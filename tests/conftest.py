@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+
+Multi-chip TPU hardware is not available in CI; sharding correctness is
+validated on a virtual CPU mesh exactly as the driver's dryrun does.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+existing = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in existing:
+    os.environ["XLA_FLAGS"] = (
+        existing + " --xla_force_host_platform_device_count=8"
+    ).strip()

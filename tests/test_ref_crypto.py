@@ -1,0 +1,123 @@
+"""Reference (pure-Python) BLS12-381 implementation tests.
+
+These validate the mathematical ground truth that the JAX/TPU kernels are
+checked against: curve constants, group structure, field tower laws, the
+final-exponentiation addition chain, and pairing bilinearity.
+"""
+
+import random
+
+from lighthouse_tpu.crypto import constants as C
+from lighthouse_tpu.crypto import ref_fields as ff
+from lighthouse_tpu.crypto import ref_pairing as pairing
+from lighthouse_tpu.crypto.ref_curve import G1, G2
+
+rng = random.Random(1234)
+
+
+def test_curve_constants():
+    # generators satisfy curve equations
+    assert G1.is_on_curve(G1.generator)
+    assert G2.is_on_curve(G2.generator)
+    # generators have order r
+    assert G1.is_infinity(G1.mul_scalar(G1.generator, C.R))
+    assert G2.is_infinity(G2.mul_scalar(G2.generator, C.R))
+    assert not G1.is_infinity(G1.mul_scalar(G1.generator, C.R - 1))
+    # BLS structure: r = x^4 - x^2 + 1, p = (x-1)^2/3 * r + x
+    x = C.BLS_X
+    assert C.R == x**4 - x**2 + 1
+    assert C.P == (x - 1) ** 2 * C.R // 3 + x
+
+
+def test_cofactor_clearing_lands_in_subgroup():
+    # random point on E'(Fp2) (not in G2): scale generator out, or build via
+    # cofactor: take h2 * random_curve_point and check r-torsion
+    # Construct a curve point by hashing x-coords until on-curve
+    from lighthouse_tpu.crypto.ref_fields import fp2_sqrt, fp2_add, fp2_mul, fp2_sqr
+
+    attempt = (rng.randrange(C.P), rng.randrange(C.P))
+    while True:
+        rhs = fp2_add(fp2_mul(fp2_sqr(attempt), attempt), C.B_G2)
+        y = fp2_sqrt(rhs)
+        if y is not None:
+            break
+        attempt = (attempt[0] + 1, attempt[1])
+    pt = (attempt, y, ff.FP2_ONE)
+    assert G2.is_on_curve(pt)
+    cleared = G2.clear_cofactor(pt)
+    assert G2.in_subgroup(cleared)
+
+
+def test_fp2_sqrt_total_on_squares():
+    """Every square in Fp2 must yield a root (regression: p%8==3 fix-up)."""
+    for _ in range(20):
+        a = (rng.randrange(C.P), rng.randrange(C.P))
+        sq = ff.fp2_sqr(a)
+        root = ff.fp2_sqrt(sq)
+        assert root is not None and ff.fp2_sqr(root) == sq
+
+
+def test_group_laws():
+    a, b = rng.randrange(C.R), rng.randrange(C.R)
+    pa = G1.mul_scalar(G1.generator, a)
+    pb = G1.mul_scalar(G1.generator, b)
+    pab = G1.mul_scalar(G1.generator, (a + b) % C.R)
+    assert G1.eq(G1.add(pa, pb), pab)
+    # doubling consistency
+    assert G1.eq(G1.double(pa), G1.mul_scalar(G1.generator, 2 * a % C.R))
+    # G2 same laws
+    qa = G2.mul_scalar(G2.generator, a)
+    qb = G2.mul_scalar(G2.generator, b)
+    qab = G2.mul_scalar(G2.generator, (a + b) % C.R)
+    assert G2.eq(G2.add(qa, qb), qab)
+
+
+def test_field_tower_laws():
+    def rand_fp2():
+        return (rng.randrange(C.P), rng.randrange(C.P))
+
+    a = ((rand_fp2(), rand_fp2(), rand_fp2()), (rand_fp2(), rand_fp2(), rand_fp2()))
+    b = ((rand_fp2(), rand_fp2(), rand_fp2()), (rand_fp2(), rand_fp2(), rand_fp2()))
+    # mul commutes, inv works, frobenius is the p-power map
+    assert ff.fp12_mul(a, b) == ff.fp12_mul(b, a)
+    assert ff.fp12_mul(a, ff.fp12_inv(a)) == ff.FP12_ONE
+    assert ff.fp12_frobenius(a) == ff.fp12_pow(a, C.P)
+
+
+def test_final_exp_decomposition_identity():
+    """The hard-part addition chain must equal 3*(p^4-p^2+1)/r."""
+    p, r, x = C.P, C.R, C.BLS_X
+    hard = (p**4 - p**2 + 1) // r
+    assert (p**4 - p**2 + 1) % r == 0
+    assert 3 * hard == (x - 1) ** 2 * (x + p) * (x**2 + p**2 - 1) + 3
+
+
+def test_pairing_bilinearity():
+    a, b = 7, 13
+    P1 = G1.to_affine(G1.generator)
+    Q1 = G2.to_affine(G2.generator)
+    Pa = G1.to_affine(G1.mul_scalar(G1.generator, a))
+    Qb = G2.to_affine(G2.mul_scalar(G2.generator, b))
+    e_ab = pairing.pairing(Pa, Qb)
+    e_base = pairing.pairing(P1, Q1)
+    assert e_ab == ff.fp12_pow(e_base, a * b)
+    assert e_base != ff.FP12_ONE
+    # e(aP, Q) * e(-aP, Q) == 1
+    Pneg = G1.to_affine(G1.neg(G1.mul_scalar(G1.generator, a)))
+    Qa = G2.to_affine(G2.mul_scalar(G2.generator, a))
+    assert pairing.multi_pairing_is_one([(Pa, Q1), (Pneg, Q1)])
+    # e(aP, Q) == e(P, aQ)
+    assert pairing.multi_pairing_is_one([(Pa, Q1), (G1.to_affine(G1.neg(G1.generator)), Qa)])
+
+
+def test_pairing_verify_shape():
+    """BLS verification equation shape: e(pk, H) == e(g1, sig)."""
+    sk = rng.randrange(1, C.R)
+    msg_point = G2.mul_scalar(G2.generator, rng.randrange(1, C.R))  # stand-in H(m)
+    pk = G1.mul_scalar(G1.generator, sk)
+    sig = G2.mul_scalar(msg_point, sk)
+    neg_g1 = G1.neg(G1.generator)
+    assert pairing.pairing_check_points([pk, neg_g1], [msg_point, sig])
+    # wrong signature fails
+    bad_sig = G2.mul_scalar(msg_point, (sk + 1) % C.R)
+    assert not pairing.pairing_check_points([pk, neg_g1], [msg_point, bad_sig])
