@@ -98,16 +98,8 @@ def miller_loop(pairs):
 
 
 def _pow_x(f):
-    """f^|x| by square-and-multiply over the fixed 64-bit parameter."""
-    result = ff.FP12_ONE
-    base = f
-    e = BLS_X_ABS
-    while e:
-        if e & 1:
-            result = ff.fp12_mul(result, base)
-        base = ff.fp12_sqr(base)
-        e >>= 1
-    return result
+    """f^|x| over the fixed 64-bit parameter."""
+    return ff.fp12_pow(f, BLS_X_ABS)
 
 
 def _pow_neg_x(f):

@@ -1,0 +1,11 @@
+"""Device (JAX/XLA/Pallas) kernels for the lighthouse_tpu crypto data plane.
+
+Layout convention: a base-field element is a little-endian vector of
+`constants.NLIMBS` limbs of `constants.LIMB_BITS` bits held in int32 lanes,
+shape (..., NLIMBS). Tower elements (Fp2/Fp6/Fp12) and curve points are
+pytrees (tuples) of such arrays, mirroring the pure-Python reference in
+`lighthouse_tpu.crypto` 1:1 so every kernel is testable against it.
+
+All arithmetic is batched: every op broadcasts over leading axes, so the
+same code serves one signature set or a 30k-signature slot batch.
+"""

@@ -1,0 +1,86 @@
+"""Device Fp limb arithmetic vs the pure-Python reference field."""
+
+import random
+
+import jax
+import numpy as np
+
+from lighthouse_tpu.crypto.constants import P
+from lighthouse_tpu.ops import fp
+
+rng = random.Random(99)
+
+
+def rand_fp(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def test_roundtrip_int_limbs():
+    vals = rand_fp(8) + [0, 1, P - 1]
+    arr = fp.pack(vals)
+    for v, row in zip(vals, arr):
+        assert fp.to_int(row) == v
+
+
+def test_add_sub_neg():
+    a_vals, b_vals = rand_fp(16), rand_fp(16)
+    a, b = fp.pack(a_vals), fp.pack(b_vals)
+    s = jax.jit(fp.add)(a, b)
+    d = jax.jit(fp.sub)(a, b)
+    n = jax.jit(fp.neg)(a)
+    for i in range(16):
+        assert fp.to_int(s[i]) == (a_vals[i] + b_vals[i]) % P
+        assert fp.to_int(d[i]) == (a_vals[i] - b_vals[i]) % P
+        assert fp.to_int(n[i]) == (-a_vals[i]) % P
+    # edge: 0 and p-1
+    edge = fp.pack([0, P - 1])
+    assert fp.to_int(fp.neg(edge)[0]) == 0
+    assert fp.to_int(fp.neg(edge)[1]) == 1
+    assert fp.to_int(fp.add(edge, edge)[1]) == (2 * (P - 1)) % P
+
+
+def test_mont_mul_matches_reference():
+    a_vals, b_vals = rand_fp(16), rand_fp(16)
+    am = jax.jit(fp.to_mont)(fp.pack(a_vals))
+    bm = jax.jit(fp.to_mont)(fp.pack(b_vals))
+    prod = jax.jit(fp.from_mont)(jax.jit(fp.mont_mul)(am, bm))
+    for i in range(16):
+        assert fp.to_int(prod[i]) == (a_vals[i] * b_vals[i]) % P
+
+
+def test_mont_roundtrip_and_edges():
+    vals = [0, 1, 2, P - 1, P - 2] + rand_fp(3)
+    m = fp.to_mont(fp.pack(vals))
+    back = fp.from_mont(m)
+    for v, row in zip(vals, back):
+        assert fp.to_int(row) == v
+
+
+def test_scalar_small():
+    vals = rand_fp(4) + [P - 1]
+    arr = fp.pack(vals)
+    for k in (2, 3, 8):
+        out = jax.jit(fp.scalar_small, static_argnums=1)(arr, k)
+        for v, row in zip(vals, out):
+            assert fp.to_int(row) == v * k % P
+
+
+def test_inv():
+    vals = rand_fp(4) + [1, P - 1]
+    am = fp.to_mont(fp.pack(vals))
+    out = fp.from_mont(jax.jit(fp.inv)(am))
+    for v, row in zip(vals, out):
+        assert fp.to_int(row) == pow(v, -1, P)
+
+
+def test_inv_zero_is_zero():
+    z = fp.to_mont(fp.pack([0]))
+    assert fp.to_int(fp.from_mont(fp.inv(z))[0]) == 0
+
+
+def test_batched_shapes():
+    """Ops must broadcast over arbitrary leading axes."""
+    a = fp.to_mont(np.stack([fp.pack(rand_fp(3)) for _ in range(2)]))
+    b = fp.to_mont(np.stack([fp.pack(rand_fp(3)) for _ in range(2)]))
+    out = fp.mont_mul(a, b)
+    assert out.shape == a.shape
