@@ -1,0 +1,61 @@
+"""Headline benchmark: BLS signature verification throughput on one chip.
+
+Config #1 from BASELINE.json: `verify_signature_sets` over 1024 independent
+single-key signature sets (the gossip-attestation shape — the >=30k sigs/slot
+hot path of the reference client, crypto/bls/src/impls/blst.rs:36-119).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured against the north-star target rate of 150k sigs/sec
+(30k signatures in <200 ms on one chip, BASELINE.json/BASELINE.md) — 1.0
+means the target is met.
+"""
+
+import json
+import os
+import time
+
+
+def main():
+    import numpy as np
+
+    import jax
+
+    from lighthouse_tpu import testing as td
+    from lighthouse_tpu.ops import batch_verify
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    n_sets = 32 if smoke else 1024
+    reps = 3 if smoke else 5
+
+    args = td.make_signature_set_batch(
+        n_sets, max_keys=1, seed=0, fast_sequential=True
+    )
+    args = jax.device_put(args)
+
+    fn = jax.jit(batch_verify.verify_signature_sets)
+    ok = bool(np.asarray(fn(*args)))  # compile + warm
+    assert ok, "benchmark batch failed to verify"
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[len(times) // 2]
+
+    sigs_per_sec = n_sets / p50
+    target = 150_000.0  # sigs/sec north star (30k in 200 ms)
+    print(
+        json.dumps(
+            {
+                "metric": "verify_signature_sets_throughput",
+                "value": round(sigs_per_sec, 2),
+                "unit": "sigs/sec",
+                "vs_baseline": round(sigs_per_sec / target, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from lighthouse_tpu.beacon_chain.chain import BeaconChain  # noqa: F401

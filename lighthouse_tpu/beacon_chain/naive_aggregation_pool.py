@@ -1,0 +1,64 @@
+"""Naive aggregation pool: merge own-subnet unaggregated attestations.
+
+Role of beacon_node/beacon_chain/src/naive_aggregation_pool.rs: group
+unaggregated attestations by AttestationData root, OR the aggregation bits
+and aggregate the signatures; retain a few slots; cap distinct data per
+slot (SLOTS_RETAINED / MAX_ATTESTATIONS_PER_SLOT,
+naive_aggregation_pool.rs:14-24).
+"""
+
+from lighthouse_tpu import bls
+
+SLOTS_RETAINED = 3
+MAX_ATTESTATIONS_PER_SLOT = 16_384
+
+
+class InsertOutcome:
+    NEW = "new"
+    AGGREGATED = "aggregated"
+    ALREADY_KNOWN = "already_known"
+    STALE = "stale"
+    CAPACITY = "capacity"
+
+
+class NaiveAggregationPool:
+    def __init__(self):
+        # slot -> {data_root: Attestation (aggregate under construction)}
+        self._by_slot: dict[int, dict[bytes, object]] = {}
+
+    def insert(self, attestation) -> str:
+        data = attestation.data
+        slot = data.slot
+        slots = self._by_slot.setdefault(slot, {})
+        data_root = type(data).hash_tree_root(data)
+        existing = slots.get(data_root)
+        if existing is None:
+            if len(slots) >= MAX_ATTESTATIONS_PER_SLOT:
+                return InsertOutcome.CAPACITY
+            slots[data_root] = attestation.copy()
+            return InsertOutcome.NEW
+        new_bits = list(attestation.aggregation_bits)
+        old_bits = list(existing.aggregation_bits)
+        if all(ob or not nb for nb, ob in zip(new_bits, old_bits)):
+            return InsertOutcome.ALREADY_KNOWN
+        merged = [a or b for a, b in zip(old_bits, new_bits)]
+        existing.aggregation_bits = merged
+        existing.signature = bls.aggregate_signatures(
+            [
+                bls.Signature.from_bytes(bytes(existing.signature)),
+                bls.Signature.from_bytes(bytes(attestation.signature)),
+            ]
+        ).to_bytes()
+        return InsertOutcome.AGGREGATED
+
+    def get(self, data) -> object | None:
+        data_root = type(data).hash_tree_root(data)
+        return self._by_slot.get(data.slot, {}).get(data_root)
+
+    def aggregates_at_slot(self, slot: int):
+        return list(self._by_slot.get(slot, {}).values())
+
+    def prune(self, current_slot: int):
+        cutoff = current_slot - SLOTS_RETAINED + 1
+        for slot in [s for s in self._by_slot if s < cutoff]:
+            del self._by_slot[slot]

@@ -1,0 +1,271 @@
+"""Host-side BLS API: keys, signatures, signature sets, backend dispatch.
+
+Mirrors the capability surface of the reference's `crypto/bls` crate:
+generic wrappers (`GenericPublicKey`/`GenericSignature`/
+`GenericSignatureSet`, crypto/bls/src/generic_signature_set.rs:61) over
+pluggable backends selected at runtime (the reference selects blst/milagro/
+fake_crypto at compile time via `define_mod!`, crypto/bls/src/lib.rs:95-151).
+
+Backends here:
+  "ref"  — pure-Python pairing (the milagro analog; ground truth)
+  "tpu"  — device batch verification (`ops.batch_verify`), the production
+           path: one multi-pairing per batch with RLC scalars
+  "fake" — always-valid (the fake_crypto analog for spec tests)
+
+Policy preserved from the reference:
+  * pubkeys are validated at deserialization: on-curve, not infinity,
+    in-subgroup (blst.rs:126-136 key_validate)
+  * signatures are subgroup-checked at verification time (blst.rs:72-81)
+  * empty signature-set batches fail (blst.rs:41-43)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import secrets
+
+from lighthouse_tpu.bls import point_serde
+from lighthouse_tpu.bls.hash_to_curve import hash_to_g2
+from lighthouse_tpu.crypto import ref_pairing
+from lighthouse_tpu.crypto.constants import R
+from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
+from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
+
+INFINITY_PUBKEY_BYTES = bytes([0xC0]) + b"\x00" * 47
+INFINITY_SIGNATURE_BYTES = bytes([0xC0]) + b"\x00" * 95
+
+_DEFAULT_BACKEND = os.environ.get("LIGHTHOUSE_TPU_BLS_BACKEND", "ref")
+
+
+class BlsError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------------ secrets
+
+
+class SecretKey:
+    __slots__ = ("_sk",)
+
+    def __init__(self, scalar: int):
+        if not 1 <= scalar < R:
+            raise BlsError("secret key out of range")
+        self._sk = scalar
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SecretKey":
+        if len(data) != 32:
+            raise BlsError("secret key: expected 32 bytes")
+        return cls(int.from_bytes(data, "big"))
+
+    @classmethod
+    def random(cls) -> "SecretKey":
+        return cls(1 + secrets.randbelow(R - 1))
+
+    def to_bytes(self) -> bytes:
+        return self._sk.to_bytes(32, "big")
+
+    def public_key(self) -> "PublicKey":
+        pt = G1_GROUP.mul_scalar(G1_GROUP.generator, self._sk)
+        return PublicKey(pt)
+
+    def sign(self, message: bytes) -> "Signature":
+        h = hash_to_g2(message)
+        return Signature(G2_GROUP.mul_scalar(h, self._sk))
+
+
+class Keypair:
+    __slots__ = ("sk", "pk")
+
+    def __init__(self, sk: SecretKey):
+        self.sk = sk
+        self.pk = sk.public_key()
+
+
+def interop_keypairs(n: int) -> list[Keypair]:
+    """Deterministic interop keypairs (common/eth2_interop_keypairs analog):
+    sk_i = int(sha256(le32(i+1))) % r, nonzero-adjusted."""
+    out = []
+    for i in range(n):
+        digest = hashlib.sha256((i + 1).to_bytes(32, "little")).digest()
+        sk = int.from_bytes(digest, "little") % R
+        out.append(Keypair(SecretKey(sk if sk else 1)))
+    return out
+
+
+# ------------------------------------------------------------------- points
+
+
+class PublicKey:
+    """Validated G1 point (never infinity, always in-subgroup)."""
+
+    __slots__ = ("point", "_bytes")
+
+    def __init__(self, point_jacobian, compressed: bytes | None = None):
+        self.point = point_jacobian
+        self._bytes = compressed
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PublicKey":
+        pt = point_serde.g1_decompress(bytes(data))
+        if G1_GROUP.is_infinity(pt):
+            raise BlsError("pubkey: point at infinity rejected")
+        if not G1_GROUP.in_subgroup(pt):
+            raise BlsError("pubkey: not in subgroup")
+        return cls(pt, bytes(data))
+
+    def to_bytes(self) -> bytes:
+        if self._bytes is None:
+            self._bytes = point_serde.g1_compress(self.point)
+        return self._bytes
+
+    def __eq__(self, other):
+        return isinstance(other, PublicKey) and self.to_bytes() == other.to_bytes()
+
+    def __hash__(self):
+        return hash(self.to_bytes())
+
+
+class Signature:
+    """G2 point; subgroup checked at verification (or explicitly)."""
+
+    __slots__ = ("point", "_bytes", "_subgroup_ok")
+
+    def __init__(self, point_jacobian, compressed: bytes | None = None):
+        self.point = point_jacobian
+        self._bytes = compressed
+        self._subgroup_ok = None
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Signature":
+        return cls(point_serde.g2_decompress(bytes(data)), bytes(data))
+
+    def to_bytes(self) -> bytes:
+        if self._bytes is None:
+            self._bytes = point_serde.g2_compress(self.point)
+        return self._bytes
+
+    def is_infinity(self) -> bool:
+        return G2_GROUP.is_infinity(self.point)
+
+    def in_subgroup(self) -> bool:
+        if self._subgroup_ok is None:
+            self._subgroup_ok = G2_GROUP.in_subgroup(self.point)
+        return self._subgroup_ok
+
+    def __eq__(self, other):
+        return isinstance(other, Signature) and self.to_bytes() == other.to_bytes()
+
+
+def aggregate_signatures(sigs) -> Signature:
+    acc = G2_GROUP.infinity
+    for s in sigs:
+        acc = G2_GROUP.add(acc, s.point)
+    return Signature(acc)
+
+
+def aggregate_public_keys(pubkeys) -> PublicKey:
+    if not pubkeys:
+        raise BlsError("aggregate of zero pubkeys")
+    acc = G1_GROUP.infinity
+    for p in pubkeys:
+        acc = G1_GROUP.add(acc, p.point)
+    return PublicKey(acc)
+
+
+def aggregate_pubkeys_bytes(pubkey_bytes_list) -> bytes:
+    """Aggregate compressed pubkeys -> compressed aggregate (may be the
+    infinity encoding if keys cancel; used for SyncCommittee aggregates)."""
+    acc = G1_GROUP.infinity
+    for data in pubkey_bytes_list:
+        acc = G1_GROUP.add(acc, point_serde.g1_decompress(bytes(data)))
+    return point_serde.g1_compress(acc)
+
+
+# --------------------------------------------------------------- signature sets
+
+
+class SignatureSet:
+    """One verification unit: signature over message by >= 1 pubkeys
+    (pre-aggregated by point addition), the analog of
+    `GenericSignatureSet` (generic_signature_set.rs:61)."""
+
+    __slots__ = ("signature", "pubkeys", "message")
+
+    def __init__(self, signature: Signature, pubkeys, message: bytes):
+        if not pubkeys:
+            raise BlsError("signature set with no pubkeys")
+        self.signature = signature
+        self.pubkeys = list(pubkeys)
+        self.message = bytes(message)
+
+
+def _verify_one_ref(sset: SignatureSet) -> bool:
+    if sset.signature.is_infinity() or not sset.signature.in_subgroup():
+        return False
+    agg_pk = G1_GROUP.infinity
+    for p in sset.pubkeys:
+        agg_pk = G1_GROUP.add(agg_pk, p.point)
+    h = hash_to_g2(sset.message)
+    return ref_pairing.pairing_check_points(
+        [agg_pk, G1_GROUP.neg(G1_GROUP.generator)],
+        [h, sset.signature.point],
+    )
+
+
+def verify(pk: PublicKey, message: bytes, sig: Signature) -> bool:
+    return _verify_one_ref(SignatureSet(sig, [pk], message))
+
+
+def fast_aggregate_verify(pubkeys, message: bytes, sig: Signature) -> bool:
+    if not pubkeys:
+        return False
+    return _verify_one_ref(SignatureSet(sig, pubkeys, message))
+
+
+def eth_fast_aggregate_verify(pubkeys, message: bytes, sig: Signature) -> bool:
+    """Ethereum variant: the infinity signature over zero pubkeys is valid
+    (empty sync aggregates)."""
+    if not pubkeys and sig.to_bytes() == INFINITY_SIGNATURE_BYTES:
+        return True
+    return fast_aggregate_verify(pubkeys, message, sig)
+
+
+def aggregate_verify(pubkeys, messages, sig: Signature) -> bool:
+    """Distinct-message aggregate verification."""
+    if not pubkeys or len(pubkeys) != len(messages):
+        return False
+    if sig.is_infinity() or not sig.in_subgroup():
+        return False
+    g1s = [p.point for p in pubkeys] + [G1_GROUP.neg(G1_GROUP.generator)]
+    g2s = [hash_to_g2(m) for m in messages] + [sig.point]
+    return ref_pairing.pairing_check_points(g1s, g2s)
+
+
+# ----------------------------------------------------------- batch dispatch
+
+
+def verify_signature_sets(
+    sets, backend: str | None = None, seed: int | None = None
+) -> bool:
+    """Batch-verify signature sets — the north-star boundary
+    (blst.rs:36-119 verify_signature_sets).
+
+    Empty batches fail. On the tpu backend the whole batch becomes one
+    device multi-pairing with >=64-bit RLC scalars; "ref" verifies each set
+    with an independent pairing check (ground truth); "fake" returns True.
+    """
+    sets = list(sets)
+    if not sets:
+        return False
+    backend = backend or _DEFAULT_BACKEND
+    if backend == "fake":
+        return True
+    if backend == "ref":
+        return all(_verify_one_ref(s) for s in sets)
+    if backend == "tpu":
+        from lighthouse_tpu.bls.tpu_backend import verify_signature_sets_tpu
+
+        return verify_signature_sets_tpu(sets, seed=seed)
+    raise BlsError(f"unknown BLS backend {backend!r}")

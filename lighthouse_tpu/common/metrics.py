@@ -1,0 +1,126 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+Role of common/lighthouse_metrics (lazy-static Prometheus registries,
+start_timer/stop_timer histograms) — a dependency-free registry exposing
+the same scrape format `http_metrics` serves.
+"""
+
+import threading
+import time
+from collections import defaultdict
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_, "counter")
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, v: float = 1.0):
+        with self._lock:
+            self.value += v
+
+    def render(self):
+        return [f"{self.name} {self.value}"]
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_, "gauge")
+        self.value = 0.0
+
+    def set(self, v: float):
+        self.value = float(v)
+
+    def render(self):
+        return [f"{self.name} {self.value}"]
+
+
+class Histogram(_Metric):
+    DEFAULT_BUCKETS = (
+        0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+    )
+
+    def __init__(self, name, help_="", buckets=None):
+        super().__init__(name, help_, "histogram")
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self.counts = defaultdict(int)
+        self.total = 0.0
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        with self._lock:
+            self.n += 1
+            self.total += v
+            for b in self.buckets:
+                if v <= b:
+                    self.counts[b] += 1
+
+    def time(self):
+        return _Timer(self)
+
+    def render(self):
+        out = []
+        cum = 0
+        for b in self.buckets:
+            cum = self.counts[b]
+            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self.n}')
+        out.append(f"{self.name}_sum {self.total}")
+        out.append(f"{self.name}_count {self.n}")
+        return out
+
+
+class _Timer:
+    def __init__(self, hist):
+        self.hist = hist
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name, help_="") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help_))
+
+    def gauge(self, name, help_="") -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help_))
+
+    def histogram(self, name, help_="", buckets=None) -> Histogram:
+        return self._get_or_create(
+            name, lambda: Histogram(name, help_, buckets)
+        )
+
+    def _get_or_create(self, name, factory):
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = factory()
+            return self._metrics[name]
+
+    def render(self) -> str:
+        lines = []
+        for m in self._metrics.values():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()

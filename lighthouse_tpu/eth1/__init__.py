@@ -1,0 +1,2 @@
+from lighthouse_tpu.eth1.deposit_tree import DepositTree  # noqa: F401
+from lighthouse_tpu.eth1.service import Eth1Cache, MockEth1Backend  # noqa: F401

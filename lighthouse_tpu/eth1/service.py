@@ -1,0 +1,87 @@
+"""Eth1 data plane: deposit log cache + eth1-data voting inputs.
+
+Role of beacon_node/eth1/src/service.rs (deposit/block caches polled from
+the execution chain) — here split into a pure cache (`Eth1Cache`) and a
+backend interface with a deterministic in-process mock
+(`MockEth1Backend`, the CachingEth1Backend-with-fake-chain analog used by
+the reference harness).
+"""
+
+from dataclasses import dataclass, field
+
+from lighthouse_tpu.eth1.deposit_tree import DepositTree
+
+
+@dataclass
+class Eth1Block:
+    number: int
+    hash: bytes
+    timestamp: int
+    deposit_root: bytes
+    deposit_count: int
+
+
+@dataclass
+class Eth1Cache:
+    """Deposit log + block cache supporting range queries for block
+    packing (get deposits for [start, end) deposit indices)."""
+
+    tree: DepositTree = field(default_factory=DepositTree)
+    deposit_data: list = field(default_factory=list)
+    blocks: list = field(default_factory=list)
+
+    def add_deposit(self, deposit_data, leaf_root: bytes):
+        self.deposit_data.append(deposit_data)
+        self.tree.push(leaf_root)
+
+    def add_block(self, block: Eth1Block):
+        self.blocks.append(block)
+
+    def deposits_for_block(self, start_index: int, count: int, t):
+        """Build Deposit containers (with proofs) for inclusion."""
+        out = []
+        for i in range(start_index, min(start_index + count, len(self.tree))):
+            out.append(
+                t.Deposit(
+                    proof=self.tree.proof(i),
+                    data=self.deposit_data[i],
+                )
+            )
+        return out
+
+    def latest_eth1_data(self, t):
+        if not self.blocks:
+            return None
+        b = self.blocks[-1]
+        return t.Eth1Data(
+            deposit_root=b.deposit_root,
+            deposit_count=b.deposit_count,
+            block_hash=b.hash,
+        )
+
+
+class MockEth1Backend:
+    """Deterministic fake execution chain for tests/simulation."""
+
+    def __init__(self, t, seconds_per_eth1_block: int = 14):
+        self.t = t
+        self.cache = Eth1Cache()
+        self.seconds_per_eth1_block = seconds_per_eth1_block
+        self._next_number = 0
+
+    def mine_block(self, timestamp: int):
+        n = self._next_number
+        self._next_number += 1
+        block = Eth1Block(
+            number=n,
+            hash=n.to_bytes(4, "big").rjust(32, b"\x11"),
+            timestamp=timestamp,
+            deposit_root=self.cache.tree.root(),
+            deposit_count=len(self.cache.tree),
+        )
+        self.cache.add_block(block)
+        return block
+
+    def submit_deposit(self, deposit_data):
+        leaf = type(deposit_data).hash_tree_root(deposit_data)
+        self.cache.add_deposit(deposit_data, leaf)

@@ -1,0 +1,2 @@
+from lighthouse_tpu.fork_choice.proto_array import ProtoArray  # noqa: F401
+from lighthouse_tpu.fork_choice.fork_choice import ForkChoice  # noqa: F401

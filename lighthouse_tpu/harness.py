@@ -1,0 +1,256 @@
+"""In-process chain harness: deterministic validators driving a real state
+transition, block production, and attestation flow.
+
+Role of the reference's `BeaconChainHarness`
+(beacon_node/beacon_chain/src/test_utils.rs:47-66): interop-keypair genesis,
+manual slot control, block production with full attestation participation,
+and import through the real per-block pipeline — the "minimum end-to-end
+slice" of SURVEY.md §7. The full BeaconChain runtime (fork choice, stores,
+pools) builds on this.
+"""
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.ssz.hashing import ZERO_BYTES32
+from lighthouse_tpu.state_processing.helpers import (
+    CommitteeCache,
+    get_beacon_proposer_index,
+    get_block_root_at_slot,
+    get_current_epoch,
+    get_domain,
+)
+from lighthouse_tpu.state_processing.genesis import interop_genesis_state
+from lighthouse_tpu.state_processing.per_block import (
+    BlockSignatureStrategy,
+    per_block_processing,
+)
+from lighthouse_tpu.state_processing.per_slot import process_slots
+from lighthouse_tpu.state_processing.pubkey_cache import PubkeyCache
+from lighthouse_tpu.types.containers import types_for
+from lighthouse_tpu.types.helpers import compute_signing_root
+from lighthouse_tpu.types.spec import Spec
+from lighthouse_tpu import ssz
+
+
+class Harness:
+    def __init__(
+        self,
+        spec: Spec,
+        n_validators: int,
+        backend: str = "ref",
+        genesis_time: int = 0,
+    ):
+        self.spec = spec
+        self.t = types_for(spec)
+        self.keypairs = bls.interop_keypairs(n_validators)
+        self.state = interop_genesis_state(
+            [kp.pk.to_bytes() for kp in self.keypairs], genesis_time, spec
+        )
+        self.backend = backend
+        self.pubkey_cache = PubkeyCache()
+        self.pubkey_cache.import_new(self.state)
+        self.fork_name = spec.fork_name_at_epoch(0)
+        # attestations produced at the previous slot, pending inclusion
+        self.pending_attestations = []
+
+    # ------------------------------------------------------------ helpers
+
+    def _sign(self, sk, obj_root: bytes, domain: bytes) -> bytes:
+        return sk.sign(compute_signing_root(obj_root, domain)).to_bytes()
+
+    def head_block_root(self, state) -> bytes:
+        header = state.latest_block_header
+        if bytes(header.state_root) == ZERO_BYTES32:
+            header = header.copy()
+            header.state_root = type(state).hash_tree_root(state)
+        return type(header).hash_tree_root(header)
+
+    # ----------------------------------------------------- attestations
+
+    def make_attestations(self, state, slot: int):
+        """Full-participation attestations for `slot` against the current
+        head (call right after importing the block at `slot`)."""
+        spec = self.spec
+        t = self.t
+        epoch = spec.slot_to_epoch(slot)
+        cache = CommitteeCache(state, epoch, spec)
+        head_root = self.head_block_root(state)
+        start_slot = spec.epoch_start_slot(epoch)
+        if start_slot == slot:
+            target_root = head_root
+        else:
+            target_root = bytes(get_block_root_at_slot(state, start_slot, spec))
+        out = []
+        for index in range(cache.committees_per_slot):
+            committee = cache.get_beacon_committee(slot, index)
+            data = t.AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=head_root,
+                source=state.current_justified_checkpoint,
+                target=t.Checkpoint(epoch=epoch, root=target_root),
+            )
+            domain = get_domain(
+                state, spec.DOMAIN_BEACON_ATTESTER, epoch, spec
+            )
+            root = t.AttestationData.hash_tree_root(data)
+            sigs = [
+                bls.Signature.from_bytes(
+                    self._sign(self.keypairs[v].sk, root, domain)
+                )
+                for v in committee
+            ]
+            out.append(
+                t.Attestation(
+                    aggregation_bits=[True] * len(committee),
+                    data=data,
+                    signature=bls.aggregate_signatures(sigs).to_bytes(),
+                )
+            )
+        return out
+
+    def make_sync_aggregate(self, state, block_root: bytes):
+        spec = self.spec
+        t = self.t
+        prev_slot = max(state.slot, 1) - 1
+        domain = get_domain(
+            state,
+            spec.DOMAIN_SYNC_COMMITTEE,
+            spec.slot_to_epoch(prev_slot),
+            spec,
+        )
+        signing_root = compute_signing_root(block_root, domain)
+        sigs = []
+        bits = []
+        for pk in state.current_sync_committee.pubkeys:
+            idx = self.pubkey_cache.index_of(bytes(pk))
+            bits.append(True)
+            sigs.append(self.keypairs[idx].sk.sign(signing_root))
+        return t.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=bls.aggregate_signatures(
+                sigs
+            ).to_bytes(),
+        )
+
+    # -------------------------------------------------------- production
+
+    def produce_block(self, slot: int, attestations):
+        """Produce a signed block for `slot` on top of the current state."""
+        spec = self.spec
+        t = self.t
+        state = self.state.copy()
+        state = process_slots(state, slot, spec)
+        fork_name = spec.fork_name_at_epoch(get_current_epoch(state, spec))
+
+        proposer = get_beacon_proposer_index(state, spec)
+        epoch = get_current_epoch(state, spec)
+        randao_domain = get_domain(state, spec.DOMAIN_RANDAO, epoch, spec)
+        randao_reveal = self._sign(
+            self.keypairs[proposer].sk,
+            ssz.uint64.hash_tree_root(epoch),
+            randao_domain,
+        )
+
+        body_cls = t.block_body_classes[fork_name]
+        body = body_cls(
+            randao_reveal=randao_reveal,
+            eth1_data=state.eth1_data,
+            graffiti=b"\x00" * 32,
+            attestations=list(attestations),
+        )
+        parent_root = self.head_block_root(state)
+        if fork_name != "phase0":
+            prev_root = (
+                parent_root
+                if state.slot > 0
+                else self.head_block_root(state)
+            )
+            body.sync_aggregate = self.make_sync_aggregate(state, prev_root)
+
+        block_cls = t.block_classes[fork_name]
+        block = block_cls(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=parent_root,
+            state_root=ZERO_BYTES32,
+            body=body,
+        )
+
+        # compute post-state root with signatures skipped
+        trial = state.copy()
+        signed_cls = t.signed_block_classes[fork_name]
+        trial_signed = signed_cls(message=block, signature=b"\x00" * 96)
+        per_block_processing(
+            trial,
+            trial_signed,
+            spec,
+            BlockSignatureStrategy.NO_VERIFICATION,
+            self.pubkey_cache,
+        )
+        block.state_root = type(trial).hash_tree_root(trial)
+
+        proposal_domain = get_domain(
+            state,
+            spec.DOMAIN_BEACON_PROPOSER,
+            spec.slot_to_epoch(slot),
+            spec,
+        )
+        signature = self._sign(
+            self.keypairs[proposer].sk,
+            block_cls.hash_tree_root(block),
+            proposal_domain,
+        )
+        return signed_cls(message=block, signature=signature)
+
+    # ------------------------------------------------------------ import
+
+    def import_block(self, signed_block, strategy=None):
+        spec = self.spec
+        state = self.state.copy()
+        state = process_slots(state, signed_block.message.slot, spec)
+        per_block_processing(
+            state,
+            signed_block,
+            spec,
+            strategy
+            if strategy is not None
+            else BlockSignatureStrategy.VERIFY_BULK,
+            self.pubkey_cache,
+            backend=self.backend,
+            seed=int(signed_block.message.slot) + 1,
+        )
+        # verify the block's claimed post-state root
+        post_root = type(state).hash_tree_root(state)
+        assert bytes(signed_block.message.state_root) == post_root, (
+            "state root mismatch"
+        )
+        self.state = state
+        return post_root
+
+    # ----------------------------------------------------------- driving
+
+    def advance_slot_with_block(self, slot: int):
+        """Produce + import the block for `slot` including all pending
+        attestations, then attest at `slot` with every committee."""
+        capacity = self.spec.MAX_ATTESTATIONS
+        atts = self.pending_attestations[:capacity]
+        self.pending_attestations = self.pending_attestations[capacity:]
+        block = self.produce_block(slot, atts)
+        self.import_block(block)
+        self.pending_attestations.extend(
+            self.make_attestations(self.state, slot)
+        )
+        return block
+
+    def run_slots(self, n: int):
+        start = self.state.slot + 1
+        for slot in range(start, start + n):
+            self.advance_slot_with_block(slot)
+
+    @property
+    def finalized_epoch(self) -> int:
+        return self.state.finalized_checkpoint.epoch
+
+    @property
+    def justified_epoch(self) -> int:
+        return self.state.current_justified_checkpoint.epoch

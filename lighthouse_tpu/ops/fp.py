@@ -5,15 +5,23 @@ reference client's BLS boundary (reference crypto/bls/src/impls/blst.rs); the
 math here is validated against `lighthouse_tpu.crypto.ref_fields`.
 
 Design (tpu-first):
-- An Fp element is `(..., NLIMBS)` int32, little-endian base-2^12 limbs.
+- An Fp element is `(..., NLIMBS)` int32, little-endian base-2^12 limbs,
+  canonical (every limb in [0, 2^12), value in [0, p)) at every op boundary.
   12-bit limbs keep every intermediate of a schoolbook 32x32-limb product
   below 2^30, so all accumulation fits native int32 lanes — no 64-bit
   emulation anywhere on the hot path.
-- Multiplication is Montgomery (R = 2^384) in *full-word REDC* form:
-  three 32x32-limb convolutions (a*b, m = T*N' mod R, m*P) which XLA maps to
-  dense batched contractions, plus short sequential carry scans. This avoids
-  the serial 32-step CIOS recurrence entirely — the only sequential pieces
-  are carry propagations, which are cheap `lax.scan`s over 12-bit shifts.
+- Multiplication is Montgomery (R = 2^384) in *full-word REDC* form: three
+  32x32-limb convolutions (a*b; m = T*N' mod R; m*P) which XLA maps to dense
+  batched contractions.
+- Carry handling contains NO sequential loops (a `lax.scan` per carry chain
+  made every multiply a compile-time and run-time serial bottleneck).
+  Instead: a fixed number of vectorized partial-carry passes squeezes limbs
+  into [0, 2^12], then a Kogge-Stone generate/propagate pass (log2(NLIMBS)
+  steps of boolean ops) resolves the remaining 0/1 carries exactly — the
+  classic carry-lookahead adder, laid out across vector lanes.
+- Comparisons/subtractions use complement-add form (x - y computed as
+  x + (2^384 - y) with the exact carry-out as the borrow bit), keeping all
+  limbs unsigned.
 - Elements on the device live in the Montgomery domain; conversion happens
   at the host boundary.
 
@@ -52,9 +60,27 @@ for _i in range(NLIMBS):
     for _j in range(NLIMBS):
         _CONV_MASK[_i, _j, _i + _j] = 1
 
+# Low-half-only variant (k < NLIMBS): the mod-R product used by the REDC
+# m-step — coefficients at k >= NLIMBS are multiples of R and carries flow
+# strictly upward, so they never influence the low half.
+_CONV_MASK_LOW = _CONV_MASK[:, :, :NLIMBS].copy()
+
 ZERO = np.zeros(NLIMBS, dtype=np.int32)
 ONE_MONT = np.array(int_to_limbs(MONT_R_MOD_P), dtype=np.int32)  # 1 in Mont form
 R2 = np.array(int_to_limbs(MONT_R2_MOD_P), dtype=np.int32)
+
+
+def _complement_limbs(v: int, nlimbs: int) -> np.ndarray:
+    """Limbs of (2^(12*nlimbs) - v): adding them == subtracting v, with the
+    exact top carry-out flagging v <= x."""
+    comp = (1 << (LIMB_BITS * nlimbs)) - v
+    return np.array(
+        [(comp >> (LIMB_BITS * i)) & LIMB_MASK for i in range(nlimbs)],
+        dtype=np.int32,
+    )
+
+
+_NEG_P = {n: _complement_limbs(P, n) for n in (NLIMBS, NLIMBS + 1)}
 
 
 # ------------------------------------------------------------- host helpers
@@ -66,11 +92,12 @@ def from_int(v: int) -> np.ndarray:
 
 
 def to_int(limbs) -> int:
-    """Host: limb vector -> python int."""
-    acc = 0
-    for i, limb in enumerate(np.asarray(limbs).reshape(-1)):
-        acc += int(limb) << (LIMB_BITS * i)
-    return acc % P
+    """Host: limb vector -> python int. No implicit mod-p: device ops
+    guarantee canonical outputs, and tests must see a violation if that
+    regresses."""
+    from lighthouse_tpu.crypto.constants import limbs_to_int
+
+    return limbs_to_int(np.asarray(limbs).reshape(-1))
 
 
 def pack(values) -> np.ndarray:
@@ -81,25 +108,88 @@ def pack(values) -> np.ndarray:
 # ------------------------------------------------------------ carry handling
 
 
+def _partial_pass(x):
+    """One vectorized carry pass: limb -> [0, 2^12), carries move one limb
+    up (top carry dropped — callers size arrays so it is always zero)."""
+    c = x >> LIMB_BITS
+    d = x & LIMB_MASK
+    return d + jnp.pad(
+        c[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)]
+    )
+
+
+def _ks_resolve(x):
+    """Kogge-Stone carry resolution: (canonical limbs, top carry-out).
+
+    Precondition: limbs in [0, 2*2^12 - 2] with at most one unit of carry
+    flowing between adjacent limbs (i.e. (x_i + 1) >> 12 <= 1) — the state
+    after partial-carry passes. log2(L) boolean steps.
+    """
+    g = x > LIMB_MASK  # this limb generates a carry
+    p = x == LIMB_MASK  # this limb propagates an incoming carry
+    # prefix combine: carry_out[i] = g[i] | (p[i] & carry_out[i-1])
+    shift = 1
+    L = x.shape[-1]
+    gg, pp = g, p
+    while shift < L:
+        pad = [(0, 0)] * (x.ndim - 1) + [(shift, 0)]
+        g_prev = jnp.pad(gg[..., :-shift], pad)
+        p_prev = jnp.pad(pp[..., :-shift], pad)
+        gg = gg | (pp & g_prev)
+        pp = pp & p_prev
+        shift *= 2
+    carry_in = jnp.pad(
+        gg[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)]
+    ).astype(jnp.int32)
+    return (x + carry_in) & LIMB_MASK, gg[..., -1]
+
+
+def _resolve_carries(x):
+    """Exact canonicalization (see _ks_resolve); top carry must be zero by
+    the caller's value bound."""
+    out, _ = _ks_resolve(x)
+    return out
+
+
 def _normalize(x, out_len):
     """Propagate carries so every limb lands in [0, 2^12).
 
-    `x` may hold any int32 values (including negatives, via arithmetic
-    shift) as long as the represented integer is in [0, 2^(12*out_len)).
-    Returns an (..., out_len) array of canonical limbs.
+    `x` must hold non-negative int32 limbs with value < 2^(12*out_len).
+    Returns an (..., out_len) canonical array.
     """
     in_len = x.shape[-1]
     if in_len < out_len:
         pad = [(0, 0)] * (x.ndim - 1) + [(0, out_len - in_len)]
         x = jnp.pad(x, pad)
-    xs = jnp.moveaxis(x, -1, 0)
+    elif in_len > out_len:
+        raise ValueError("normalize: would truncate")
+    # limbs < 2^30 -> pass1 brings carries <= 2^18, pass2 <= 2^6, pass3
+    # leaves limbs in [0, 2^12]; Kogge-Stone finishes exactly.
+    x = _partial_pass(x)
+    x = _partial_pass(x)
+    x = _partial_pass(x)
+    return _resolve_carries(x)
 
-    def step(carry, v):
-        t = v + carry
-        return t >> LIMB_BITS, t & LIMB_MASK
 
-    _, limbs = jax.lax.scan(step, jnp.zeros(x.shape[:-1], jnp.int32), xs)
-    return jnp.moveaxis(limbs, 0, -1)[..., :out_len]
+def _add_complement(x, comp_const):
+    """x + comp(v): returns (sum_mod_2^(12L) canonical, no_borrow) where
+    no_borrow == True iff x >= v. x must be canonical."""
+    s = x + jnp.asarray(comp_const)
+    # limbs <= 2*4095: one partial pass (capturing the top carry), then
+    # exact resolve; total carry out of the top limb == 1 iff x >= v.
+    c = s >> LIMB_BITS
+    d = s & LIMB_MASK
+    top_carry1 = c[..., -1]
+    s = d + jnp.pad(c[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    out, top_carry2 = _ks_resolve(s)
+    no_borrow = (top_carry1 + top_carry2.astype(jnp.int32)) > 0
+    return out, no_borrow
+
+
+def _cond_sub_p(x):
+    """Map canonical x in [0, 2p) to x mod p (branchless)."""
+    sub, ge = _add_complement(x, _NEG_P[x.shape[-1]])
+    return jnp.where(ge[..., None], sub, x)
 
 
 def _conv(a, b_or_const):
@@ -112,77 +202,56 @@ def _conv(a, b_or_const):
     return jnp.einsum("...ij,ijk->...k", outer, jnp.asarray(_CONV_MASK))
 
 
-def _cond_sub_p(x):
-    """Map x in [0, 2p) to x mod p: subtract p iff x >= p (branchless)."""
-    d = x - jnp.asarray(P_LIMBS)
-    ds = jnp.moveaxis(d, -1, 0)
-
-    def step(borrow, v):
-        t = v + borrow
-        return t >> LIMB_BITS, t & LIMB_MASK
-
-    borrow, limbs = jax.lax.scan(
-        step, jnp.zeros(x.shape[:-1], jnp.int32), ds
-    )
-    sub = jnp.moveaxis(limbs, 0, -1)
-    return jnp.where((borrow < 0)[..., None], x, sub)
-
-
 # ----------------------------------------------------------------- field ops
 
 
 def add(a, b):
     """(a + b) mod p for canonical inputs."""
-    return _cond_sub_p(_normalize(a + b, NLIMBS))
+    s = _partial_pass(a + b)  # limbs <= 2*4095 -> one pass + resolve
+    return _cond_sub_p(_resolve_carries(s))
+
+
+# Borrow-proof subtraction constant: 2p plus a value-zero "spread"
+# (+4096 at limb 0, +4095 at limbs 1..30, -1 at limb 31; the spread
+# telescopes to zero value). Every limb of (a + _2P_SPREAD - b) is then
+# non-negative for canonical a, b: limbs 0..30 get >= 4095 headroom, and at
+# limb 31, 2p's top limb (832) minus the spread's 1 still dominates b's top
+# limb (<= 416 since b < p).
+_2P_SPREAD = np.array(int_to_limbs(2 * P), dtype=np.int32)
+for _i in range(NLIMBS - 1):
+    _2P_SPREAD[_i] += 1 << LIMB_BITS
+    _2P_SPREAD[_i + 1] -= 1
 
 
 def sub(a, b):
-    """(a - b) mod p for canonical inputs: a - b + p, then reduce."""
-    return _cond_sub_p(_normalize(a - b + jnp.asarray(P_LIMBS), NLIMBS))
+    """(a - b) mod p for canonical inputs: a - b + 2p (limbwise
+    non-negative via the spread constant), then two conditional
+    subtractions bring [0, 3p) into [0, p).
+
+    Bound note: pre-pass limbs reach 4095 + 4095 + 4096 = 12286, so the
+    partial pass hands _resolve_carries limbs up to 4095 + 2 = 4097 — just
+    inside _ks_resolve's stated [0, 2*2^12 - 2] precondition.
+    """
+    s = _partial_pass(a - b + jnp.asarray(_2P_SPREAD))
+    return _cond_sub_p(_cond_sub_p(_resolve_carries(s)))
 
 
 def neg(a):
     """(-a) mod p. Maps 0 -> 0 (p - 0 = p reduces to 0 via cond-subtract)."""
-    return _cond_sub_p(_normalize(jnp.asarray(P_LIMBS) - a, NLIMBS))
+    zero = jnp.zeros_like(a)
+    return sub(zero, a)
 
 
 def scalar_small(a, k: int):
-    """a * k for a small static non-negative int k (k * 4095 * 32 < 2^31)."""
-    return _cond_n_sub(_normalize(a * k, NLIMBS + 1), k)
-
-
-def _cond_n_sub(x, k: int):
-    """Reduce x in [0, (k)*p) to [0, p) by repeated conditional subtraction.
-
-    x has NLIMBS+1 limbs; k is a small static bound (<= 8 in practice).
-    """
-    p_ext = jnp.pad(jnp.asarray(P_LIMBS), (0, 1))
+    """a * k mod p for a small static non-negative int k (k <= 8 used)."""
+    if k == 0:
+        return jnp.zeros_like(a)
+    x = _normalize(a * k, NLIMBS + 1)  # value < 8p < 2^384 * ... fits
+    # reduce [0, k*p) -> [0, p) by repeated conditional subtraction
     for _ in range(max(1, k - 1)):
-        d = _signed_sub(x, p_ext)
-        x = jnp.where(_is_negative(d)[..., None], x, _normalize_signed(d))
+        s, ge = _add_complement(x, _NEG_P[NLIMBS + 1])
+        x = jnp.where(ge[..., None], s, x)
     return x[..., :NLIMBS]
-
-
-def _signed_sub(a, b):
-    return a - b
-
-
-def _is_negative(d):
-    """True iff the integer represented by (possibly non-canonical) limb
-    vector d is negative. Requires limbs in (-2^13, 2^13)."""
-    ds = jnp.moveaxis(d, -1, 0)
-
-    def step(borrow, v):
-        t = v + borrow
-        return t >> LIMB_BITS, t & LIMB_MASK
-
-    borrow, _ = jax.lax.scan(step, jnp.zeros(d.shape[:-1], jnp.int32), ds)
-    return borrow < 0
-
-
-def _normalize_signed(d):
-    """Canonicalize a limb vector known to represent a non-negative value."""
-    return _normalize(d, d.shape[-1])
 
 
 def mont_mul(a, b):
@@ -191,9 +260,12 @@ def mont_mul(a, b):
     Full-word REDC:  T = a*b;  m = (T mod R) * N' mod R;  out = (T + m*P)/R.
     """
     t = _normalize(_conv(a, b), 2 * NLIMBS)
-    m = _normalize(_conv(t[..., :NLIMBS], jnp.asarray(NPRIME_LIMBS)), 2 * NLIMBS)[
-        ..., :NLIMBS
-    ]
+    m_raw = jnp.einsum(
+        "...ij,ijk->...k",
+        t[..., :NLIMBS, None] * jnp.asarray(NPRIME_LIMBS)[..., None, :],
+        jnp.asarray(_CONV_MASK_LOW),
+    )
+    m = _normalize(m_raw, NLIMBS + 1)[..., :NLIMBS]
     mp = _conv(m, jnp.asarray(P_LIMBS))
     # T + m*P is divisible by R = 2^384; its high half is the candidate
     # result. Sum limbwise (values < 2^30), normalize across all 2N limbs so
@@ -207,6 +279,11 @@ def mont_mul(a, b):
 
 def mont_sqr(a):
     return mont_mul(a, a)
+
+
+# Uniform field-module interface (shared with ops.fp2) for generic curve code.
+mul = mont_mul
+sqr = mont_sqr
 
 
 def to_mont(a):

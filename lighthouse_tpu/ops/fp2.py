@@ -1,0 +1,125 @@
+"""Batched BLS12-381 quadratic-extension (Fp2) arithmetic on device limbs.
+
+Fp2 = Fp[u]/(u^2 + 1). An element is a 2-tuple `(c0, c1)` of `(..., NLIMBS)`
+int32 limb arrays (see `lighthouse_tpu.ops.fp`), giving c0 + c1*u. Tuples are
+JAX pytrees, so Fp2 values flow through jit/vmap/scan unchanged.
+
+Multiplicative ops assume the Montgomery domain (as all device field values
+on the hot path are); additive ops are domain-agnostic.
+
+Parity note: fills the role of blst's fp2 arithmetic behind the reference
+client's BLS boundary (reference crypto/bls/src/impls/blst.rs); validated
+against `lighthouse_tpu.crypto.ref_fields` (fp2_*).
+"""
+
+import jax.numpy as jnp
+
+from lighthouse_tpu.ops import fp
+
+ZERO = (fp.ZERO, fp.ZERO)
+ONE_MONT = (fp.ONE_MONT, fp.ZERO)
+
+
+def pack(values):
+    """Host: iterable of (c0, c1) int tuples -> Fp2 batch (canonical form)."""
+    return (
+        fp.pack([v[0] for v in values]),
+        fp.pack([v[1] for v in values]),
+    )
+
+
+def to_ints(a):
+    """Host: Fp2 batch -> list of (c0, c1) int tuples."""
+    c0, c1 = a
+    import numpy as np
+
+    c0 = np.asarray(c0).reshape(-1, c0.shape[-1])
+    c1 = np.asarray(c1).reshape(-1, c1.shape[-1])
+    return [(fp.to_int(x), fp.to_int(y)) for x, y in zip(c0, c1)]
+
+
+def to_mont(a):
+    return (fp.to_mont(a[0]), fp.to_mont(a[1]))
+
+
+def from_mont(a):
+    return (fp.from_mont(a[0]), fp.from_mont(a[1]))
+
+
+def add(a, b):
+    return (fp.add(a[0], b[0]), fp.add(a[1], b[1]))
+
+
+def sub(a, b):
+    return (fp.sub(a[0], b[0]), fp.sub(a[1], b[1]))
+
+
+def neg(a):
+    return (fp.neg(a[0]), fp.neg(a[1]))
+
+
+def conj(a):
+    return (a[0], fp.neg(a[1]))
+
+
+def scalar_small(a, k: int):
+    return (fp.scalar_small(a[0], k), fp.scalar_small(a[1], k))
+
+
+def mul(a, b):
+    """Karatsuba: 3 base-field Montgomery products."""
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp.mont_mul(a0, b0)
+    t1 = fp.mont_mul(a1, b1)
+    cross = fp.mont_mul(fp.add(a0, a1), fp.add(b0, b1))
+    return (fp.sub(t0, t1), fp.sub(fp.sub(cross, t0), t1))
+
+
+def sqr(a):
+    """(a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u — 2 products."""
+    a0, a1 = a
+    c0 = fp.mont_mul(fp.add(a0, a1), fp.sub(a0, a1))
+    t = fp.mont_mul(a0, a1)
+    return (c0, fp.add(t, t))
+
+
+def mul_fp(a, s):
+    """Multiply Fp2 element by an Fp element (both Montgomery)."""
+    return (fp.mont_mul(a[0], s), fp.mont_mul(a[1], s))
+
+
+def mul_by_xi(a):
+    """Multiply by xi = 1 + u: (c0 - c1) + (c0 + c1) u."""
+    return (fp.sub(a[0], a[1]), fp.add(a[0], a[1]))
+
+
+def inv(a):
+    """1 / (a0 + a1 u) = (a0 - a1 u) / (a0^2 + a1^2). inv(0) = 0."""
+    a0, a1 = a
+    norm = fp.add(fp.mont_mul(a0, a0), fp.mont_mul(a1, a1))
+    ninv = fp.inv(norm)
+    return (fp.mont_mul(a0, ninv), fp.neg(fp.mont_mul(a1, ninv)))
+
+
+def is_zero(a):
+    return fp.is_zero(a[0]) & fp.is_zero(a[1])
+
+
+def eq(a, b):
+    return fp.eq(a[0], b[0]) & fp.eq(a[1], b[1])
+
+
+def select(cond, a, b):
+    """Branchless select; cond broadcasts over the limb axis."""
+    return (fp.select(cond, a[0], b[0]), fp.select(cond, a[1], b[1]))
+
+
+def broadcast_const(const_limbs, shape_like):
+    """Broadcast a static (2, NLIMBS)-style tuple constant over batch dims of
+    `shape_like` (an Fp limb array)."""
+    batch = shape_like.shape[:-1]
+    return tuple(
+        jnp.broadcast_to(jnp.asarray(c), batch + (c.shape[-1],))
+        for c in const_limbs
+    )

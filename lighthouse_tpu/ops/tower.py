@@ -1,0 +1,250 @@
+"""Batched Fp6/Fp12 tower arithmetic on device limbs.
+
+Tower (same as the reference math, see `lighthouse_tpu.crypto.ref_fields`):
+    Fp6  = Fp2[v]/(v^3 - xi),  xi = 1 + u
+    Fp12 = Fp6[w]/(w^2 - v)
+
+Representations (all JAX pytrees):
+    Fp6  : 3-tuple of Fp2
+    Fp12 : 2-tuple of Fp6
+
+All multiplicative ops operate in the Montgomery domain. Validated against
+`ref_fields.fp6_*` / `fp12_*`.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import FROB_GAMMA, NLIMBS, P, int_to_limbs
+from lighthouse_tpu.ops import fp, fp2
+
+# ------------------------------------------------------------------ constants
+
+
+def _mont_fp2(v) -> tuple:
+    """Static (c0, c1) int tuple -> Montgomery-form Fp2 limb constant."""
+    return (
+        np.array(int_to_limbs((v[0] << 384) % P), dtype=np.int32),
+        np.array(int_to_limbs((v[1] << 384) % P), dtype=np.int32),
+    )
+
+
+FROB_GAMMA_MONT = [_mont_fp2(g) for g in FROB_GAMMA]
+
+FP6_ZERO = (fp2.ZERO, fp2.ZERO, fp2.ZERO)
+FP6_ONE = (fp2.ONE_MONT, fp2.ZERO, fp2.ZERO)
+FP12_ZERO = (FP6_ZERO, FP6_ZERO)
+FP12_ONE = (FP6_ONE, FP6_ZERO)
+
+
+# ---------------------------------------------------------------------- Fp6
+
+
+def fp6_add(a, b):
+    return tuple(fp2.add(x, y) for x, y in zip(a, b))
+
+
+def fp6_sub(a, b):
+    return tuple(fp2.sub(x, y) for x, y in zip(a, b))
+
+
+def fp6_neg(a):
+    return tuple(fp2.neg(x) for x in a)
+
+
+def fp6_mul(a, b):
+    """Toom/Karatsuba-style 6-multiplication schedule."""
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fp2.mul(a0, b0)
+    t1 = fp2.mul(a1, b1)
+    t2 = fp2.mul(a2, b2)
+    c0 = fp2.add(
+        t0,
+        fp2.mul_by_xi(
+            fp2.sub(
+                fp2.sub(fp2.mul(fp2.add(a1, a2), fp2.add(b1, b2)), t1), t2
+            )
+        ),
+    )
+    c1 = fp2.add(
+        fp2.sub(
+            fp2.sub(fp2.mul(fp2.add(a0, a1), fp2.add(b0, b1)), t0), t1
+        ),
+        fp2.mul_by_xi(t2),
+    )
+    c2 = fp2.add(
+        fp2.sub(fp2.sub(fp2.mul(fp2.add(a0, a2), fp2.add(b0, b2)), t0), t2),
+        t1,
+    )
+    return (c0, c1, c2)
+
+
+def fp6_sqr(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):
+    return (fp2.mul_by_xi(a[2]), a[0], a[1])
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a
+    c0 = fp2.sub(fp2.sqr(a0), fp2.mul_by_xi(fp2.mul(a1, a2)))
+    c1 = fp2.sub(fp2.mul_by_xi(fp2.sqr(a2)), fp2.mul(a0, a1))
+    c2 = fp2.sub(fp2.sqr(a1), fp2.mul(a0, a2))
+    norm = fp2.add(
+        fp2.mul(a0, c0),
+        fp2.mul_by_xi(fp2.add(fp2.mul(a2, c1), fp2.mul(a1, c2))),
+    )
+    ninv = fp2.inv(norm)
+    return (fp2.mul(c0, ninv), fp2.mul(c1, ninv), fp2.mul(c2, ninv))
+
+
+def fp6_select(cond, a, b):
+    return tuple(fp2.select(cond, x, y) for x, y in zip(a, b))
+
+
+# --------------------------------------------------------------------- Fp12
+
+
+def fp12_add(a, b):
+    return (fp6_add(a[0], b[0]), fp6_add(a[1], b[1]))
+
+
+def fp12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(
+        fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1
+    )
+    return (c0, c1)
+
+
+def fp12_sqr(a):
+    return fp12_mul(a, a)
+
+
+def fp12_conj(a):
+    return (a[0], fp6_neg(a[1]))
+
+
+def fp12_inv(a):
+    a0, a1 = a
+    norm = fp6_sub(fp6_sqr(a0), fp6_mul_by_v(fp6_sqr(a1)))
+    ninv = fp6_inv(norm)
+    return (fp6_mul(a0, ninv), fp6_neg(fp6_mul(a1, ninv)))
+
+
+def _gamma_like(i, ref):
+    """Broadcast Frobenius constant i over ref's batch shape (ref: Fp limbs)."""
+    return fp2.broadcast_const(FROB_GAMMA_MONT[i], ref)
+
+
+def fp12_frobenius(a):
+    """a^p: conjugate every Fp2 coefficient, scale by gamma powers."""
+    (a00, a01, a02), (a10, a11, a12) = a
+    ref = a00[0]
+    c0 = (
+        fp2.conj(a00),
+        fp2.mul(fp2.conj(a01), _gamma_like(2, ref)),
+        fp2.mul(fp2.conj(a02), _gamma_like(4, ref)),
+    )
+    c1 = (
+        fp2.mul(fp2.conj(a10), _gamma_like(1, ref)),
+        fp2.mul(fp2.conj(a11), _gamma_like(3, ref)),
+        fp2.mul(fp2.conj(a12), _gamma_like(5, ref)),
+    )
+    return (c0, c1)
+
+
+def fp12_select(cond, a, b):
+    return (fp6_select(cond, a[0], b[0]), fp6_select(cond, a[1], b[1]))
+
+
+def fp12_eq(a, b):
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    acc = None
+    for x, y in zip(leaves_a, leaves_b):
+        e = jnp.all(x == y, axis=-1)
+        acc = e if acc is None else (acc & e)
+    return acc
+
+
+def fp12_is_one(a):
+    """Batched check a == 1 (Montgomery domain)."""
+    one = fp12_broadcast_one(a)
+    return fp12_eq(a, one)
+
+
+def fp12_broadcast_one(like):
+    ref = jax.tree_util.tree_leaves(like)[0]
+    batch = ref.shape[:-1]
+
+    def bc(c):
+        return jnp.broadcast_to(jnp.asarray(c), batch + (NLIMBS,))
+
+    return jax.tree_util.tree_map(bc, FP12_ONE)
+
+
+def fp12_product_axis(a, axis: int = 0):
+    """Tree-fold product of a batch of Fp12 values along `axis` — the
+    reduction that merges per-pair Miller-loop outputs before one shared
+    final exponentiation (reference semantics: one multi-pairing per batch,
+    crypto/bls/src/impls/blst.rs verify_multiple_aggregate_signatures)."""
+    n = jax.tree_util.tree_leaves(a)[0].shape[axis]
+    while n > 1:
+        half = n // 2
+        x = jax.tree_util.tree_map(
+            lambda t: jax.lax.slice_in_dim(t, 0, half, axis=axis), a
+        )
+        y = jax.tree_util.tree_map(
+            lambda t: jax.lax.slice_in_dim(t, half, 2 * half, axis=axis), a
+        )
+        prod = fp12_mul(x, y)
+        if n % 2:
+            tail = jax.tree_util.tree_map(
+                lambda t: jax.lax.slice_in_dim(t, n - 1, n, axis=axis), a
+            )
+            prod = jax.tree_util.tree_map(
+                lambda p, t: jnp.concatenate([p, t], axis=axis), prod, tail
+            )
+        a = prod
+        n = half + (n % 2)
+    return jax.tree_util.tree_map(lambda t: jnp.squeeze(t, axis=axis), a)
+
+
+# ------------------------------------------------------------- host helpers
+
+
+def fp12_pack(vals):
+    """Host: list of ref-format Fp12 values -> device batch (Montgomery)."""
+
+    def gather(path_fn):
+        return fp2.to_mont(fp2.pack([path_fn(v) for v in vals]))
+
+    c0 = tuple(gather(lambda v, i=i: v[0][i]) for i in range(3))
+    c1 = tuple(gather(lambda v, i=i: v[1][i]) for i in range(3))
+    return (c0, c1)
+
+
+def fp12_unpack(a):
+    """Host: device Fp12 batch -> list of ref-format values."""
+    c0 = [fp2.to_ints(fp2.from_mont(c)) for c in a[0]]
+    c1 = [fp2.to_ints(fp2.from_mont(c)) for c in a[1]]
+    n = len(c0[0])
+    out = []
+    for i in range(n):
+        out.append(
+            (
+                (c0[0][i], c0[1][i], c0[2][i]),
+                (c1[0][i], c1[1][i], c1[2][i]),
+            )
+        )
+    return out

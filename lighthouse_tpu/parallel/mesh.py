@@ -1,0 +1,44 @@
+"""Device-mesh construction for the crypto data plane.
+
+The reference client's only data parallelism is rayon chunking over CPU
+cores (consensus/state_processing/.../block_signature_verifier.rs:367-375).
+The TPU-native equivalent is a 2-D `jax.sharding.Mesh`:
+
+  axis "sets": data-parallel over signature sets (the rayon-chunk analog;
+               collectives: all_gather of per-shard Fp12 Miller products).
+  axis "keys": model-parallel over the padded per-set pubkey axis (the MSM
+               partial-sum reduction; collectives: all_gather + point-fold
+               over ICI).
+
+Multi-host later rides the same mesh (DCN for "sets", ICI for "keys").
+"""
+
+import math
+
+import jax
+from jax.sharding import Mesh
+import numpy as np
+
+
+def make_mesh(n_sets: int | None = None, n_keys: int = 1, devices=None) -> Mesh:
+    """Build a ("sets", "keys") mesh over the given (or all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n_sets is None:
+        n_sets = n // n_keys
+    if n_sets * n_keys != n:
+        raise ValueError(
+            f"mesh {n_sets}x{n_keys} != {n} devices"
+        )
+    arr = np.asarray(devices).reshape(n_sets, n_keys)
+    return Mesh(arr, axis_names=("sets", "keys"))
+
+
+def default_split(n: int) -> tuple[int, int]:
+    """Factor n devices into (sets, keys): keys = largest power of two that
+    divides n and is <= sqrt(n); data parallelism gets the rest."""
+    keys = 1
+    while n % (keys * 2) == 0 and (keys * 2) ** 2 <= n:
+        keys *= 2
+    return n // keys, keys

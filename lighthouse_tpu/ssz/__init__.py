@@ -1,0 +1,44 @@
+"""SimpleSerialize (SSZ) codec + Merkleization.
+
+Covers the capability surface of the reference's in-house SSZ stack —
+consensus/ssz (Encode/Decode), consensus/ssz_types (typed fixed/variable
+lists, bitfields), consensus/ssz_derive (derive macros -> here, a Container
+base class with declarative field specs), consensus/tree_hash (hash_tree_root
+merkleization with zero-subtree cache) — re-designed as Python type
+descriptors rather than a trait system.
+
+Wire format per the SSZ spec: little-endian basics, 4-byte offsets for
+variable-size parts, bitlists with a delimiting bit, lists merkleized to
+their capacity limit with the length mixed in.
+"""
+
+from lighthouse_tpu.ssz.codec import (  # noqa: F401
+    Bitlist,
+    Bitvector,
+    Boolean,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Union,
+    Vector,
+    boolean,
+    byte,
+    bytes4,
+    bytes32,
+    bytes48,
+    bytes96,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+)
+from lighthouse_tpu.ssz.hashing import hash32, zero_hash  # noqa: F401
+from lighthouse_tpu.ssz.merkle import (  # noqa: F401
+    merkle_proof,
+    merkleize_chunks,
+    mix_in_length,
+    verify_merkle_proof,
+)

@@ -1,0 +1,11 @@
+from lighthouse_tpu.state_processing.per_slot import (  # noqa: F401
+    per_slot_processing,
+    process_slots,
+)
+from lighthouse_tpu.state_processing.per_block import (  # noqa: F401
+    BlockSignatureStrategy,
+    per_block_processing,
+)
+from lighthouse_tpu.state_processing.genesis import (  # noqa: F401
+    interop_genesis_state,
+)

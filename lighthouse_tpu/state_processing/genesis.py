@@ -1,0 +1,96 @@
+"""Genesis state construction (interop path + deposit path scaffolding).
+
+Role of the reference's genesis bootstrapping: `interop_genesis_state`
+(beacon_node/genesis + beacon_chain test_utils.rs:47 deterministic keypair
+genesis) — a state built directly from a pubkey list, skipping deposit
+proofs, used by the in-process harness and simulators.
+"""
+
+from lighthouse_tpu.ssz.hashing import ZERO_BYTES32
+from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH, GENESIS_EPOCH, Spec
+from lighthouse_tpu.types.containers import types_for
+
+
+def genesis_fork(spec: Spec, t):
+    """Fork container for the genesis epoch, honoring fork-at-genesis specs
+    (e.g. altair-from-genesis test configs)."""
+    name = spec.fork_name_at_epoch(GENESIS_EPOCH)
+    version = spec.fork_version_at_epoch(GENESIS_EPOCH)
+    return t.Fork(
+        previous_version=version, current_version=version, epoch=GENESIS_EPOCH
+    ), name
+
+
+def interop_genesis_state(
+    pubkeys,
+    genesis_time: int,
+    spec: Spec,
+    eth1_block_hash: bytes = b"\x42" * 32,
+):
+    """Build a fully-valid genesis BeaconState from interop pubkeys.
+
+    All validators are active from genesis with MAX_EFFECTIVE_BALANCE.
+    """
+    t = types_for(spec)
+    fork, fork_name = genesis_fork(spec, t)
+    state_cls = t.state_classes[fork_name]
+
+    validators = []
+    for pk in pubkeys:
+        validators.append(
+            t.Validator(
+                pubkey=bytes(pk),
+                withdrawal_credentials=b"\x00" * 32,
+                effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+                slashed=False,
+                activation_eligibility_epoch=GENESIS_EPOCH,
+                activation_epoch=GENESIS_EPOCH,
+                exit_epoch=FAR_FUTURE_EPOCH,
+                withdrawable_epoch=FAR_FUTURE_EPOCH,
+            )
+        )
+
+    body_cls = t.block_body_classes[fork_name]
+    header = t.BeaconBlockHeader(
+        slot=0,
+        proposer_index=0,
+        parent_root=ZERO_BYTES32,
+        state_root=ZERO_BYTES32,
+        body_root=body_cls.hash_tree_root(body_cls()),
+    )
+
+    state = state_cls(
+        genesis_time=genesis_time,
+        slot=0,
+        fork=fork,
+        latest_block_header=header,
+        eth1_data=t.Eth1Data(
+            deposit_root=ZERO_BYTES32,
+            deposit_count=len(validators),
+            block_hash=eth1_block_hash,
+        ),
+        eth1_deposit_index=len(validators),
+        validators=validators,
+        balances=[spec.MAX_EFFECTIVE_BALANCE] * len(validators),
+        randao_mixes=[eth1_block_hash] * spec.EPOCHS_PER_HISTORICAL_VECTOR,
+    )
+
+    from lighthouse_tpu import ssz
+
+    validators_type = ssz.List(t.Validator, spec.VALIDATOR_REGISTRY_LIMIT)
+    state.genesis_validators_root = validators_type.hash_tree_root(
+        state.validators
+    )
+
+    if fork_name == "altair":
+        n = len(validators)
+        state.previous_epoch_participation = [0] * n
+        state.current_epoch_participation = [0] * n
+        state.inactivity_scores = [0] * n
+        from lighthouse_tpu.state_processing.sync_committees import (
+            get_next_sync_committee,
+        )
+
+        state.current_sync_committee = get_next_sync_committee(state, spec)
+        state.next_sync_committee = get_next_sync_committee(state, spec)
+    return state

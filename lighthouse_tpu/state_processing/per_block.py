@@ -1,0 +1,592 @@
+"""Full per-block state transition (phase0 + altair).
+
+Role of consensus/state_processing/src/per_block_processing.rs (+
+process_operations.rs, altair/sync_committee.rs): header/randao/eth1
+processing, the five operation types, and the altair sync aggregate — with
+the same `BlockSignatureStrategy` surface (per_block_processing.rs:44):
+NoVerification / VerifyIndividual / VerifyBulk. VerifyBulk collects every
+signature set in the block and issues ONE `bls.verify_signature_sets`
+batch, which on the tpu backend is one device multi-pairing — the
+`BlockSignatureVerifier::verify_entire_block` analog
+(block_signature_verifier.rs:120-131).
+"""
+
+from enum import Enum
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.ssz.hashing import ZERO_BYTES32, hash32
+from lighthouse_tpu.ssz.merkle import verify_merkle_proof
+from lighthouse_tpu.state_processing import signature_sets as sigsets
+from lighthouse_tpu.state_processing.helpers import (
+    CommitteeCache,
+    decrease_balance,
+    get_attesting_indices,
+    get_beacon_proposer_index,
+    get_block_root,
+    get_block_root_at_slot,
+    get_current_epoch,
+    get_previous_epoch,
+    get_randao_mix,
+    get_total_active_balance,
+    increase_balance,
+    initiate_validator_exit,
+    integer_squareroot,
+    is_active_validator,
+    is_slashable_attestation_data,
+    is_slashable_validator,
+    slash_validator,
+)
+from lighthouse_tpu.types.spec import (
+    FAR_FUTURE_EPOCH,
+    PARTICIPATION_FLAG_WEIGHTS,
+    PROPOSER_WEIGHT,
+    SYNC_REWARD_WEIGHT,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    Spec,
+)
+
+
+class BlockProcessingError(Exception):
+    pass
+
+
+class BlockSignatureStrategy(Enum):
+    NO_VERIFICATION = "no_verification"
+    VERIFY_INDIVIDUAL = "verify_individual"
+    VERIFY_BULK = "verify_bulk"
+
+
+class SignatureCollector:
+    """Accumulates signature sets per the strategy; `finish` runs the batch
+    (or nothing). Individual mode verifies eagerly so errors surface at the
+    offending operation, exactly like the reference's VerifyIndividual."""
+
+    def __init__(self, strategy, backend=None, seed=None):
+        self.strategy = strategy
+        self.backend = backend
+        self.seed = seed
+        self.sets = []
+
+    def add(self, make_set):
+        """`make_set` is a zero-arg callable returning a SignatureSet (or
+        None). Construction — including signature byte parsing — is skipped
+        entirely under NO_VERIFICATION."""
+        if self.strategy == BlockSignatureStrategy.NO_VERIFICATION:
+            return
+        try:
+            sset = make_set()
+        except ValueError as e:  # undecodable signature/pubkey bytes
+            raise BlockProcessingError(f"malformed signature: {e}") from e
+        if sset is None:
+            return
+        if self.strategy == BlockSignatureStrategy.VERIFY_INDIVIDUAL:
+            if not bls.verify_signature_sets([sset], backend=self.backend):
+                raise BlockProcessingError("invalid signature")
+        else:
+            self.sets.append(sset)
+
+    def add_many(self, make_sets):
+        if self.strategy == BlockSignatureStrategy.NO_VERIFICATION:
+            return
+        for s in make_sets():
+            self.add(lambda s=s: s)
+
+    def finish(self):
+        if (
+            self.strategy == BlockSignatureStrategy.VERIFY_BULK
+            and self.sets
+        ):
+            if not bls.verify_signature_sets(
+                self.sets, backend=self.backend, seed=self.seed
+            ):
+                raise BlockProcessingError("bulk signature verification failed")
+
+
+class VerifyBlockRoot(Enum):
+    TRUE = True
+    FALSE = False
+
+
+def per_block_processing(
+    state,
+    signed_block,
+    spec: Spec,
+    strategy: BlockSignatureStrategy,
+    pubkey_cache,
+    verify_proposal: bool = True,
+    committee_cache: CommitteeCache | None = None,
+    backend: str | None = None,
+    seed: int | None = None,
+):
+    """Apply `signed_block` to `state` (which must already be advanced to
+    the block's slot via process_slots). Mutates state in place."""
+    block = signed_block.message
+    fork = spec.fork_name_at_epoch(get_current_epoch(state, spec))
+    pubkey_cache.import_new(state)
+    collector = SignatureCollector(strategy, backend=backend, seed=seed)
+    pk = pubkey_cache.get
+
+    if committee_cache is None or committee_cache.epoch != get_current_epoch(
+        state, spec
+    ):
+        committee_cache = CommitteeCache(
+            state, get_current_epoch(state, spec), spec
+        )
+
+    if verify_proposal:
+        collector.add(
+            lambda: sigsets.block_proposal_set(state, signed_block, pk, spec)
+        )
+
+    process_block_header(state, block, spec)
+    process_randao(state, block, pk, spec, collector)
+    process_eth1_data(state, block.body, spec)
+    process_operations(
+        state, block.body, spec, fork, pk, collector, committee_cache,
+        pubkey_cache,
+    )
+    if fork != "phase0":
+        process_sync_aggregate(
+            state, block.body.sync_aggregate, pubkey_cache, spec, collector
+        )
+
+    collector.finish()
+    return state
+
+
+# ----------------------------------------------------------------- header
+
+
+def process_block_header(state, block, spec: Spec):
+    if block.slot != state.slot:
+        raise BlockProcessingError("block slot mismatch")
+    if block.slot <= state.latest_block_header.slot:
+        raise BlockProcessingError("block older than latest header")
+    expected_proposer = get_beacon_proposer_index(state, spec)
+    if block.proposer_index != expected_proposer:
+        raise BlockProcessingError("wrong proposer index")
+    header_cls = type(state.latest_block_header)
+    parent_root = header_cls.hash_tree_root(state.latest_block_header)
+    if bytes(block.parent_root) != parent_root:
+        raise BlockProcessingError("parent root mismatch")
+    body_cls = type(block.body)
+    state.latest_block_header = header_cls(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=ZERO_BYTES32,
+        body_root=body_cls.hash_tree_root(block.body),
+    )
+    proposer = state.validators[block.proposer_index]
+    if proposer.slashed:
+        raise BlockProcessingError("proposer is slashed")
+
+
+# ----------------------------------------------------------------- randao
+
+
+def process_randao(state, block, pubkey_for, spec: Spec, collector):
+    epoch = get_current_epoch(state, spec)
+    collector.add(lambda: sigsets.randao_set(state, block, pubkey_for, spec))
+    mix = bytes(
+        a ^ b
+        for a, b in zip(
+            get_randao_mix(state, epoch, spec),
+            hash32(bytes(block.body.randao_reveal)),
+        )
+    )
+    state.randao_mixes[epoch % spec.EPOCHS_PER_HISTORICAL_VECTOR] = mix
+
+
+# ------------------------------------------------------------------- eth1
+
+
+def process_eth1_data(state, body, spec: Spec):
+    state.eth1_data_votes.append(body.eth1_data)
+    period_slots = spec.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.SLOTS_PER_EPOCH
+    votes = sum(1 for v in state.eth1_data_votes if v == body.eth1_data)
+    if votes * 2 > period_slots:
+        state.eth1_data = body.eth1_data
+
+
+# -------------------------------------------------------------- operations
+
+
+def process_operations(
+    state, body, spec, fork, pubkey_for, collector, committee_cache,
+    pubkey_cache,
+):
+    expected_deposits = min(
+        spec.MAX_DEPOSITS,
+        state.eth1_data.deposit_count - state.eth1_deposit_index,
+    )
+    if len(body.deposits) != expected_deposits:
+        raise BlockProcessingError("wrong deposit count")
+
+    for ps in body.proposer_slashings:
+        process_proposer_slashing(
+            state, ps, spec, fork, pubkey_for, collector
+        )
+    for aslash in body.attester_slashings:
+        process_attester_slashing(
+            state, aslash, spec, fork, pubkey_for, collector
+        )
+    for att in body.attestations:
+        process_attestation(
+            state, att, spec, fork, pubkey_for, collector, committee_cache
+        )
+    for dep in body.deposits:
+        process_deposit(state, dep, spec, fork, pubkey_cache)
+    for exit_ in body.voluntary_exits:
+        process_voluntary_exit(state, exit_, spec, pubkey_for, collector)
+
+
+def process_proposer_slashing(
+    state, slashing, spec, fork, pubkey_for, collector
+):
+    h1 = slashing.signed_header_1.message
+    h2 = slashing.signed_header_2.message
+    if h1.slot != h2.slot:
+        raise BlockProcessingError("proposer slashing: slot mismatch")
+    if h1.proposer_index != h2.proposer_index:
+        raise BlockProcessingError("proposer slashing: proposer mismatch")
+    if h1 == h2:
+        raise BlockProcessingError("proposer slashing: identical headers")
+    proposer = state.validators[h1.proposer_index]
+    if not is_slashable_validator(proposer, get_current_epoch(state, spec)):
+        raise BlockProcessingError("proposer slashing: not slashable")
+    collector.add_many(
+        lambda: sigsets.proposer_slashing_sets(state, slashing, pubkey_for, spec)
+    )
+    slash_validator(state, h1.proposer_index, spec, fork)
+
+
+def _check_indexed_attestation(
+    state, indexed, spec, pubkey_for, collector
+):
+    indices = list(indexed.attesting_indices)
+    if not indices:
+        raise BlockProcessingError("indexed attestation: empty")
+    if indices != sorted(set(indices)):
+        raise BlockProcessingError("indexed attestation: not sorted/unique")
+    collector.add(
+        lambda: sigsets.indexed_attestation_set(state, indexed, pubkey_for, spec)
+    )
+
+
+def process_attester_slashing(
+    state, slashing, spec, fork, pubkey_for, collector
+):
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    if not is_slashable_attestation_data(a1.data, a2.data):
+        raise BlockProcessingError("attester slashing: not slashable data")
+    _check_indexed_attestation(state, a1, spec, pubkey_for, collector)
+    _check_indexed_attestation(state, a2, spec, pubkey_for, collector)
+    slashed_any = False
+    current = get_current_epoch(state, spec)
+    common = sorted(
+        set(a1.attesting_indices) & set(a2.attesting_indices)
+    )
+    for idx in common:
+        if is_slashable_validator(state.validators[idx], current):
+            slash_validator(state, idx, spec, fork)
+            slashed_any = True
+    if not slashed_any:
+        raise BlockProcessingError("attester slashing: nobody slashed")
+
+
+def _validate_attestation_common(
+    state, att, spec, committee_cache
+):
+    data = att.data
+    current = get_current_epoch(state, spec)
+    previous = get_previous_epoch(state, spec)
+    if data.target.epoch not in (previous, current):
+        raise BlockProcessingError("attestation: bad target epoch")
+    if data.target.epoch != spec.slot_to_epoch(data.slot):
+        raise BlockProcessingError("attestation: target/slot mismatch")
+    if not (
+        data.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY
+        <= state.slot
+        <= data.slot + spec.SLOTS_PER_EPOCH
+    ):
+        raise BlockProcessingError("attestation: inclusion window")
+    epoch_cache = committee_cache
+    if epoch_cache.epoch != data.target.epoch:
+        epoch_cache = CommitteeCache(state, data.target.epoch, spec)
+    if data.index >= epoch_cache.committees_per_slot:
+        raise BlockProcessingError("attestation: bad committee index")
+    committee = epoch_cache.get_beacon_committee(data.slot, data.index)
+    if len(att.aggregation_bits) != len(committee):
+        raise BlockProcessingError("attestation: bits length mismatch")
+    return committee
+
+
+def _indexed_from_attestation(state, att, committee, spec):
+    from lighthouse_tpu.types.containers import types_for
+
+    t = types_for(spec)
+    return t.IndexedAttestation(
+        attesting_indices=get_attesting_indices(
+            committee, att.aggregation_bits
+        ),
+        data=att.data,
+        signature=att.signature,
+    )
+
+
+def process_attestation(
+    state, att, spec, fork, pubkey_for, collector, committee_cache
+):
+    committee = _validate_attestation_common(
+        state, att, spec, committee_cache
+    )
+    indexed = _indexed_from_attestation(state, att, committee, spec)
+    _check_indexed_attestation(state, indexed, spec, pubkey_for, collector)
+
+    if fork == "phase0":
+        _apply_attestation_phase0(state, att, spec)
+    else:
+        _apply_attestation_altair(state, att, indexed, spec)
+
+
+def _apply_attestation_phase0(state, att, spec):
+    from lighthouse_tpu.types.containers import types_for
+
+    t = types_for(spec)
+    data = att.data
+    pending = t.PendingAttestation(
+        aggregation_bits=list(att.aggregation_bits),
+        data=data,
+        inclusion_delay=state.slot - data.slot,
+        proposer_index=get_beacon_proposer_index(state, spec),
+    )
+    if data.target.epoch == get_current_epoch(state, spec):
+        if data.source != state.current_justified_checkpoint:
+            raise BlockProcessingError("attestation: wrong source (current)")
+        state.current_epoch_attestations.append(pending)
+    else:
+        if data.source != state.previous_justified_checkpoint:
+            raise BlockProcessingError("attestation: wrong source (previous)")
+        state.previous_epoch_attestations.append(pending)
+
+
+def get_attestation_participation_flags(
+    state, data, inclusion_delay, spec
+):
+    """Altair: which timeliness flags does this attestation earn."""
+    current = get_current_epoch(state, spec)
+    if data.target.epoch == current:
+        justified = state.current_justified_checkpoint
+    else:
+        justified = state.previous_justified_checkpoint
+    is_matching_source = data.source == justified
+    if not is_matching_source:
+        raise BlockProcessingError("attestation: source mismatch")
+    is_matching_target = is_matching_source and bytes(
+        data.target.root
+    ) == bytes(get_block_root(state, data.target.epoch, spec))
+    is_matching_head = is_matching_target and bytes(
+        data.beacon_block_root
+    ) == bytes(get_block_root_at_slot(state, data.slot, spec))
+
+    flags = []
+    if is_matching_source and inclusion_delay <= integer_squareroot(
+        spec.SLOTS_PER_EPOCH
+    ):
+        flags.append(TIMELY_SOURCE_FLAG_INDEX)
+    if is_matching_target and inclusion_delay <= spec.SLOTS_PER_EPOCH:
+        flags.append(TIMELY_TARGET_FLAG_INDEX)
+    if (
+        is_matching_head
+        and inclusion_delay == spec.MIN_ATTESTATION_INCLUSION_DELAY
+    ):
+        flags.append(TIMELY_HEAD_FLAG_INDEX)
+    return flags
+
+
+def get_base_reward_per_increment(state, spec) -> int:
+    return (
+        spec.EFFECTIVE_BALANCE_INCREMENT
+        * spec.BASE_REWARD_FACTOR
+        // integer_squareroot(get_total_active_balance(state, spec))
+    )
+
+
+def get_base_reward_altair(state, index, spec) -> int:
+    increments = (
+        state.validators[index].effective_balance
+        // spec.EFFECTIVE_BALANCE_INCREMENT
+    )
+    return increments * get_base_reward_per_increment(state, spec)
+
+
+def _apply_attestation_altair(state, att, indexed, spec):
+    data = att.data
+    inclusion_delay = state.slot - data.slot
+    flags = get_attestation_participation_flags(
+        state, data, inclusion_delay, spec
+    )
+    if data.target.epoch == get_current_epoch(state, spec):
+        participation = state.current_epoch_participation
+    else:
+        participation = state.previous_epoch_participation
+
+    proposer_reward_numerator = 0
+    for idx in indexed.attesting_indices:
+        for flag_index in flags:
+            if not participation[idx] & (1 << flag_index):
+                participation[idx] |= 1 << flag_index
+                proposer_reward_numerator += get_base_reward_altair(
+                    state, idx, spec
+                ) * PARTICIPATION_FLAG_WEIGHTS[flag_index]
+
+    proposer_reward_denominator = (
+        (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+        * WEIGHT_DENOMINATOR
+        // PROPOSER_WEIGHT
+    )
+    proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+    increase_balance(
+        state, get_beacon_proposer_index(state, spec), proposer_reward
+    )
+
+
+# --------------------------------------------------------------- deposits
+
+
+def process_deposit(state, deposit, spec, fork, pubkey_cache):
+    leaf = type(deposit.data).hash_tree_root(deposit.data)
+    if not verify_merkle_proof(
+        leaf,
+        list(deposit.proof),
+        state.eth1_deposit_index,
+        bytes(state.eth1_data.deposit_root),
+    ):
+        raise BlockProcessingError("deposit: bad merkle proof")
+    state.eth1_deposit_index += 1
+    apply_deposit(state, deposit.data, spec, fork, pubkey_cache)
+
+
+def apply_deposit(state, deposit_data, spec, fork, pubkey_cache):
+    pubkey_cache.import_new(state)
+    pk_bytes = bytes(deposit_data.pubkey)
+    existing = pubkey_cache.index_of(pk_bytes)
+    if existing is None:
+        # new validator: deposit signature is checked INDIVIDUALLY and an
+        # invalid one skips the deposit without failing the block
+        try:
+            sset = sigsets.deposit_set(deposit_data, spec)
+        except bls.BlsError:
+            return
+        if not bls.verify_signature_sets([sset]):
+            return
+        _add_validator(state, deposit_data, spec, fork)
+    else:
+        increase_balance(state, existing, deposit_data.amount)
+
+
+def _add_validator(state, deposit_data, spec, fork):
+    from lighthouse_tpu.types.containers import types_for
+
+    t = types_for(spec)
+    amount = deposit_data.amount
+    effective = min(
+        amount - amount % spec.EFFECTIVE_BALANCE_INCREMENT,
+        spec.MAX_EFFECTIVE_BALANCE,
+    )
+    state.validators.append(
+        t.Validator(
+            pubkey=deposit_data.pubkey,
+            withdrawal_credentials=deposit_data.withdrawal_credentials,
+            effective_balance=effective,
+            slashed=False,
+            activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+            activation_epoch=FAR_FUTURE_EPOCH,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+    )
+    state.balances.append(amount)
+    if fork != "phase0":
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+
+
+# ------------------------------------------------------------------ exits
+
+
+def process_voluntary_exit(state, signed_exit, spec, pubkey_for, collector):
+    exit_msg = signed_exit.message
+    v = state.validators[exit_msg.validator_index]
+    current = get_current_epoch(state, spec)
+    if not is_active_validator(v, current):
+        raise BlockProcessingError("exit: validator not active")
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        raise BlockProcessingError("exit: already exiting")
+    if current < exit_msg.epoch:
+        raise BlockProcessingError("exit: epoch in the future")
+    if current < v.activation_epoch + spec.SHARD_COMMITTEE_PERIOD:
+        raise BlockProcessingError("exit: too early in validator lifetime")
+    collector.add(
+        lambda: sigsets.voluntary_exit_set(state, signed_exit, pubkey_for, spec)
+    )
+    initiate_validator_exit(state, exit_msg.validator_index, spec)
+
+
+# --------------------------------------------------------- sync aggregate
+
+
+def process_sync_aggregate(state, aggregate, pubkey_cache, spec, collector):
+    block_root = bytes(
+        get_block_root_at_slot(state, max(state.slot, 1) - 1, spec)
+    )
+    collector.add(
+        lambda: sigsets.sync_aggregate_set(
+            state,
+            aggregate,
+            state.slot,
+            block_root,
+            pubkey_cache.get_by_bytes,
+            spec,
+        )
+    )
+
+    total_active_increments = (
+        get_total_active_balance(state, spec)
+        // spec.EFFECTIVE_BALANCE_INCREMENT
+    )
+    total_base_rewards = (
+        get_base_reward_per_increment(state, spec) * total_active_increments
+    )
+    max_participant_rewards = (
+        total_base_rewards
+        * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR
+        // spec.SLOTS_PER_EPOCH
+    )
+    participant_reward = max_participant_rewards // spec.SYNC_COMMITTEE_SIZE
+    proposer_reward = (
+        participant_reward
+        * PROPOSER_WEIGHT
+        // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+
+    proposer_index = get_beacon_proposer_index(state, spec)
+    for pk, bit in zip(
+        state.current_sync_committee.pubkeys,
+        aggregate.sync_committee_bits,
+    ):
+        idx = pubkey_cache.index_of(bytes(pk))
+        if idx is None:
+            raise BlockProcessingError("sync aggregate: unknown pubkey")
+        if bit:
+            increase_balance(state, idx, participant_reward)
+            increase_balance(state, proposer_index, proposer_reward)
+        else:
+            decrease_balance(state, idx, participant_reward)

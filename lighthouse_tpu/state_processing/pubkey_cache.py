@@ -1,0 +1,36 @@
+"""Decompressed validator pubkey cache.
+
+Role of the reference's `ValidatorPubkeyCache`
+(beacon_node/beacon_chain/src/validator_pubkey_cache.rs:9-24): pubkey
+decompression is expensive; do it once per validator and reuse across every
+signature-set build. On the device path this is the host half of the
+device-resident pubkey table.
+"""
+
+from lighthouse_tpu import bls
+
+
+class PubkeyCache:
+    def __init__(self):
+        self._by_index: list[bls.PublicKey] = []
+        self._by_bytes: dict[bytes, int] = {}
+
+    def import_new(self, state):
+        """Pick up any validators appended since the last import."""
+        for i in range(len(self._by_index), len(state.validators)):
+            pk_bytes = bytes(state.validators[i].pubkey)
+            pk = bls.PublicKey.from_bytes(pk_bytes)
+            self._by_index.append(pk)
+            self._by_bytes[pk_bytes] = i
+
+    def get(self, index: int) -> bls.PublicKey:
+        return self._by_index[index]
+
+    def get_by_bytes(self, pk_bytes: bytes) -> bls.PublicKey:
+        return self._by_index[self._by_bytes[bytes(pk_bytes)]]
+
+    def index_of(self, pk_bytes: bytes):
+        return self._by_bytes.get(bytes(pk_bytes))
+
+    def __len__(self):
+        return len(self._by_index)

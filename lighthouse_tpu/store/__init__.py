@@ -1,0 +1,2 @@
+from lighthouse_tpu.store.kv import MemoryStore, SqliteStore  # noqa: F401
+from lighthouse_tpu.store.hot_cold import HotColdDB  # noqa: F401

@@ -1,0 +1,165 @@
+"""Hot/cold split database for blocks and states.
+
+Role of the reference's `HotColdDB` (beacon_node/store/src/hot_cold_store.rs:
+42-60): the hot section holds all blocks plus full state snapshots at epoch
+boundaries since the split; the cold (freezer) section holds one full
+"restore point" state every `slots_per_restore_point` slots; any other
+historical state is reconstructed by loading the nearest earlier snapshot
+and replaying blocks (the `BlockReplayer` analog,
+consensus/state_processing/src/block_replayer.rs).
+
+Objects are stored as SSZ bytes keyed by root (blocks) or slot (states);
+fork-aware decoding consults the Spec for the slot's fork.
+"""
+
+from lighthouse_tpu.state_processing.per_block import (
+    BlockSignatureStrategy,
+    per_block_processing,
+)
+from lighthouse_tpu.state_processing.per_slot import process_slots
+from lighthouse_tpu.state_processing.pubkey_cache import PubkeyCache
+from lighthouse_tpu.types.containers import types_for
+from lighthouse_tpu.types.spec import Spec
+
+COL_BLOCK = b"blk"
+COL_HOT_STATE = b"hst"
+COL_COLD_STATE = b"cst"
+COL_BLOCK_ROOTS = b"bri"  # slot -> block root (canonical chain index)
+COL_META = b"meta"
+
+SPLIT_KEY = b"split_slot"
+GENESIS_STATE_KEY = b"genesis_state"
+
+
+def _u64(v: int) -> bytes:
+    return int(v).to_bytes(8, "big")  # big-endian for ordered iteration
+
+
+class StoreError(Exception):
+    pass
+
+
+class HotColdDB:
+    def __init__(
+        self, kv, spec: Spec, slots_per_restore_point: int | None = None
+    ):
+        self.kv = kv
+        self.spec = spec
+        self.t = types_for(spec)
+        self.slots_per_restore_point = (
+            slots_per_restore_point or spec.SLOTS_PER_EPOCH * 4
+        )
+        self._replay_pubkeys = PubkeyCache()
+
+    # ------------------------------------------------------------- codecs
+
+    def _state_cls_at_slot(self, slot: int):
+        fork = self.spec.fork_name_at_epoch(self.spec.slot_to_epoch(slot))
+        return self.t.state_classes[fork]
+
+    def _block_cls_at_slot(self, slot: int):
+        fork = self.spec.fork_name_at_epoch(self.spec.slot_to_epoch(slot))
+        return self.t.signed_block_classes[fork]
+
+    # ------------------------------------------------------------- blocks
+
+    def put_block(self, root: bytes, signed_block) -> None:
+        data = _u64(signed_block.message.slot) + signed_block.to_bytes()
+        self.kv.put(COL_BLOCK, root, data)
+
+    def get_block(self, root: bytes):
+        data = self.kv.get(COL_BLOCK, root)
+        if data is None:
+            return None
+        slot = int.from_bytes(data[:8], "big")
+        return self._block_cls_at_slot(slot).decode(data[8:])
+
+    def set_canonical_block_root(self, slot: int, root: bytes) -> None:
+        self.kv.put(COL_BLOCK_ROOTS, _u64(slot), root)
+
+    def get_canonical_block_root(self, slot: int):
+        return self.kv.get(COL_BLOCK_ROOTS, _u64(slot))
+
+    # ------------------------------------------------------------- states
+
+    def put_hot_state(self, state) -> None:
+        self.kv.put(
+            COL_HOT_STATE, _u64(state.slot), state.to_bytes()
+        )
+
+    def get_hot_state(self, slot: int):
+        data = self.kv.get(COL_HOT_STATE, _u64(slot))
+        if data is None:
+            return None
+        return self._state_cls_at_slot(slot).decode(data)
+
+    def put_cold_state(self, state) -> None:
+        if state.slot % self.slots_per_restore_point:
+            raise StoreError("cold states must land on restore points")
+        self.kv.put(COL_COLD_STATE, _u64(state.slot), state.to_bytes())
+
+    # ------------------------------------------------------ hot/cold split
+
+    @property
+    def split_slot(self) -> int:
+        raw = self.kv.get(COL_META, SPLIT_KEY)
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def migrate_to_cold(self, finalized_slot: int) -> None:
+        """Move hot states below the finalized slot into the freezer:
+        keep restore points, drop the rest (reference
+        beacon_chain/src/migrate.rs background migration)."""
+        for key in sorted(self.kv.keys(COL_HOT_STATE)):
+            slot = int.from_bytes(key, "big")
+            if slot >= finalized_slot:
+                continue
+            if slot % self.slots_per_restore_point == 0:
+                data = self.kv.get(COL_HOT_STATE, key)
+                self.kv.put(COL_COLD_STATE, key, data)
+            self.kv.delete(COL_HOT_STATE, key)
+        self.kv.put(COL_META, SPLIT_KEY, _u64(finalized_slot))
+
+    # -------------------------------------------------- state reconstruction
+
+    def load_cold_state(self, slot: int):
+        """Exact state at `slot`: nearest restore point at or below, plus
+        replay of canonical blocks (signatures skipped — they were verified
+        on import; reference store/src/reconstruct.rs + block_replayer)."""
+        base_slot = slot - (slot % self.slots_per_restore_point)
+        data = None
+        while base_slot >= 0:
+            data = self.kv.get(COL_COLD_STATE, _u64(base_slot))
+            if data is not None:
+                break
+            base_slot -= self.slots_per_restore_point
+        if data is None:
+            return None
+        state = self._state_cls_at_slot(base_slot).decode(data)
+        return self.replay_blocks(state, slot)
+
+    def replay_blocks(self, state, target_slot: int):
+        """Advance `state` to `target_slot` applying canonical blocks."""
+        spec = self.spec
+        while state.slot < target_slot:
+            next_slot = state.slot + 1
+            root = self.get_canonical_block_root(next_slot)
+            state = process_slots(state, next_slot, spec)
+            if root is not None:
+                block = self.get_block(root)
+                if block is not None and block.message.slot == next_slot:
+                    self._replay_pubkeys.import_new(state)
+                    per_block_processing(
+                        state,
+                        block,
+                        spec,
+                        BlockSignatureStrategy.NO_VERIFICATION,
+                        self._replay_pubkeys,
+                    )
+        return state
+
+    def state_at_slot(self, slot: int):
+        """Hot lookup first, then freezer reconstruction."""
+        hot = self.get_hot_state(slot)
+        if hot is not None:
+            return hot
+        return self.load_cold_state(slot)

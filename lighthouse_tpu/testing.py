@@ -1,0 +1,114 @@
+"""Deterministic signature-set fixtures for tests, benches, and the graft
+entry — the analog of the reference's deterministic interop keypairs
+(common/eth2_interop_keypairs) + BeaconChainHarness test rigs.
+
+Message points are generated as scalar multiples of the G2 generator: a
+stand-in for hash-to-curve with identical device-side cost (the pairing does
+not care how H(m) was produced). `lighthouse_tpu.bls` layers real RFC-9380
+hashing on top for protocol use.
+"""
+
+import random
+
+import numpy as np
+
+from lighthouse_tpu.crypto import constants as C
+from lighthouse_tpu.crypto.ref_curve import G1 as RG1
+from lighthouse_tpu.crypto.ref_curve import G2 as RG2
+from lighthouse_tpu.ops import batch_verify, curve, fp, fp2
+
+
+def _pack_g1_affine(pts):
+    """[(x, y) or None, ...] -> device affine Montgomery pair; None -> (0,0)."""
+    xs = fp.to_mont(fp.pack([0 if p is None else p[0] for p in pts]))
+    ys = fp.to_mont(fp.pack([0 if p is None else p[1] for p in pts]))
+    return (xs, ys)
+
+
+def _pack_g2_affine(pts):
+    zero2 = (0, 0)
+    xs = fp2.to_mont(fp2.pack([zero2 if p is None else p[0] for p in pts]))
+    ys = fp2.to_mont(fp2.pack([zero2 if p is None else p[1] for p in pts]))
+    return (xs, ys)
+
+
+def make_signature_set_batch(
+    n_sets: int,
+    max_keys: int = 1,
+    seed: int = 0,
+    corrupt_indices: tuple = (),
+    fast_sequential: bool = False,
+):
+    """Build a batch of valid BLS signature sets (optionally corrupting some).
+
+    fast_sequential: secret keys are 1..N and points are built by running
+    point additions instead of full scalar muls — O(N) instead of O(N*255);
+    used for large benchmark batches.
+
+    Returns the 6-tuple of device inputs for
+    `ops.batch_verify.verify_signature_sets`.
+    """
+    rng = random.Random(seed)
+
+    msgs, sigs, pk_rows, mask_rows = [], [], [], []
+    if fast_sequential:
+        h_scalar = rng.randrange(2, C.R)
+        h = RG2.mul_scalar(RG2.generator, h_scalar)
+        h_aff = RG2.to_affine(h)
+        running_pk = RG1.infinity
+        running_sig = RG2.infinity
+        for i in range(n_sets):
+            running_pk = RG1.add(running_pk, RG1.generator)  # (i+1) * G1
+            running_sig = RG2.add(running_sig, h)            # (i+1) * H
+            msgs.append(h_aff)
+            sigs.append(RG2.to_affine(running_sig))
+            pk_rows.append(
+                [RG1.to_affine(running_pk)] + [None] * (max_keys - 1)
+            )
+            mask_rows.append([True] + [False] * (max_keys - 1))
+    else:
+        for i in range(n_sets):
+            n_keys = rng.randrange(1, max_keys + 1)
+            sks = [rng.randrange(2, C.R) for _ in range(n_keys)]
+            h = RG2.mul_scalar(RG2.generator, rng.randrange(2, C.R))
+            msgs.append(RG2.to_affine(h))
+            agg_sig = RG2.infinity
+            row = []
+            for sk in sks:
+                row.append(RG1.to_affine(RG1.mul_scalar(RG1.generator, sk)))
+                agg_sig = RG2.add(agg_sig, RG2.mul_scalar(h, sk))
+            sigs.append(RG2.to_affine(agg_sig))
+            pk_rows.append(row + [None] * (max_keys - n_keys))
+            mask_rows.append(
+                [True] * n_keys + [False] * (max_keys - n_keys)
+            )
+
+    for idx in corrupt_indices:
+        # corrupt the signature: use 7*H instead of the true aggregate
+        bad = RG2.to_affine(
+            RG2.mul_scalar(RG2.from_affine(msgs[idx]), 7)
+        )
+        sigs[idx] = bad
+
+    flat_pks = [p for row in pk_rows for p in row]
+    pk_x, pk_y = _pack_g1_affine(flat_pks)
+    nl = pk_x.shape[-1]
+    pubkeys = (
+        pk_x.reshape(n_sets, max_keys, nl),
+        pk_y.reshape(n_sets, max_keys, nl),
+    )
+    key_mask = np.array(mask_rows, dtype=bool)
+    set_mask = np.ones(n_sets, dtype=bool)
+    rand_scalars = [
+        rng.randrange(1, 1 << batch_verify.RAND_BITS) for _ in range(n_sets)
+    ]
+    rand_bits = curve.scalars_to_bits(rand_scalars, batch_verify.RAND_BITS)
+
+    return (
+        _pack_g2_affine(msgs),
+        _pack_g2_affine(sigs),
+        pubkeys,
+        key_mask,
+        rand_bits,
+        set_mask,
+    )

@@ -1,0 +1,367 @@
+"""Consensus containers (phase0 + altair), built per-Spec.
+
+The reference monomorphizes containers over the `EthSpec` trait
+(consensus/types/src/beacon_state.rs:295, beacon_block.rs, attestation.rs,
+superstruct-versioned for forks). Here `types_for(spec)` builds the same
+family of SSZ container classes with the spec's sizes baked into List/Vector
+limits, cached per spec name. Fork variants are separate classes
+(`BeaconStatePhase0` / `BeaconStateAltair`, same for blocks/bodies) with a
+shared field prefix, dispatched by `spec.fork_name_at_epoch`.
+"""
+
+from types import SimpleNamespace
+
+from lighthouse_tpu import ssz
+from lighthouse_tpu.types.spec import (
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    JUSTIFICATION_BITS_LENGTH,
+    Spec,
+)
+
+Root = ssz.bytes32
+Hash32 = ssz.bytes32
+Slot = ssz.uint64
+Epoch = ssz.uint64
+CommitteeIndex = ssz.uint64
+ValidatorIndex = ssz.uint64
+Gwei = ssz.uint64
+Version = ssz.bytes4
+DomainType = ssz.bytes4
+Domain = ssz.bytes32
+BLSPubkey = ssz.bytes48
+BLSSignature = ssz.bytes96
+ParticipationFlags = ssz.uint8
+
+_CACHE: dict[str, SimpleNamespace] = {}
+
+
+def types_for(spec: Spec) -> SimpleNamespace:
+    if spec.name in _CACHE:
+        return _CACHE[spec.name]
+
+    # ----------------------------------------------------------- fork-free
+
+    class Fork(ssz.Container):
+        previous_version: Version
+        current_version: Version
+        epoch: Epoch
+
+    class ForkData(ssz.Container):
+        current_version: Version
+        genesis_validators_root: Root
+
+    class Checkpoint(ssz.Container):
+        epoch: Epoch
+        root: Root
+
+    class SigningData(ssz.Container):
+        object_root: Root
+        domain: Domain
+
+    class Validator(ssz.Container):
+        pubkey: BLSPubkey
+        withdrawal_credentials: ssz.bytes32
+        effective_balance: Gwei
+        slashed: ssz.boolean
+        activation_eligibility_epoch: Epoch
+        activation_epoch: Epoch
+        exit_epoch: Epoch
+        withdrawable_epoch: Epoch
+
+    class AttestationData(ssz.Container):
+        slot: Slot
+        index: CommitteeIndex
+        beacon_block_root: Root
+        source: Checkpoint
+        target: Checkpoint
+
+    class IndexedAttestation(ssz.Container):
+        attesting_indices: ssz.List(
+            ssz.uint64, spec.MAX_VALIDATORS_PER_COMMITTEE
+        )
+        data: AttestationData
+        signature: BLSSignature
+
+    class PendingAttestation(ssz.Container):
+        aggregation_bits: ssz.Bitlist(spec.MAX_VALIDATORS_PER_COMMITTEE)
+        data: AttestationData
+        inclusion_delay: Slot
+        proposer_index: ValidatorIndex
+
+    class Eth1Data(ssz.Container):
+        deposit_root: Root
+        deposit_count: ssz.uint64
+        block_hash: Hash32
+
+    class HistoricalBatch(ssz.Container):
+        block_roots: ssz.Vector(Root, spec.SLOTS_PER_HISTORICAL_ROOT)
+        state_roots: ssz.Vector(Root, spec.SLOTS_PER_HISTORICAL_ROOT)
+
+    class DepositMessage(ssz.Container):
+        pubkey: BLSPubkey
+        withdrawal_credentials: ssz.bytes32
+        amount: Gwei
+
+    class DepositData(ssz.Container):
+        pubkey: BLSPubkey
+        withdrawal_credentials: ssz.bytes32
+        amount: Gwei
+        signature: BLSSignature
+
+    class BeaconBlockHeader(ssz.Container):
+        slot: Slot
+        proposer_index: ValidatorIndex
+        parent_root: Root
+        state_root: Root
+        body_root: Root
+
+    class SignedBeaconBlockHeader(ssz.Container):
+        message: BeaconBlockHeader
+        signature: BLSSignature
+
+    class ProposerSlashing(ssz.Container):
+        signed_header_1: SignedBeaconBlockHeader
+        signed_header_2: SignedBeaconBlockHeader
+
+    class AttesterSlashing(ssz.Container):
+        attestation_1: IndexedAttestation
+        attestation_2: IndexedAttestation
+
+    class Attestation(ssz.Container):
+        aggregation_bits: ssz.Bitlist(spec.MAX_VALIDATORS_PER_COMMITTEE)
+        data: AttestationData
+        signature: BLSSignature
+
+    class Deposit(ssz.Container):
+        proof: ssz.Vector(ssz.bytes32, DEPOSIT_CONTRACT_TREE_DEPTH + 1)
+        data: DepositData
+
+    class VoluntaryExit(ssz.Container):
+        epoch: Epoch
+        validator_index: ValidatorIndex
+
+    class SignedVoluntaryExit(ssz.Container):
+        message: VoluntaryExit
+        signature: BLSSignature
+
+    class SyncCommittee(ssz.Container):
+        pubkeys: ssz.Vector(BLSPubkey, spec.SYNC_COMMITTEE_SIZE)
+        aggregate_pubkey: BLSPubkey
+
+    class SyncAggregate(ssz.Container):
+        sync_committee_bits: ssz.Bitvector(spec.SYNC_COMMITTEE_SIZE)
+        sync_committee_signature: BLSSignature
+
+    # -------------------------------------------------------------- bodies
+
+    class BeaconBlockBodyPhase0(ssz.Container):
+        randao_reveal: BLSSignature
+        eth1_data: Eth1Data
+        graffiti: ssz.bytes32
+        proposer_slashings: ssz.List(
+            ProposerSlashing, spec.MAX_PROPOSER_SLASHINGS
+        )
+        attester_slashings: ssz.List(
+            AttesterSlashing, spec.MAX_ATTESTER_SLASHINGS
+        )
+        attestations: ssz.List(Attestation, spec.MAX_ATTESTATIONS)
+        deposits: ssz.List(Deposit, spec.MAX_DEPOSITS)
+        voluntary_exits: ssz.List(
+            SignedVoluntaryExit, spec.MAX_VOLUNTARY_EXITS
+        )
+
+    class BeaconBlockBodyAltair(ssz.Container):
+        randao_reveal: BLSSignature
+        eth1_data: Eth1Data
+        graffiti: ssz.bytes32
+        proposer_slashings: ssz.List(
+            ProposerSlashing, spec.MAX_PROPOSER_SLASHINGS
+        )
+        attester_slashings: ssz.List(
+            AttesterSlashing, spec.MAX_ATTESTER_SLASHINGS
+        )
+        attestations: ssz.List(Attestation, spec.MAX_ATTESTATIONS)
+        deposits: ssz.List(Deposit, spec.MAX_DEPOSITS)
+        voluntary_exits: ssz.List(
+            SignedVoluntaryExit, spec.MAX_VOLUNTARY_EXITS
+        )
+        sync_aggregate: SyncAggregate
+
+    def _make_block(body_cls, name):
+        cls = type(
+            name,
+            (ssz.Container,),
+            {
+                "__annotations__": {
+                    "slot": Slot,
+                    "proposer_index": ValidatorIndex,
+                    "parent_root": Root,
+                    "state_root": Root,
+                    "body": body_cls,
+                }
+            },
+        )
+        return cls
+
+    BeaconBlockPhase0 = _make_block(BeaconBlockBodyPhase0, "BeaconBlockPhase0")
+    BeaconBlockAltair = _make_block(BeaconBlockBodyAltair, "BeaconBlockAltair")
+
+    def _make_signed(block_cls, name):
+        return type(
+            name,
+            (ssz.Container,),
+            {
+                "__annotations__": {
+                    "message": block_cls,
+                    "signature": BLSSignature,
+                }
+            },
+        )
+
+    SignedBeaconBlockPhase0 = _make_signed(
+        BeaconBlockPhase0, "SignedBeaconBlockPhase0"
+    )
+    SignedBeaconBlockAltair = _make_signed(
+        BeaconBlockAltair, "SignedBeaconBlockAltair"
+    )
+
+    # --------------------------------------------------------------- state
+
+    _state_prefix = {
+        "genesis_time": ssz.uint64,
+        "genesis_validators_root": Root,
+        "slot": Slot,
+        "fork": Fork,
+        "latest_block_header": BeaconBlockHeader,
+        "block_roots": ssz.Vector(Root, spec.SLOTS_PER_HISTORICAL_ROOT),
+        "state_roots": ssz.Vector(Root, spec.SLOTS_PER_HISTORICAL_ROOT),
+        "historical_roots": ssz.List(Root, spec.HISTORICAL_ROOTS_LIMIT),
+        "eth1_data": Eth1Data,
+        "eth1_data_votes": ssz.List(
+            Eth1Data,
+            spec.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.SLOTS_PER_EPOCH,
+        ),
+        "eth1_deposit_index": ssz.uint64,
+        "validators": ssz.List(Validator, spec.VALIDATOR_REGISTRY_LIMIT),
+        "balances": ssz.List(Gwei, spec.VALIDATOR_REGISTRY_LIMIT),
+        "randao_mixes": ssz.Vector(
+            ssz.bytes32, spec.EPOCHS_PER_HISTORICAL_VECTOR
+        ),
+        "slashings": ssz.Vector(Gwei, spec.EPOCHS_PER_SLASHINGS_VECTOR),
+    }
+    _state_suffix = {
+        "justification_bits": ssz.Bitvector(JUSTIFICATION_BITS_LENGTH),
+        "previous_justified_checkpoint": Checkpoint,
+        "current_justified_checkpoint": Checkpoint,
+        "finalized_checkpoint": Checkpoint,
+    }
+
+    BeaconStatePhase0 = type(
+        "BeaconStatePhase0",
+        (ssz.Container,),
+        {
+            "__annotations__": {
+                **_state_prefix,
+                "previous_epoch_attestations": ssz.List(
+                    PendingAttestation,
+                    spec.MAX_ATTESTATIONS * spec.SLOTS_PER_EPOCH,
+                ),
+                "current_epoch_attestations": ssz.List(
+                    PendingAttestation,
+                    spec.MAX_ATTESTATIONS * spec.SLOTS_PER_EPOCH,
+                ),
+                **_state_suffix,
+            }
+        },
+    )
+
+    BeaconStateAltair = type(
+        "BeaconStateAltair",
+        (ssz.Container,),
+        {
+            "__annotations__": {
+                **_state_prefix,
+                "previous_epoch_participation": ssz.List(
+                    ParticipationFlags, spec.VALIDATOR_REGISTRY_LIMIT
+                ),
+                "current_epoch_participation": ssz.List(
+                    ParticipationFlags, spec.VALIDATOR_REGISTRY_LIMIT
+                ),
+                **_state_suffix,
+                "inactivity_scores": ssz.List(
+                    ssz.uint64, spec.VALIDATOR_REGISTRY_LIMIT
+                ),
+                "current_sync_committee": SyncCommittee,
+                "next_sync_committee": SyncCommittee,
+            }
+        },
+    )
+
+    # ------------------------------------------------- gossip/VC envelopes
+
+    class AggregateAndProof(ssz.Container):
+        aggregator_index: ValidatorIndex
+        aggregate: Attestation
+        selection_proof: BLSSignature
+
+    class SignedAggregateAndProof(ssz.Container):
+        message: AggregateAndProof
+        signature: BLSSignature
+
+    class SyncCommitteeMessage(ssz.Container):
+        slot: Slot
+        beacon_block_root: Root
+        validator_index: ValidatorIndex
+        signature: BLSSignature
+
+    class SyncCommitteeContribution(ssz.Container):
+        slot: Slot
+        beacon_block_root: Root
+        subcommittee_index: ssz.uint64
+        aggregation_bits: ssz.Bitvector(max(spec.SYNC_COMMITTEE_SIZE // 4, 1))
+        signature: BLSSignature
+
+    class ContributionAndProof(ssz.Container):
+        aggregator_index: ValidatorIndex
+        contribution: SyncCommitteeContribution
+        selection_proof: BLSSignature
+
+    class SignedContributionAndProof(ssz.Container):
+        message: ContributionAndProof
+        signature: BLSSignature
+
+    class DepositEvent(ssz.Container):
+        """Deposit log entry as cached by the eth1 service
+        (reference beacon_node/eth1/src/deposit_cache.rs)."""
+
+        deposit_data: DepositData
+        block_number: ssz.uint64
+        index: ssz.uint64
+
+    ns = SimpleNamespace(**{
+        k: v
+        for k, v in locals().items()
+        if isinstance(v, type) and issubclass(v, ssz.Container)
+    })
+    ns.spec = spec
+
+    # fork dispatch tables
+    ns.block_body_classes = {
+        "phase0": BeaconBlockBodyPhase0,
+        "altair": BeaconBlockBodyAltair,
+    }
+    ns.block_classes = {
+        "phase0": BeaconBlockPhase0,
+        "altair": BeaconBlockAltair,
+    }
+    ns.signed_block_classes = {
+        "phase0": SignedBeaconBlockPhase0,
+        "altair": SignedBeaconBlockAltair,
+    }
+    ns.state_classes = {
+        "phase0": BeaconStatePhase0,
+        "altair": BeaconStateAltair,
+    }
+
+    _CACHE[spec.name] = ns
+    return ns

@@ -1,0 +1,7 @@
+from lighthouse_tpu.validator_client.validator_client import (  # noqa: F401
+    ValidatorClient,
+)
+from lighthouse_tpu.validator_client.slashing_protection import (  # noqa: F401
+    SlashingProtectionDB,
+    SlashingError,
+)

@@ -1,0 +1,213 @@
+"""Slashing protection database (SQLite) with EIP-3076 interchange.
+
+Role of validator_client/slashing_protection: the authoritative signing
+history. Every block proposal and attestation signature MUST pass through
+`check_and_insert_*` first; the DB enforces the minimal conditions:
+
+  blocks:       slot strictly greater than any previously signed slot
+  attestations: no double vote (same target epoch), no surround vote
+                (either direction), sources/targets monotonic
+
+Import/export uses the EIP-3076 JSON interchange format so histories can
+move between this and other clients.
+"""
+
+import json
+import sqlite3
+import threading
+
+
+class SlashingError(Exception):
+    pass
+
+
+class SlashingProtectionDB:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            c = self._conn
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS signed_blocks ("
+                "pubkey BLOB NOT NULL, slot INTEGER NOT NULL, "
+                "signing_root BLOB, PRIMARY KEY (pubkey, slot))"
+            )
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS signed_attestations ("
+                "pubkey BLOB NOT NULL, source_epoch INTEGER NOT NULL, "
+                "target_epoch INTEGER NOT NULL, signing_root BLOB, "
+                "PRIMARY KEY (pubkey, target_epoch))"
+            )
+            c.commit()
+
+    # -------------------------------------------------------------- blocks
+
+    def check_and_insert_block(
+        self, pubkey: bytes, slot: int, signing_root: bytes
+    ):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT MAX(slot) FROM signed_blocks WHERE pubkey=?",
+                (pubkey,),
+            ).fetchone()
+            max_slot = row[0]
+            if max_slot is not None and slot <= max_slot:
+                existing = self._conn.execute(
+                    "SELECT signing_root FROM signed_blocks "
+                    "WHERE pubkey=? AND slot=?",
+                    (pubkey, slot),
+                ).fetchone()
+                if existing and existing[0] == signing_root:
+                    return  # exact re-sign of the same block is safe
+                raise SlashingError(
+                    f"block slot {slot} <= previously signed {max_slot}"
+                )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO signed_blocks VALUES (?,?,?)",
+                (pubkey, slot, signing_root),
+            )
+            self._conn.commit()
+
+    # -------------------------------------------------------- attestations
+
+    def check_and_insert_attestation(
+        self,
+        pubkey: bytes,
+        source_epoch: int,
+        target_epoch: int,
+        signing_root: bytes,
+    ):
+        if source_epoch > target_epoch:
+            raise SlashingError("source after target")
+        with self._lock:
+            # double vote
+            row = self._conn.execute(
+                "SELECT source_epoch, signing_root FROM signed_attestations "
+                "WHERE pubkey=? AND target_epoch=?",
+                (pubkey, target_epoch),
+            ).fetchone()
+            if row is not None:
+                if row[1] == signing_root:
+                    return
+                raise SlashingError(
+                    f"double vote at target epoch {target_epoch}"
+                )
+            # surrounding an existing attestation
+            row = self._conn.execute(
+                "SELECT COUNT(*) FROM signed_attestations WHERE pubkey=? "
+                "AND source_epoch > ? AND target_epoch < ?",
+                (pubkey, source_epoch, target_epoch),
+            ).fetchone()
+            if row[0]:
+                raise SlashingError("surround vote (new surrounds existing)")
+            # surrounded by an existing attestation
+            row = self._conn.execute(
+                "SELECT COUNT(*) FROM signed_attestations WHERE pubkey=? "
+                "AND source_epoch < ? AND target_epoch > ?",
+                (pubkey, source_epoch, target_epoch),
+            ).fetchone()
+            if row[0]:
+                raise SlashingError("surround vote (existing surrounds new)")
+            # monotonic minimums (EIP-3076 minimal condition)
+            row = self._conn.execute(
+                "SELECT MAX(source_epoch), MAX(target_epoch) "
+                "FROM signed_attestations WHERE pubkey=?",
+                (pubkey,),
+            ).fetchone()
+            if row[0] is not None and source_epoch < row[0]:
+                raise SlashingError("source epoch rewind")
+            self._conn.execute(
+                "INSERT INTO signed_attestations VALUES (?,?,?,?)",
+                (pubkey, source_epoch, target_epoch, signing_root),
+            )
+            self._conn.commit()
+
+    # --------------------------------------------------------- interchange
+
+    def export_interchange(self, genesis_validators_root: bytes) -> str:
+        with self._lock:
+            data = {
+                "metadata": {
+                    "interchange_format_version": "5",
+                    "genesis_validators_root": "0x"
+                    + genesis_validators_root.hex(),
+                },
+                "data": [],
+            }
+            pubkeys = {
+                r[0]
+                for r in self._conn.execute(
+                    "SELECT DISTINCT pubkey FROM signed_blocks "
+                    "UNION SELECT DISTINCT pubkey FROM signed_attestations"
+                )
+            }
+            for pk in sorted(pubkeys):
+                blocks = self._conn.execute(
+                    "SELECT slot, signing_root FROM signed_blocks "
+                    "WHERE pubkey=? ORDER BY slot",
+                    (pk,),
+                ).fetchall()
+                atts = self._conn.execute(
+                    "SELECT source_epoch, target_epoch, signing_root "
+                    "FROM signed_attestations WHERE pubkey=? "
+                    "ORDER BY target_epoch",
+                    (pk,),
+                ).fetchall()
+                data["data"].append(
+                    {
+                        "pubkey": "0x" + pk.hex(),
+                        "signed_blocks": [
+                            {
+                                "slot": str(s),
+                                **(
+                                    {"signing_root": "0x" + r.hex()}
+                                    if r
+                                    else {}
+                                ),
+                            }
+                            for s, r in blocks
+                        ],
+                        "signed_attestations": [
+                            {
+                                "source_epoch": str(se),
+                                "target_epoch": str(te),
+                                **(
+                                    {"signing_root": "0x" + r.hex()}
+                                    if r
+                                    else {}
+                                ),
+                            }
+                            for se, te, r in atts
+                        ],
+                    }
+                )
+        return json.dumps(data, indent=2)
+
+    def import_interchange(self, payload: str):
+        doc = json.loads(payload)
+        with self._lock:
+            for entry in doc.get("data", []):
+                pk = bytes.fromhex(entry["pubkey"][2:])
+                for b in entry.get("signed_blocks", []):
+                    root = bytes.fromhex(
+                        b.get("signing_root", "0x")[2:]
+                    ) or None
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO signed_blocks VALUES (?,?,?)",
+                        (pk, int(b["slot"]), root),
+                    )
+                for a in entry.get("signed_attestations", []):
+                    root = bytes.fromhex(
+                        a.get("signing_root", "0x")[2:]
+                    ) or None
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO signed_attestations "
+                        "VALUES (?,?,?,?)",
+                        (
+                            pk,
+                            int(a["source_epoch"]),
+                            int(a["target_epoch"]),
+                            root,
+                        ),
+                    )
+            self._conn.commit()

@@ -1,0 +1,278 @@
+"""Validator client: duties tracking, block/attestation/aggregation duties
+execution, slashing-protection gating, doppelganger protection.
+
+Role of the reference validator_client crate: `DutiesService`
+(duties_service.rs:105) polling proposer/attester duties per epoch,
+`BlockService` (block_service.rs:185) producing + signing + publishing on
+own proposal slots, `AttestationService` (attestation_service.rs) signing
+attestations at slot+1/3 and aggregating at slot+2/3 when selected, and
+`DoppelgangerService` refusing to sign until liveness of our keys has been
+observed quiet for a few epochs. The beacon node is reached through a
+`BeaconNodeInterface` — in-process here, with the HTTP API client as the
+production transport (the BeaconNodeHttpClient analog).
+"""
+
+from dataclasses import dataclass, field
+
+from lighthouse_tpu import bls, ssz
+from lighthouse_tpu.state_processing.helpers import (
+    CommitteeCache,
+    get_domain,
+    hash32,
+)
+from lighthouse_tpu.types.helpers import compute_signing_root
+from lighthouse_tpu.validator_client.slashing_protection import (
+    SlashingProtectionDB,
+)
+
+TARGET_AGGREGATORS_PER_COMMITTEE = 16
+
+
+@dataclass
+class AttesterDuty:
+    validator_index: int
+    slot: int
+    committee_index: int
+    committee_position: int
+    committee_length: int
+    selection_proof: bytes | None = None
+    is_aggregator: bool = False
+
+
+@dataclass
+class EpochDuties:
+    epoch: int
+    proposers: dict = field(default_factory=dict)  # slot -> validator index
+    attesters: dict = field(default_factory=dict)  # validator -> AttesterDuty
+
+
+class ValidatorClient:
+    def __init__(
+        self,
+        chain,
+        keypairs_by_index: dict,
+        slashing_db: SlashingProtectionDB | None = None,
+        doppelganger_epochs: int = 0,
+    ):
+        """keypairs_by_index: validator index -> bls Keypair for the keys
+        this client manages."""
+        self.chain = chain
+        self.spec = chain.spec
+        self.t = chain.t
+        self.keys = dict(keypairs_by_index)
+        self.slashing_db = slashing_db or SlashingProtectionDB()
+        self._duties: dict[int, EpochDuties] = {}
+        self.doppelganger_epochs = doppelganger_epochs
+        self._started_epoch: int | None = None
+        self.metrics = {
+            "blocks_proposed": 0,
+            "attestations_published": 0,
+            "aggregates_published": 0,
+            "signings_blocked": 0,
+        }
+
+    # ------------------------------------------------------------- duties
+
+    def update_duties(self, epoch: int):
+        """Poll duties for an epoch (DutiesService::poll_beacon_attesters)."""
+        state = self.chain.state_for_epoch(epoch)
+        spec = self.spec
+        cache = CommitteeCache(state, epoch, spec)
+        duties = EpochDuties(epoch=epoch)
+
+        from lighthouse_tpu.state_processing.helpers import (
+            get_beacon_proposer_index,
+        )
+        from lighthouse_tpu.state_processing.per_slot import process_slots
+
+        for slot in range(
+            spec.epoch_start_slot(epoch),
+            spec.epoch_start_slot(epoch + 1),
+        ):
+            st = state
+            if st.slot < slot:
+                st = process_slots(state.copy(), slot, spec)
+            proposer = get_beacon_proposer_index(st, spec)
+            if proposer in self.keys:
+                duties.proposers[slot] = proposer
+            for index in range(cache.committees_per_slot):
+                committee = cache.get_beacon_committee(slot, index)
+                for pos, v in enumerate(committee):
+                    if v in self.keys:
+                        duty = AttesterDuty(
+                            validator_index=v,
+                            slot=slot,
+                            committee_index=index,
+                            committee_position=pos,
+                            committee_length=len(committee),
+                        )
+                        self._attach_selection_proof(state, duty)
+                        duties.attesters[v] = duty
+        self._duties[epoch] = duties
+        return duties
+
+    def _attach_selection_proof(self, state, duty: AttesterDuty):
+        """Precompute the aggregation selection proof and aggregator flag
+        (DutyAndProof in the reference, duties_service.rs:58-93)."""
+        domain = get_domain(
+            state,
+            self.spec.DOMAIN_SELECTION_PROOF,
+            self.spec.slot_to_epoch(duty.slot),
+            self.spec,
+        )
+        root = compute_signing_root(
+            ssz.uint64.hash_tree_root(duty.slot), domain
+        )
+        proof = self.keys[duty.validator_index].sk.sign(root).to_bytes()
+        duty.selection_proof = proof
+        modulo = max(
+            1,
+            duty.committee_length // TARGET_AGGREGATORS_PER_COMMITTEE,
+        )
+        duty.is_aggregator = (
+            int.from_bytes(hash32(proof)[:8], "little") % modulo == 0
+        )
+
+    # ------------------------------------------------- doppelganger gating
+
+    def start_epoch(self, epoch: int):
+        if self._started_epoch is None:
+            self._started_epoch = epoch
+
+    def signing_enabled(self, epoch: int) -> bool:
+        """Doppelganger protection: no signing for the first N epochs after
+        startup (doppelganger_service.rs semantics, liveness-check form)."""
+        if self._started_epoch is None:
+            self._started_epoch = epoch
+        return epoch >= self._started_epoch + self.doppelganger_epochs
+
+    # -------------------------------------------------------------- blocks
+
+    def propose(self, slot: int, harness_producer) -> object | None:
+        """Run the proposal duty for `slot` if one of our keys has it.
+
+        `harness_producer(slot, proposer)` returns an unsigned block; in
+        production this is `GET /eth/v2/validator/blocks/{slot}`."""
+        epoch = self.spec.slot_to_epoch(slot)
+        duties = self._duties.get(epoch) or self.update_duties(epoch)
+        proposer = duties.proposers.get(slot)
+        if proposer is None:
+            return None
+        if not self.signing_enabled(epoch):
+            self.metrics["signings_blocked"] += 1
+            return None
+        block = harness_producer(slot, proposer)
+        block_cls = type(block)
+        state = self.chain.head_state
+        domain = get_domain(
+            state, self.spec.DOMAIN_BEACON_PROPOSER, epoch, self.spec
+        )
+        root = compute_signing_root(
+            block_cls.hash_tree_root(block), domain
+        )
+        pk = self.keys[proposer].pk.to_bytes()
+        self.slashing_db.check_and_insert_block(pk, slot, root)
+        sig = self.keys[proposer].sk.sign(root).to_bytes()
+        signed_cls = self.t.signed_block_classes[
+            self.spec.fork_name_at_epoch(epoch)
+        ]
+        self.metrics["blocks_proposed"] += 1
+        return signed_cls(message=block, signature=sig)
+
+    # -------------------------------------------------------- attestations
+
+    def attest(self, slot: int):
+        """Produce signed attestations for every managed validator with a
+        duty at `slot` (slot+1/3 timing handled by the caller's clock)."""
+        epoch = self.spec.slot_to_epoch(slot)
+        duties = self._duties.get(epoch) or self.update_duties(epoch)
+        if not self.signing_enabled(epoch):
+            self.metrics["signings_blocked"] += 1
+            return []
+        state = self.chain.head_state
+        spec = self.spec
+        head_root = self.chain.head_root
+        start_slot = spec.epoch_start_slot(epoch)
+        if state.slot > start_slot:
+            from lighthouse_tpu.state_processing.helpers import (
+                get_block_root_at_slot,
+            )
+
+            target_root = bytes(
+                get_block_root_at_slot(state, start_slot, spec)
+            )
+        else:
+            target_root = head_root
+
+        out = []
+        domain = get_domain(
+            state, spec.DOMAIN_BEACON_ATTESTER, epoch, spec
+        )
+        for duty in duties.attesters.values():
+            if duty.slot != slot:
+                continue
+            data = self.t.AttestationData(
+                slot=slot,
+                index=duty.committee_index,
+                beacon_block_root=head_root,
+                source=state.current_justified_checkpoint,
+                target=self.t.Checkpoint(epoch=epoch, root=target_root),
+            )
+            root = compute_signing_root(
+                self.t.AttestationData.hash_tree_root(data), domain
+            )
+            pk = self.keys[duty.validator_index].pk.to_bytes()
+            self.slashing_db.check_and_insert_attestation(
+                pk, data.source.epoch, data.target.epoch, root
+            )
+            bits = [
+                i == duty.committee_position
+                for i in range(duty.committee_length)
+            ]
+            sig = self.keys[duty.validator_index].sk.sign(root).to_bytes()
+            out.append(
+                self.t.Attestation(
+                    aggregation_bits=bits, data=data, signature=sig
+                )
+            )
+        self.metrics["attestations_published"] += len(out)
+        return out
+
+    def aggregate(self, slot: int):
+        """At slot+2/3: selected aggregators wrap the naive-pool aggregate
+        in a SignedAggregateAndProof."""
+        epoch = self.spec.slot_to_epoch(slot)
+        duties = self._duties.get(epoch) or self.update_duties(epoch)
+        state = self.chain.head_state
+        out = []
+        for duty in duties.attesters.values():
+            if duty.slot != slot or not duty.is_aggregator:
+                continue
+            pool_aggs = self.chain.naive_pool.aggregates_at_slot(slot)
+            agg = next(
+                (
+                    a
+                    for a in pool_aggs
+                    if a.data.index == duty.committee_index
+                ),
+                None,
+            )
+            if agg is None:
+                continue
+            msg = self.t.AggregateAndProof(
+                aggregator_index=duty.validator_index,
+                aggregate=agg,
+                selection_proof=duty.selection_proof,
+            )
+            domain = get_domain(
+                state, self.spec.DOMAIN_AGGREGATE_AND_PROOF, epoch, self.spec
+            )
+            root = compute_signing_root(
+                self.t.AggregateAndProof.hash_tree_root(msg), domain
+            )
+            sig = self.keys[duty.validator_index].sk.sign(root).to_bytes()
+            out.append(
+                self.t.SignedAggregateAndProof(message=msg, signature=sig)
+            )
+        self.metrics["aggregates_published"] += len(out)
+        return out

@@ -1,0 +1,65 @@
+"""Profile trace/compile/run time of the pairing stack stage by stage."""
+
+import os
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", "/tmp/lighthouse_tpu_jax_cache"
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax  # noqa: E402
+
+from lighthouse_tpu.crypto.ref_curve import G1 as RG1  # noqa: E402
+from lighthouse_tpu.crypto.ref_curve import G2 as RG2  # noqa: E402
+from lighthouse_tpu.ops import fp, fp2, pairing, tower  # noqa: E402
+
+
+def pack_g1(pts):
+    return (
+        fp.to_mont(fp.pack([p[0] for p in pts])),
+        fp.to_mont(fp.pack([p[1] for p in pts])),
+    )
+
+
+def pack_g2(pts):
+    return (
+        fp2.to_mont(fp2.pack([p[0] for p in pts])),
+        fp2.to_mont(fp2.pack([p[1] for p in pts])),
+    )
+
+
+def stage(name, fn, *args):
+    t0 = time.perf_counter()
+    lowered = jax.jit(fn).lower(*args)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    out = compiled(*args)
+    jax.block_until_ready(out)
+    t3 = time.perf_counter()
+    out = compiled(*args)
+    jax.block_until_ready(out)
+    t4 = time.perf_counter()
+    print(
+        f"{name:24s} trace {t1-t0:7.2f}s  compile {t2-t1:7.2f}s  "
+        f"run1 {t3-t2:7.2f}s  run2 {t4-t3:7.2f}s"
+    )
+    return out
+
+
+def main():
+    p1 = RG1.to_affine(RG1.mul_scalar(RG1.generator, 5))
+    q1 = RG2.to_affine(RG2.mul_scalar(RG2.generator, 7))
+    g1 = pack_g1([p1, p1])
+    g2 = pack_g2([q1, q1])
+
+    f = stage("miller_loop", pairing.miller_loop, g1, g2)
+    prod = stage("fp12_product_axis", tower.fp12_product_axis, f)
+    stage("final_exponentiation", pairing.final_exponentiation, prod)
+    stage("pairing (full)", pairing.pairing, g1, g2)
+
+
+if __name__ == "__main__":
+    main()

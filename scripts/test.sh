@@ -1,0 +1,4 @@
+#!/bin/sh
+# Run the test suite on the virtual CPU mesh, never touching the TPU tunnel.
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest "${@:-tests/}" -q

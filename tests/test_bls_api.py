@@ -1,0 +1,128 @@
+"""Host BLS API: serde, sign/verify, aggregation, signature-set batches.
+
+Mirrors the reference's bls conformance surface (the seven ef-test BLS
+handlers: verify, aggregate_verify, fast_aggregate_verify, eth variants,
+aggregation — testing/ef_tests/src/cases/bls_*.rs) with locally generated
+vectors (no network), plus wire-format edge cases.
+"""
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.bls.point_serde import DecodeError, g1_compress, g1_decompress
+from lighthouse_tpu.crypto.constants import R
+from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
+
+
+def kp(i):
+    return bls.interop_keypairs(i + 1)[i]
+
+
+def test_keygen_deterministic():
+    a = bls.interop_keypairs(3)
+    b = bls.interop_keypairs(3)
+    assert [x.pk.to_bytes() for x in a] == [x.pk.to_bytes() for x in b]
+    assert len({x.pk.to_bytes() for x in a}) == 3
+
+
+def test_pubkey_serde_roundtrip():
+    pk = kp(0).pk
+    data = pk.to_bytes()
+    assert len(data) == 48
+    pk2 = bls.PublicKey.from_bytes(data)
+    assert pk == pk2
+
+
+def test_infinity_pubkey_rejected():
+    with pytest.raises(bls.BlsError):
+        bls.PublicKey.from_bytes(bls.INFINITY_PUBKEY_BYTES)
+
+
+def test_non_subgroup_pubkey_rejected():
+    # find an x whose curve point is NOT in the r-subgroup
+    x = 0
+    while True:
+        x += 1
+        try:
+            pt = g1_decompress(
+                bytes([0x80 | (x >> 376 if False else 0)])
+                + x.to_bytes(47, "big")
+            )
+        except DecodeError:
+            continue
+        if not G1_GROUP.in_subgroup(pt):
+            data = g1_compress(pt)
+            break
+    with pytest.raises(bls.BlsError):
+        bls.PublicKey.from_bytes(data)
+
+
+def test_sign_verify_roundtrip():
+    pair = kp(1)
+    msg = b"\x01" * 32
+    sig = pair.sk.sign(msg)
+    assert len(sig.to_bytes()) == 96
+    assert bls.verify(pair.pk, msg, sig)
+    assert not bls.verify(pair.pk, b"\x02" * 32, sig)
+    assert not bls.verify(kp(2).pk, msg, sig)
+    # serde roundtrip preserves verification
+    sig2 = bls.Signature.from_bytes(sig.to_bytes())
+    assert bls.verify(pair.pk, msg, sig2)
+
+
+def test_fast_aggregate_verify():
+    msg = b"\x05" * 32
+    pairs = bls.interop_keypairs(4)
+    sigs = [p.sk.sign(msg) for p in pairs]
+    agg = bls.aggregate_signatures(sigs)
+    pks = [p.pk for p in pairs]
+    assert bls.fast_aggregate_verify(pks, msg, agg)
+    assert not bls.fast_aggregate_verify(pks[:3], msg, agg)
+    assert not bls.fast_aggregate_verify([], msg, agg)
+
+
+def test_eth_fast_aggregate_verify_infinity_special_case():
+    inf_sig = bls.Signature.from_bytes(bls.INFINITY_SIGNATURE_BYTES)
+    assert bls.eth_fast_aggregate_verify([], b"msg", inf_sig)
+    assert not bls.fast_aggregate_verify([], b"msg", inf_sig)
+
+
+def test_aggregate_verify_distinct_messages():
+    pairs = bls.interop_keypairs(3)
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    sigs = [p.sk.sign(m) for p, m in zip(pairs, msgs)]
+    agg = bls.aggregate_signatures(sigs)
+    assert bls.aggregate_verify([p.pk for p in pairs], msgs, agg)
+    bad = list(msgs)
+    bad[1] = b"\xff" * 32
+    assert not bls.aggregate_verify([p.pk for p in pairs], bad, agg)
+
+
+def test_verify_signature_sets_ref_backend():
+    pairs = bls.interop_keypairs(3)
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    sets = []
+    for p, m in zip(pairs, msgs):
+        sets.append(bls.SignatureSet(p.sk.sign(m), [p.pk], m))
+    # multi-pubkey set
+    shared = b"\x09" * 32
+    agg = bls.aggregate_signatures([p.sk.sign(shared) for p in pairs])
+    sets.append(bls.SignatureSet(agg, [p.pk for p in pairs], shared))
+
+    assert bls.verify_signature_sets(sets, backend="ref")
+    assert bls.verify_signature_sets(sets, backend="fake")
+    assert not bls.verify_signature_sets([], backend="ref")
+
+    # corrupt one set
+    bad = list(sets)
+    bad[1] = bls.SignatureSet(sets[0].signature, [pairs[1].pk], msgs[1])
+    assert not bls.verify_signature_sets(bad, backend="ref")
+
+
+def test_secret_key_bounds():
+    with pytest.raises(bls.BlsError):
+        bls.SecretKey(0)
+    with pytest.raises(bls.BlsError):
+        bls.SecretKey(R)
+    sk = bls.SecretKey.from_bytes((1).to_bytes(32, "big"))
+    assert sk.public_key() is not None

@@ -1,0 +1,116 @@
+"""Device Jacobian point arithmetic vs the pure-Python reference groups."""
+
+import random
+
+import jax
+import numpy as np
+
+from lighthouse_tpu.crypto import constants as C
+from lighthouse_tpu.crypto import ref_curve
+from lighthouse_tpu.ops import curve
+
+rng = random.Random(42)
+
+
+def rand_ref_points(group, n):
+    return [
+        group.mul_scalar(group.generator, rng.randrange(1, C.R))
+        for _ in range(n)
+    ]
+
+
+def _check_batch(dev_group, ref_group, dev_pts, expected_ref_pts, unpack):
+    got = unpack(dev_pts)
+    for g, e in zip(got, expected_ref_pts):
+        assert ref_group.eq(g, e)
+
+
+def test_g1_add_double_matches_reference():
+    pts_a = rand_ref_points(ref_curve.G1, 4)
+    pts_b = rand_ref_points(ref_curve.G1, 4)
+    da, db = curve.g1_pack(pts_a), curve.g1_pack(pts_b)
+    added = jax.jit(curve.G1.add)(da, db)
+    doubled = jax.jit(curve.G1.double)(da)
+    _check_batch(
+        curve.G1,
+        ref_curve.G1,
+        added,
+        [ref_curve.G1.add(a, b) for a, b in zip(pts_a, pts_b)],
+        curve.g1_unpack,
+    )
+    _check_batch(
+        curve.G1,
+        ref_curve.G1,
+        doubled,
+        [ref_curve.G1.double(a) for a in pts_a],
+        curve.g1_unpack,
+    )
+
+
+def test_g1_add_edge_cases():
+    g = ref_curve.G1.generator
+    inf = ref_curve.G1.infinity
+    cases_a = [g, inf, g, g, inf]
+    cases_b = [inf, g, g, ref_curve.G1.neg(g), inf]
+    expect = [g, g, ref_curve.G1.double(g), inf, inf]
+    da, db = curve.g1_pack(cases_a), curve.g1_pack(cases_b)
+    out = jax.jit(curve.G1.add)(da, db)
+    got = curve.g1_unpack(out)
+    for g_out, e in zip(got, expect):
+        assert ref_curve.G1.eq(g_out, e)
+
+
+def test_g2_add_double_matches_reference():
+    pts_a = rand_ref_points(ref_curve.G2, 3)
+    pts_b = rand_ref_points(ref_curve.G2, 3)
+    da, db = curve.g2_pack(pts_a), curve.g2_pack(pts_b)
+    added = jax.jit(curve.G2.add)(da, db)
+    _check_batch(
+        curve.G2,
+        ref_curve.G2,
+        added,
+        [ref_curve.G2.add(a, b) for a, b in zip(pts_a, pts_b)],
+        curve.g2_unpack,
+    )
+
+
+def test_g1_scalar_mul_variable():
+    pts = rand_ref_points(ref_curve.G1, 4)
+    scalars = [rng.randrange(1 << 64) for _ in range(3)] + [0]
+    dev = curve.g1_pack(pts)
+    bits = curve.scalars_to_bits(scalars, 64)
+    out = jax.jit(curve.G1.mul_scalar_bits)(dev, bits)
+    got = curve.g1_unpack(out)
+    for g, p, s in zip(got, pts, scalars):
+        assert ref_curve.G1.eq(g, ref_curve.G1.mul_scalar(p, s))
+
+
+def test_g1_scalar_mul_static_and_eq():
+    pts = rand_ref_points(ref_curve.G1, 2)
+    dev = curve.g1_pack(pts)
+    k = 0xDEADBEEFCAFE
+    out = jax.jit(lambda p: curve.G1.mul_scalar_static(p, k))(dev)
+    got = curve.g1_unpack(out)
+    for g, p in zip(got, pts):
+        assert ref_curve.G1.eq(g, ref_curve.G1.mul_scalar(p, k))
+    # device eq
+    assert bool(np.all(np.asarray(curve.G1.eq(dev, dev))))
+    assert not bool(np.any(np.asarray(curve.G1.eq(dev, curve.G1.double(dev)))))
+
+
+def test_g1_sum_and_masked_sum():
+    pts = rand_ref_points(ref_curve.G1, 5)
+    dev = curve.g1_pack(pts)
+    total = jax.jit(lambda p: curve.G1.sum_axis(p, axis=0))(dev)
+    ref_total = ref_curve.G1.infinity
+    for p in pts:
+        ref_total = ref_curve.G1.add(ref_total, p)
+    assert ref_curve.G1.eq(curve.g1_unpack(total)[0], ref_total)
+
+    mask = np.array([True, False, True, True, False])
+    msum = jax.jit(lambda p: curve.G1.masked_sum_axis(p, mask, axis=0))(dev)
+    ref_msum = ref_curve.G1.infinity
+    for p, m in zip(pts, mask):
+        if m:
+            ref_msum = ref_curve.G1.add(ref_msum, p)
+    assert ref_curve.G1.eq(curve.g1_unpack(msum)[0], ref_msum)

@@ -1,0 +1,118 @@
+"""Proto-array fork choice: LMD-GHOST weights, reorgs, viability, pruning.
+
+Mirrors the scenarios of the reference's fork-choice spec tests
+(ef_tests fork_choice handler: scripted block/attestation sequences) with
+hand-built trees.
+"""
+
+import pytest
+
+from lighthouse_tpu.fork_choice import ForkChoice, ProtoArray
+from lighthouse_tpu.fork_choice.proto_array import ProtoArrayError
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+def r(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def make_fc(spec=None):
+    spec = spec or minimal_spec()
+    fc = ForkChoice(
+        genesis_root=r(0),
+        genesis_slot=0,
+        justified_checkpoint=(0, r(0)),
+        finalized_checkpoint=(0, r(0)),
+        spec=spec,
+    )
+    return fc
+
+
+def test_single_chain_head():
+    fc = make_fc()
+    fc.set_slot(3)
+    fc.on_block(1, r(1), r(0), (0, r(0)), (0, r(0)))
+    fc.on_block(2, r(2), r(1), (0, r(0)), (0, r(0)))
+    head = fc.get_head([32] * 8)
+    assert head == r(2)
+
+
+def test_votes_pick_heavier_fork():
+    fc = make_fc()
+    fc.set_slot(2)
+    # two children of genesis
+    fc.on_block(1, r(1), r(0), (0, r(0)), (0, r(0)))
+    fc.on_block(1, r(2), r(0), (0, r(0)), (0, r(0)))
+    balances = [32] * 10
+    # 3 votes for r(1), 6 votes for r(2)
+    fc.on_attestation([0, 1, 2], r(1), 0)
+    fc.on_attestation([3, 4, 5, 6, 7, 8], r(2), 0)
+    assert fc.get_head(balances) == r(2)
+    # votes move: 5 validators switch to r(1)
+    fc.on_attestation([3, 4, 5, 6, 7], r(1), 1)
+    fc.set_slot(8)  # epoch 1 arrives so the new votes count
+    assert fc.get_head(balances) == r(1)
+
+
+def test_tie_breaks_by_root():
+    fc = make_fc()
+    fc.set_slot(1)
+    fc.on_block(1, r(1), r(0), (0, r(0)), (0, r(0)))
+    fc.on_block(1, r(2), r(0), (0, r(0)), (0, r(0)))
+    # no votes: equal weight, larger root wins
+    assert fc.get_head([32] * 4) == r(2)
+
+
+def test_unknown_parent_rejected():
+    fc = make_fc()
+    fc.set_slot(5)
+    with pytest.raises(Exception):
+        fc.on_block(1, r(9), r(8), (0, r(0)), (0, r(0)))
+
+
+def test_future_block_rejected():
+    fc = make_fc()
+    with pytest.raises(Exception):
+        fc.on_block(5, r(1), r(0), (0, r(0)), (0, r(0)))
+
+
+def test_justified_viability_filters_forks():
+    fc = make_fc()
+    fc.set_slot(10)
+    fc.on_block(1, r(1), r(0), (0, r(0)), (0, r(0)))
+    fc.on_block(2, r(2), r(1), (1, r(1)), (0, r(0)))  # justifies epoch 1
+    fc.on_block(2, r(3), r(1), (0, r(0)), (0, r(0)))
+    # lots of votes on the non-justifying fork
+    fc.on_attestation(list(range(8)), r(3), 0)
+    # head must still be found from the justified root's subtree
+    head = fc.get_head([32] * 8)
+    assert head in (r(2), r(3))
+    # once justified checkpoint advances, only r(2)'s branch is viable
+    assert fc.justified_checkpoint == (1, r(1))
+    head2 = fc.get_head([32] * 8)
+    assert head2 == r(2)
+
+
+def test_prune_keeps_finalized_subtree():
+    pa = ProtoArray(justified_epoch=0, finalized_epoch=0)
+    pa.on_block(0, r(0), None, 0, 0)
+    pa.on_block(1, r(1), r(0), 0, 0)
+    pa.on_block(2, r(2), r(1), 0, 0)
+    pa.on_block(1, r(9), r(0), 0, 0)  # stale fork
+    pa.prune(r(1))
+    assert set(pa.indices) == {r(1), r(2)}
+    assert pa.find_head(r(1)) == r(2)
+    with pytest.raises(ProtoArrayError):
+        pa.find_head(r(0))
+
+
+def test_balance_changes_reflected():
+    fc = make_fc()
+    fc.set_slot(1)
+    fc.on_block(1, r(1), r(0), (0, r(0)), (0, r(0)))
+    fc.on_block(1, r(2), r(0), (0, r(0)), (0, r(0)))
+    fc.on_attestation([0], r(1), 0)
+    fc.on_attestation([1], r(2), 0)
+    assert fc.get_head([64, 32]) == r(1)
+    # validator 0's balance collapses; same votes now favor r(2)
+    assert fc.get_head([8, 32]) == r(2)

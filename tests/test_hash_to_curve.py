@@ -1,0 +1,133 @@
+"""Hash-to-curve self-validation.
+
+No external vectors are available in this environment, so correctness is
+established through mathematical identities that pin down each stage:
+  * SSWU output lies on E' (y^2 = x^3 + A x + B)
+  * the isogeny carries arbitrary E' points onto E (y^2 = x^3 + 4(1+u)) —
+    a wrong coefficient table cannot produce a curve-to-curve map
+  * psi is an endomorphism acting as multiplication by the BLS parameter x
+    on G2 (p == x mod r), pinning the twist constants
+  * cleared outputs are r-torsion and non-infinity
+  * determinism + message sensitivity
+"""
+
+import random
+
+from lighthouse_tpu.bls import hash_to_curve as h2c
+from lighthouse_tpu.crypto import ref_fields as ff
+from lighthouse_tpu.crypto.constants import BLS_X, P, R
+from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
+
+rng = random.Random(31337)
+
+
+def rand_fp2():
+    return (rng.randrange(P), rng.randrange(P))
+
+
+def on_e_prime(pt):
+    x, y = pt
+    return ff.fp2_sqr(y) == h2c._g_prime(x)
+
+
+def on_e(pt):
+    x, y = pt
+    rhs = ff.fp2_add(ff.fp2_mul(ff.fp2_sqr(x), x), (4, 4))
+    return ff.fp2_sqr(y) == rhs
+
+
+def rand_e_prime_point():
+    while True:
+        x = rand_fp2()
+        rhs = h2c._g_prime(x)
+        y = ff.fp2_sqrt(rhs)
+        if y is not None:
+            return (x, y)
+
+
+def test_sswu_lands_on_e_prime():
+    for _ in range(8):
+        u = rand_fp2()
+        pt = h2c.map_to_curve_sswu(u)
+        assert on_e_prime(pt)
+
+
+def test_isogeny_maps_e_prime_to_e():
+    for _ in range(8):
+        pt = rand_e_prime_point()
+        assert on_e(h2c.iso_map(pt))
+
+
+def test_isogeny_is_homomorphism():
+    # phi(P + Q) == phi(P) + phi(Q) for random E' points (checked via
+    # the group law on each side) — pins the map as a true isogeny, not
+    # just a curve-to-curve correspondence.
+    class EPrime:
+        pass
+
+    from lighthouse_tpu.crypto.ref_curve import CurveGroup, Fp2Field
+
+    e_prime = CurveGroup.__new__(CurveGroup)
+    e_prime.F = Fp2Field
+    e_prime.b = None  # unused for add/double with generic formulas? no —
+    # CurveGroup.add/double do not reference b, only eq/is_on_curve do.
+    e_prime.name = "E'"
+    e_prime.cofactor = 1
+
+    # E' has a*x term, so the generic b-only double() formula (a=0) does
+    # NOT apply. Use chord-only addition on distinct points instead.
+    p = rand_e_prime_point()
+    q = rand_e_prime_point()
+    # affine chord addition on E' (valid for p != +-q)
+    lam = ff.fp2_mul(
+        ff.fp2_sub(q[1], p[1]), ff.fp2_inv(ff.fp2_sub(q[0], p[0]))
+    )
+    xr = ff.fp2_sub(ff.fp2_sub(ff.fp2_sqr(lam), p[0]), q[0])
+    yr = ff.fp2_sub(ff.fp2_mul(lam, ff.fp2_sub(p[0], xr)), p[1])
+    sum_on_eprime = (xr, yr)
+
+    phi_sum = h2c.iso_map(sum_on_eprime)
+    phi_p = G2_GROUP.from_affine(h2c.iso_map(p))
+    phi_q = G2_GROUP.from_affine(h2c.iso_map(q))
+    expect = G2_GROUP.to_affine(G2_GROUP.add(phi_p, phi_q))
+    assert phi_sum == expect
+
+
+def test_psi_acts_as_mul_by_x_on_g2():
+    # random G2 point: cofactor-clear a random E point via scalar mul by h2
+    from lighthouse_tpu.crypto.constants import H2
+
+    pt = rand_e_prime_point()
+    g2_pt = G2_GROUP.mul_scalar(G2_GROUP.from_affine(h2c.iso_map(pt)), H2)
+    assert G2_GROUP.in_subgroup(g2_pt)
+    aff = G2_GROUP.to_affine(g2_pt)
+    psi_pt = G2_GROUP.from_affine(h2c.psi(aff))
+    expect = G2_GROUP.mul_scalar(g2_pt, BLS_X % R)
+    assert G2_GROUP.eq(psi_pt, expect)
+    # psi2 == psi . psi
+    psi2_pt = h2c.psi2(aff)
+    assert psi2_pt == h2c.psi(h2c.psi(aff))
+
+
+def test_clear_cofactor_lands_in_subgroup():
+    pt = rand_e_prime_point()
+    on_e_pt = h2c.iso_map(pt)
+    cleared = h2c.clear_cofactor(on_e_pt)
+    assert not G2_GROUP.is_infinity(cleared)
+    assert G2_GROUP.in_subgroup(cleared)
+
+
+def test_hash_to_g2_deterministic_and_sensitive():
+    a1 = h2c.hash_to_g2(b"message one")
+    a2 = h2c.hash_to_g2(b"message one")
+    b1 = h2c.hash_to_g2(b"message two")
+    assert G2_GROUP.eq(a1, a2)
+    assert not G2_GROUP.eq(a1, b1)
+    assert G2_GROUP.in_subgroup(a1)
+
+
+def test_expand_message_xmd_shape():
+    out = h2c.expand_message_xmd(b"abc", b"QUUX-V01-CS02", 0x80)
+    assert len(out) == 0x80
+    out2 = h2c.expand_message_xmd(b"abc", b"QUUX-V01-CS02", 32)
+    assert out[:32] != out2  # length is bound into the hash

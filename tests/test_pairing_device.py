@@ -1,0 +1,94 @@
+"""Device pairing vs the pure-Python reference pairing.
+
+The device Miller loop uses different (inversion-free) line scalings, so
+intermediate values differ from ref_pairing; equality is checked after the
+final exponentiation, where subfield scalings are annihilated.
+"""
+
+import random
+
+import jax
+import numpy as np
+
+from lighthouse_tpu.crypto import constants as C
+from lighthouse_tpu.crypto import ref_pairing
+from lighthouse_tpu.crypto.ref_curve import G1 as RG1
+from lighthouse_tpu.crypto.ref_curve import G2 as RG2
+from lighthouse_tpu.ops import curve, fp, fp2, pairing, tower
+
+rng = random.Random(777)
+
+
+def pack_g1_affine(pts):
+    """Affine ref G1 points [(x, y), ...] -> device Montgomery Fp pairs."""
+    px = fp.to_mont(fp.pack([p[0] for p in pts]))
+    py = fp.to_mont(fp.pack([p[1] for p in pts]))
+    return (px, py)
+
+
+def pack_g2_affine(pts):
+    qx = fp2.to_mont(fp2.pack([p[0] for p in pts]))
+    qy = fp2.to_mont(fp2.pack([p[1] for p in pts]))
+    return (qx, qy)
+
+
+def test_pairing_matches_reference():
+    a = rng.randrange(2, 1 << 32)
+    b = rng.randrange(2, 1 << 32)
+    p1 = RG1.to_affine(RG1.mul_scalar(RG1.generator, a))
+    q1 = RG2.to_affine(RG2.mul_scalar(RG2.generator, b))
+    p2 = RG1.to_affine(RG1.generator)
+    q2 = RG2.to_affine(RG2.generator)
+
+    dev = jax.jit(pairing.pairing)(
+        pack_g1_affine([p1, p2]), pack_g2_affine([q1, q2])
+    )
+    got = tower.fp12_unpack(dev)
+    assert got[0] == ref_pairing.pairing(p1, q1)
+    assert got[1] == ref_pairing.pairing(p2, q2)
+    # bilinearity across the two computed values:
+    # e(aP, bQ) == e(P, Q)^(ab)
+    import lighthouse_tpu.crypto.ref_fields as ff
+
+    assert got[0] == ff.fp12_pow(got[1], a * b)
+
+
+def test_multi_pairing_is_one_signature_identity():
+    # BLS identity: e(pk, H) * e(-G1, sig) == 1 for pk = sk*G1, sig = sk*H.
+    sk = rng.randrange(2, C.R)
+    h = RG2.mul_scalar(RG2.generator, rng.randrange(2, C.R))  # stand-in H(m)
+    pk = RG1.to_affine(RG1.mul_scalar(RG1.generator, sk))
+    sig = RG2.to_affine(RG2.mul_scalar(h, sk))
+    neg_g1 = RG1.to_affine(RG1.neg(RG1.generator))
+    h_aff = RG2.to_affine(h)
+
+    fn = jax.jit(pairing.multi_pairing_is_one)
+    ok = fn(
+        pack_g1_affine([pk, neg_g1]), pack_g2_affine([h_aff, sig])
+    )
+    assert bool(np.asarray(ok))
+
+    # flip one bit of the message point -> must fail
+    h_bad = RG2.to_affine(RG2.mul_scalar(RG2.generator, 12345))
+    bad = fn(pack_g1_affine([pk, neg_g1]), pack_g2_affine([h_bad, sig]))
+    assert not bool(np.asarray(bad))
+
+
+def test_multi_pairing_mask_skips_invalid_pairs():
+    sk = rng.randrange(2, C.R)
+    h = RG2.mul_scalar(RG2.generator, 99)
+    pk = RG1.to_affine(RG1.mul_scalar(RG1.generator, sk))
+    sig = RG2.to_affine(RG2.mul_scalar(h, sk))
+    neg_g1 = RG1.to_affine(RG1.neg(RG1.generator))
+    h_aff = RG2.to_affine(h)
+    # third pair is garbage but masked out
+    garbage_g1 = (0, 0)
+    garbage_g2 = ((0, 0), (0, 0))
+
+    mask = np.array([True, True, False])
+    ok = jax.jit(pairing.multi_pairing_is_one)(
+        pack_g1_affine([pk, neg_g1, garbage_g1]),
+        pack_g2_affine([h_aff, sig, garbage_g2]),
+        mask,
+    )
+    assert bool(np.asarray(ok))

@@ -1,0 +1,171 @@
+"""SSZ codec + merkleization conformance.
+
+Known-answer vectors are taken from the published SSZ spec examples and
+independently computable identities (zero-hash towers, packed-chunk roots),
+plus roundtrip properties over randomized values.
+"""
+
+import hashlib
+import random
+
+from lighthouse_tpu import ssz
+from lighthouse_tpu.ssz.codec import UInt
+
+rng = random.Random(11)
+
+
+def sha(data):
+    return hashlib.sha256(data).digest()
+
+
+# ------------------------------------------------------------ wire encoding
+
+
+def test_uint_encoding():
+    assert ssz.uint8.encode(5) == b"\x05"
+    assert ssz.uint16.encode(0x0102) == b"\x02\x01"
+    assert ssz.uint64.encode(0x0102030405060708) == bytes(
+        [8, 7, 6, 5, 4, 3, 2, 1]
+    )
+    assert ssz.uint64.decode(ssz.uint64.encode(2**64 - 1)) == 2**64 - 1
+
+
+def test_fixed_vector_roundtrip():
+    v = ssz.Vector(ssz.uint16, 3)
+    enc = v.encode([1, 2, 3])
+    assert enc == b"\x01\x00\x02\x00\x03\x00"
+    assert v.decode(enc) == [1, 2, 3]
+
+
+def test_variable_list_offsets():
+    inner = ssz.List(ssz.uint8, 10)
+    outer = ssz.List(inner, 4)
+    val = [[1, 2], [], [3]]
+    enc = outer.encode(val)
+    # 3 offsets of 4 bytes = 12, then [1,2] at 12, [] at 14, [3] at 14
+    assert enc[:4] == (12).to_bytes(4, "little")
+    assert enc[4:8] == (14).to_bytes(4, "little")
+    assert enc[8:12] == (14).to_bytes(4, "little")
+    assert outer.decode(enc) == val
+
+
+def test_bitlist_roundtrip_and_delimiter():
+    bl = ssz.Bitlist(8)
+    assert bl.encode([]) == b"\x01"
+    assert bl.encode([True, False, True]) == bytes([0b1101])
+    assert bl.decode(bl.encode([True] * 8)) == [True] * 8
+    for n in range(9):
+        bits = [bool(rng.getrandbits(1)) for _ in range(n)]
+        assert bl.decode(bl.encode(bits)) == bits
+
+
+def test_bitvector_roundtrip():
+    bv = ssz.Bitvector(10)
+    bits = [bool(rng.getrandbits(1)) for _ in range(10)]
+    assert bv.decode(bv.encode(bits)) == bits
+
+
+class Checkpoint(ssz.Container):
+    epoch: ssz.uint64
+    root: ssz.bytes32
+
+
+class Wrapper(ssz.Container):
+    a: ssz.uint8
+    items: ssz.List(ssz.uint64, 16)
+    cp: Checkpoint
+
+
+def test_container_roundtrip():
+    w = Wrapper(
+        a=7,
+        items=[1, 2, 3],
+        cp=Checkpoint(epoch=5, root=b"\x11" * 32),
+    )
+    enc = w.to_bytes()
+    back = Wrapper.decode(enc)
+    assert back == w
+    # fixed part: 1 (a) + 4 (offset) + 40 (checkpoint) = 45
+    assert enc[1:5] == (45).to_bytes(4, "little")
+
+
+# ----------------------------------------------------------- hash tree root
+
+
+def test_htr_uint64():
+    assert ssz.uint64.hash_tree_root(3) == (3).to_bytes(8, "little") + b"\x00" * 24
+
+
+def test_htr_packed_vector():
+    # Vector[uint64, 4] fits one chunk: root == packed chunk
+    v = ssz.Vector(ssz.uint64, 4)
+    expect = b"".join(i.to_bytes(8, "little") for i in (1, 2, 3, 4))
+    assert v.hash_tree_root([1, 2, 3, 4]) == expect
+
+    # Vector[uint64, 8] = two chunks hashed together
+    v8 = ssz.Vector(ssz.uint64, 8)
+    vals = list(range(1, 9))
+    c0 = b"".join(i.to_bytes(8, "little") for i in vals[:4])
+    c1 = b"".join(i.to_bytes(8, "little") for i in vals[4:])
+    assert v8.hash_tree_root(vals) == sha(c0 + c1)
+
+
+def test_htr_list_mixes_length():
+    lst = ssz.List(ssz.uint64, 4)  # limit 4 -> one chunk
+    packed = (1).to_bytes(8, "little") + b"\x00" * 24
+    expect = sha(packed + (1).to_bytes(32, "little"))
+    assert lst.hash_tree_root([1]) == expect
+
+    # empty list: zero chunk + length 0
+    expect_empty = sha(b"\x00" * 32 + (0).to_bytes(32, "little"))
+    assert lst.hash_tree_root([]) == expect_empty
+
+
+def test_htr_container():
+    cp = Checkpoint(epoch=2, root=b"\x22" * 32)
+    leaf0 = (2).to_bytes(8, "little") + b"\x00" * 24
+    leaf1 = b"\x22" * 32
+    assert Checkpoint.hash_tree_root(cp) == sha(leaf0 + leaf1)
+
+
+def test_htr_list_of_containers_uses_limit_depth():
+    lst = ssz.List(Checkpoint, 4)
+    cp = Checkpoint(epoch=1, root=b"\x01" * 32)
+    r = Checkpoint.hash_tree_root(cp)
+    z0 = b"\x00" * 32
+    z1 = sha(z0 + z0)
+    layer = sha(sha(r + z0) + z1)
+    assert lst.hash_tree_root([cp]) == sha(
+        layer + (1).to_bytes(32, "little")
+    )
+
+
+def test_zero_hash_tower():
+    assert ssz.zero_hash(0) == b"\x00" * 32
+    assert ssz.zero_hash(2) == sha(sha(b"\x00" * 64) * 2)
+
+
+def test_merkle_proof_roundtrip():
+    chunks = [bytes([i]) * 32 for i in range(5)]
+    root = ssz.merkleize_chunks(chunks, limit=8)
+    for idx in range(5):
+        proof = ssz.merkle_proof(chunks, idx, limit=8)
+        assert ssz.verify_merkle_proof(chunks[idx], proof, idx, root)
+    bad = ssz.merkle_proof(chunks, 0, limit=8)
+    assert not ssz.verify_merkle_proof(chunks[1], bad, 0, root)
+
+
+def test_container_copy_is_deep():
+    w = Wrapper(a=1, items=[1], cp=Checkpoint(epoch=9, root=b"\x00" * 32))
+    w2 = w.copy()
+    w2.items.append(5)
+    w2.cp.epoch = 10
+    assert w.items == [1]
+    assert w.cp.epoch == 9
+
+
+def test_union():
+    u = ssz.Union([None, ssz.uint16])
+    assert u.encode((0, None)) == b"\x00"
+    assert u.encode((1, 7)) == b"\x01\x07\x00"
+    assert u.decode(b"\x01\x07\x00") == (1, 7)

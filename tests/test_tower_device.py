@@ -1,0 +1,87 @@
+"""Device Fp6/Fp12 tower vs the pure-Python reference."""
+
+import random
+
+import jax
+import numpy as np
+
+from lighthouse_tpu.crypto import ref_fields as ff
+from lighthouse_tpu.crypto.constants import P
+from lighthouse_tpu.ops import fp2, tower
+
+rng = random.Random(5)
+
+
+def rand_fp2():
+    return (rng.randrange(P), rng.randrange(P))
+
+
+def rand_fp6():
+    return (rand_fp2(), rand_fp2(), rand_fp2())
+
+
+def rand_fp12(n):
+    return [(rand_fp6(), rand_fp6()) for _ in range(n)]
+
+
+def fp6_pack(vals):
+    return tuple(
+        fp2.to_mont(fp2.pack([v[i] for v in vals])) for i in range(3)
+    )
+
+
+def fp6_unpack(a):
+    comps = [fp2.to_ints(fp2.from_mont(c)) for c in a]
+    return list(zip(*comps))
+
+
+def test_fp6_mul_inv():
+    a_vals = [rand_fp6() for _ in range(4)]
+    b_vals = [rand_fp6() for _ in range(4)]
+    a, b = fp6_pack(a_vals), fp6_pack(b_vals)
+    prod = fp6_unpack(jax.jit(tower.fp6_mul)(a, b))
+    invs = fp6_unpack(jax.jit(tower.fp6_inv)(a))
+    for i in range(4):
+        assert prod[i] == ff.fp6_mul(a_vals[i], b_vals[i])
+        assert invs[i] == ff.fp6_inv(a_vals[i])
+
+
+def test_fp12_mul_sqr_conj_inv():
+    a_vals = rand_fp12(3)
+    b_vals = rand_fp12(3)
+    a, b = tower.fp12_pack(a_vals), tower.fp12_pack(b_vals)
+    prod = tower.fp12_unpack(jax.jit(tower.fp12_mul)(a, b))
+    sq = tower.fp12_unpack(jax.jit(tower.fp12_sqr)(a))
+    cj = tower.fp12_unpack(jax.jit(tower.fp12_conj)(a))
+    iv = tower.fp12_unpack(jax.jit(tower.fp12_inv)(a))
+    for i in range(3):
+        assert prod[i] == ff.fp12_mul(a_vals[i], b_vals[i])
+        assert sq[i] == ff.fp12_sqr(a_vals[i])
+        assert cj[i] == ff.fp12_conj(a_vals[i])
+        assert iv[i] == ff.fp12_inv(a_vals[i])
+
+
+def test_fp12_frobenius():
+    a_vals = rand_fp12(2)
+    a = tower.fp12_pack(a_vals)
+    fr = tower.fp12_unpack(jax.jit(tower.fp12_frobenius)(a))
+    for i in range(2):
+        assert fr[i] == ff.fp12_frobenius(a_vals[i])
+
+
+def test_fp12_product_axis_and_is_one():
+    a_vals = rand_fp12(5)
+    a = tower.fp12_pack(a_vals)
+    prod = tower.fp12_unpack(
+        jax.tree_util.tree_map(
+            lambda t: t[None], jax.jit(tower.fp12_product_axis)(a)
+        )
+    )[0]
+    expect = ff.FP12_ONE
+    for v in a_vals:
+        expect = ff.fp12_mul(expect, v)
+    assert prod == expect
+
+    ones = tower.fp12_broadcast_one(a)
+    assert bool(np.all(np.asarray(tower.fp12_is_one(ones))))
+    assert not bool(np.any(np.asarray(tower.fp12_is_one(a))))
