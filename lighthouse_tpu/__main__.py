@@ -1,0 +1,3 @@
+from lighthouse_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,7 @@
+from lighthouse_tpu.accounts.key_derivation import (  # noqa: F401
+    derive_child_sk,
+    derive_master_sk,
+    derive_path,
+    mnemonic_to_seed,
+)
+from lighthouse_tpu.accounts.keystore import Keystore  # noqa: F401

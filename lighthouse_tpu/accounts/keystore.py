@@ -1,0 +1,157 @@
+"""EIP-2335 encrypted BLS keystores (scrypt/PBKDF2 + AES-128-CTR).
+
+Role of the reference's crypto/eth2_keystore (keystore.rs: EIP-2335 JSON
+keystores used for every validator key at rest). Encrypt/decrypt round
+trips are validated against the EIP's published structure: a KDF module
+(scrypt or pbkdf2), sha256 checksum over dk[16:32] || ciphertext, and
+AES-128-CTR cipher with the first 16 bytes of the derived key.
+"""
+
+import hashlib
+import json
+import os
+import unicodedata
+import uuid
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+
+def _normalize_password(password: str) -> bytes:
+    norm = unicodedata.normalize("NFKD", password)
+    stripped = "".join(
+        c for c in norm if ord(c) >= 0x20 and ord(c) != 0x7F
+    )
+    return stripped.encode("utf-8")
+
+
+def _aes128ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
+    cipher = Cipher(algorithms.AES(key16), modes.CTR(iv16))
+    enc = cipher.encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+class KeystoreError(ValueError):
+    pass
+
+
+class Keystore:
+    def __init__(self, doc: dict):
+        self.doc = doc
+
+    # ------------------------------------------------------------ encrypt
+
+    @classmethod
+    def encrypt(
+        cls,
+        secret: bytes,
+        password: str,
+        path: str = "",
+        kdf: str = "scrypt",
+        pubkey: bytes | None = None,
+    ) -> "Keystore":
+        salt = os.urandom(32)
+        iv = os.urandom(16)
+        pw = _normalize_password(password)
+        if kdf == "scrypt":
+            dk = hashlib.scrypt(
+                pw, salt=salt, n=2**18, r=8, p=1, dklen=32, maxmem=2**31 - 1
+            )
+            kdf_module = {
+                "function": "scrypt",
+                "params": {
+                    "dklen": 32,
+                    "n": 2**18,
+                    "r": 8,
+                    "p": 1,
+                    "salt": salt.hex(),
+                },
+                "message": "",
+            }
+        elif kdf == "pbkdf2":
+            dk = hashlib.pbkdf2_hmac("sha256", pw, salt, 262144, dklen=32)
+            kdf_module = {
+                "function": "pbkdf2",
+                "params": {
+                    "dklen": 32,
+                    "c": 262144,
+                    "prf": "hmac-sha256",
+                    "salt": salt.hex(),
+                },
+                "message": "",
+            }
+        else:
+            raise KeystoreError(f"unknown kdf {kdf}")
+        ciphertext = _aes128ctr(dk[:16], iv, secret)
+        checksum = hashlib.sha256(dk[16:32] + ciphertext).digest()
+        doc = {
+            "crypto": {
+                "kdf": kdf_module,
+                "checksum": {
+                    "function": "sha256",
+                    "params": {},
+                    "message": checksum.hex(),
+                },
+                "cipher": {
+                    "function": "aes-128-ctr",
+                    "params": {"iv": iv.hex()},
+                    "message": ciphertext.hex(),
+                },
+            },
+            "path": path,
+            "pubkey": pubkey.hex() if pubkey else "",
+            "uuid": str(uuid.uuid4()),
+            "version": 4,
+        }
+        return cls(doc)
+
+    # ------------------------------------------------------------ decrypt
+
+    def decrypt(self, password: str) -> bytes:
+        crypto = self.doc["crypto"]
+        kdf = crypto["kdf"]
+        pw = _normalize_password(password)
+        salt = bytes.fromhex(kdf["params"]["salt"])
+        if kdf["function"] == "scrypt":
+            p = kdf["params"]
+            dk = hashlib.scrypt(
+                pw,
+                salt=salt,
+                n=p["n"],
+                r=p["r"],
+                p=p["p"],
+                dklen=p["dklen"],
+                maxmem=2**31 - 1,
+            )
+        elif kdf["function"] == "pbkdf2":
+            p = kdf["params"]
+            dk = hashlib.pbkdf2_hmac(
+                "sha256", pw, salt, p["c"], dklen=p["dklen"]
+            )
+        else:
+            raise KeystoreError("unknown kdf")
+        ciphertext = bytes.fromhex(crypto["cipher"]["message"])
+        checksum = hashlib.sha256(dk[16:32] + ciphertext).digest()
+        if checksum.hex() != crypto["checksum"]["message"]:
+            raise KeystoreError("invalid password (checksum mismatch)")
+        iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+        return _aes128ctr(dk[:16], iv, ciphertext)
+
+    # --------------------------------------------------------------- json
+
+    def to_json(self) -> str:
+        return json.dumps(self.doc, indent=2)
+
+    @classmethod
+    def from_json(cls, payload: str) -> "Keystore":
+        doc = json.loads(payload)
+        if doc.get("version") != 4:
+            raise KeystoreError("unsupported keystore version")
+        return cls(doc)
+
+    @property
+    def pubkey_hex(self) -> str:
+        return self.doc.get("pubkey", "")
+
+    @property
+    def path(self) -> str:
+        return self.doc.get("path", "")

@@ -95,6 +95,45 @@ class BeaconChain:
         ]
         self.metrics = {"blocks_imported": 0, "attestations_processed": 0}
 
+        from lighthouse_tpu.beacon_chain.events import EventBus
+        from lighthouse_tpu.beacon_chain.validator_monitor import (
+            ValidatorMonitor,
+        )
+
+        self.events = EventBus()
+        self.validator_monitor = ValidatorMonitor()
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        anchor_state,
+        anchor_block,
+        spec: Spec,
+        kv=None,
+        backend: str = "ref",
+        slot_clock=None,
+    ):
+        """Checkpoint-sync boot (reference `ClientGenesis::WeakSubjSszBytes`,
+        client/src/config.rs:31-34): start from a trusted finalized state +
+        its block instead of genesis; history is backfilled separately
+        (SyncManager.run_backfill)."""
+        chain = cls(
+            anchor_state,
+            spec,
+            kv=kv,
+            backend=backend,
+            slot_clock=slot_clock,
+        )
+        root = type(anchor_block.message).hash_tree_root(
+            anchor_block.message
+        )
+        chain.store.put_block(root, anchor_block)
+        chain.store.set_canonical_block_root(
+            anchor_block.message.slot, root
+        )
+        chain.anchor_slot = anchor_state.slot
+        return chain
+
     # ------------------------------------------------------------ helpers
 
     def _header_root(self, state) -> bytes:
@@ -212,7 +251,8 @@ class BeaconChain:
             block.slot, block_root, parent_root, justified, finalized
         )
 
-        # register the block's attestations with fork choice
+        # register the block's attestations with fork choice + monitor
+        indexed_atts = []
         for att in block.body.attestations:
             try:
                 committee = self.committee_for(att.data)
@@ -227,6 +267,13 @@ class BeaconChain:
             indices = get_attesting_indices(
                 committee, att.aggregation_bits
             )
+            indexed_atts.append(
+                self.t.IndexedAttestation(
+                    attesting_indices=indices,
+                    data=att.data,
+                    signature=att.signature,
+                )
+            )
             try:
                 self.fork_choice.on_attestation(
                     indices,
@@ -238,7 +285,31 @@ class BeaconChain:
 
         self._cache_snapshot(block_root, state)
         self.metrics["blocks_imported"] += 1
+        self.validator_monitor.register_block(
+            block, indexed_atts, spec
+        )
+        old_finalized = self.finalized_checkpoint.epoch
         self.recompute_head()
+        self.events.publish(
+            "block",
+            {"slot": int(block.slot), "root": "0x" + block_root.hex()},
+        )
+        self.events.publish(
+            "head",
+            {
+                "slot": int(self.head_state.slot),
+                "root": "0x" + self.head_root.hex(),
+            },
+        )
+        new_fin = self.head_state.finalized_checkpoint
+        if new_fin.epoch > old_finalized:
+            self.events.publish(
+                "finalized_checkpoint",
+                {
+                    "epoch": int(new_fin.epoch),
+                    "root": "0x" + bytes(new_fin.root).hex(),
+                },
+            )
         return block_root
 
     def process_chain_segment(self, signed_blocks):

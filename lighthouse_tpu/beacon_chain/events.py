@@ -1,0 +1,40 @@
+"""Event bus: head/block/attestation/finalized_checkpoint streams.
+
+Role of the reference's SSE machinery (beacon_chain/src/events.rs +
+/eth/v1/events): subsystems publish typed events; subscribers (the SSE
+route, the validator client, tests) consume bounded queues.
+"""
+
+import queue
+import threading
+
+TOPICS = (
+    "head",
+    "block",
+    "attestation",
+    "finalized_checkpoint",
+    "chain_reorg",
+)
+
+
+class EventBus:
+    def __init__(self, capacity: int = 1024):
+        self._subs: dict[str, list] = {t: [] for t in TOPICS}
+        self._lock = threading.Lock()
+        self.capacity = capacity
+
+    def subscribe(self, topics):
+        q = queue.Queue(maxsize=self.capacity)
+        with self._lock:
+            for t in topics:
+                self._subs[t].append(q)
+        return q
+
+    def publish(self, topic: str, payload: dict):
+        with self._lock:
+            subs = list(self._subs.get(topic, ()))
+        for q in subs:
+            try:
+                q.put_nowait({"event": topic, "data": payload})
+            except queue.Full:
+                pass  # slow consumer loses events (bounded, as reference)

@@ -1,0 +1,72 @@
+"""Per-validator observability: attestation/block hit tracking.
+
+Role of the reference's `validator_monitor`
+(beacon_node/beacon_chain/src/validator_monitor.rs:1-26): registered
+validators get per-epoch hit/miss/delay tracking over a 4-epoch window,
+surfaced through logs and metrics.
+"""
+
+from collections import defaultdict
+
+HISTORIC_EPOCHS = 4
+
+
+class ValidatorMonitor:
+    def __init__(self, registered=()):
+        self.registered = set(registered)
+        # epoch -> validator -> {"attested": bool, "delay": int}
+        self._epochs: dict[int, dict] = defaultdict(dict)
+        self._proposals: dict[int, list] = defaultdict(list)
+
+    def register(self, *indices):
+        self.registered.update(indices)
+
+    def auto_register_all(self, n: int):
+        self.registered.update(range(n))
+
+    # ------------------------------------------------------------ feeding
+
+    def register_block(self, block, indexed_attestations, spec):
+        """Feed an imported block: credits attesters and the proposer."""
+        epoch = spec.slot_to_epoch(block.slot)
+        if block.proposer_index in self.registered:
+            self._proposals[epoch].append(block.proposer_index)
+        for indexed in indexed_attestations:
+            att_epoch = indexed.data.target.epoch
+            delay = block.slot - indexed.data.slot
+            for v in indexed.attesting_indices:
+                if v not in self.registered:
+                    continue
+                rec = self._epochs[att_epoch].setdefault(
+                    v, {"attested": False, "delay": None}
+                )
+                rec["attested"] = True
+                if rec["delay"] is None or delay < rec["delay"]:
+                    rec["delay"] = delay
+
+    def prune(self, current_epoch: int):
+        cutoff = current_epoch - HISTORIC_EPOCHS
+        for e in [e for e in self._epochs if e < cutoff]:
+            del self._epochs[e]
+        for e in [e for e in self._proposals if e < cutoff]:
+            del self._proposals[e]
+
+    # ------------------------------------------------------------ queries
+
+    def epoch_summary(self, epoch: int):
+        recs = self._epochs.get(epoch, {})
+        hits = [v for v in self.registered if recs.get(v, {}).get("attested")]
+        misses = [v for v in self.registered if v not in recs]
+        delays = [
+            recs[v]["delay"] for v in hits if recs[v]["delay"] is not None
+        ]
+        return {
+            "epoch": epoch,
+            "hits": len(hits),
+            "misses": len(misses),
+            "missed_validators": sorted(misses)[:16],
+            "mean_inclusion_delay": (
+                sum(delays) / len(delays) if delays else None
+            ),
+            "proposals": len(self._proposals.get(epoch, [])),
+        }

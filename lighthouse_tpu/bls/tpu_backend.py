@@ -21,7 +21,7 @@ import jax
 from lighthouse_tpu.bls.hash_to_curve import hash_to_g2
 from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
 from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
-from lighthouse_tpu.ops import batch_verify, curve, fp, fp2
+from lighthouse_tpu.ops import batch_verify, curve, fieldb as fb, fp2
 
 _jitted = None
 
@@ -41,16 +41,16 @@ def _bucket(n: int, minimum: int) -> int:
 
 
 def _pack_g1_affine(affs):
-    xs = fp.to_mont(fp.pack([a[0] if a else 0 for a in affs]))
-    ys = fp.to_mont(fp.pack([a[1] if a else 0 for a in affs]))
-    return xs, ys
+    xs = np.stack([fb.pack_ints([a[0] if a else 0]) for a in affs])
+    ys = np.stack([fb.pack_ints([a[1] if a else 0]) for a in affs])
+    return fb.to_mont(xs), fb.to_mont(ys)
 
 
 def _pack_g2_affine(affs):
     zero = ((0, 0), (0, 0))
-    xs = fp2.to_mont(fp2.pack([(a or zero)[0] for a in affs]))
-    ys = fp2.to_mont(fp2.pack([(a or zero)[1] for a in affs]))
-    return (xs, ys)
+    xs = fp2.pack([(a or zero)[0] for a in affs])
+    ys = fp2.pack([(a or zero)[1] for a in affs])
+    return (fb.to_mont(xs), fb.to_mont(ys))
 
 
 def verify_signature_sets_tpu(sets, seed: int | None = None) -> bool:
@@ -99,10 +99,9 @@ def verify_signature_sets_tpu(sets, seed: int | None = None) -> bool:
 
     pk_flat = [p for row in pk_rows for p in row]
     pk_x, pk_y = _pack_g1_affine(pk_flat)
-    nl = pk_x.shape[-1]
     pubkeys = (
-        pk_x.reshape(s_bucket, k_bucket, nl),
-        pk_y.reshape(s_bucket, k_bucket, nl),
+        np.asarray(pk_x).reshape(s_bucket, k_bucket, 1, fb.NB),
+        np.asarray(pk_y).reshape(s_bucket, k_bucket, 1, fb.NB),
     )
 
     ok = _get_fn()(

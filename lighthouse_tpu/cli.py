@@ -1,0 +1,303 @@
+"""CLI multiplexer: beacon node, validator client, accounts, dev tools.
+
+Role of the reference's `lighthouse` binary (lighthouse/src/main.rs:34
+subcommand multiplexer), account_manager, database_manager, and lcli (dev
+Swiss-army tools: transition-blocks, skip-slots, new-testnet, ssz parsing).
+
+    python -m lighthouse_tpu bn --network minimal --validators 32 --slots 16
+    python -m lighthouse_tpu vc ...
+    python -m lighthouse_tpu account new --password ... --out key.json
+    python -m lighthouse_tpu lcli skip-slots --slots 4
+    python -m lighthouse_tpu db inspect --path chain.sqlite
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def _spec_for(name: str, altair_epoch=None):
+    from lighthouse_tpu.types.spec import mainnet_spec, minimal_spec
+
+    overrides = {}
+    if altair_epoch is not None:
+        overrides["ALTAIR_FORK_EPOCH"] = altair_epoch
+    return (
+        minimal_spec(**overrides)
+        if name == "minimal"
+        else mainnet_spec(**overrides)
+    )
+
+
+def cmd_bn(args):
+    """Run a beacon node: interop genesis, optional self-proposing (dev
+    chain), HTTP API, per-slot timer loop."""
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.http_api import BeaconApiServer
+    from lighthouse_tpu.store import SqliteStore
+
+    spec = _spec_for(args.network)
+    h = Harness(
+        spec,
+        args.validators,
+        backend=args.bls_backend,
+        genesis_time=int(time.time()) if args.slots == 0 else 0,
+    )
+    kv = SqliteStore(args.datadir) if args.datadir else None
+    chain = BeaconChain(
+        h.state.copy(), spec, kv=kv, backend=args.bls_backend
+    )
+    srv = BeaconApiServer(chain, port=args.http_port).start()
+    print(f"HTTP API on 127.0.0.1:{srv.port}")
+    try:
+        if args.slots:
+            for slot in range(1, args.slots + 1):
+                block = h.advance_slot_with_block(slot)
+                chain.process_block(block)
+                chain.set_slot(slot)
+                print(
+                    f"slot {slot} head=0x{chain.head_root.hex()[:12]} "
+                    f"justified={chain.head_state.current_justified_checkpoint.epoch} "
+                    f"finalized={chain.finalized_checkpoint.epoch}"
+                )
+            print("dev chain complete")
+            if args.serve_seconds:
+                time.sleep(args.serve_seconds)
+        else:
+            while True:  # pragma: no cover
+                time.sleep(spec.SECONDS_PER_SLOT)
+    finally:
+        srv.stop()
+    return 0
+
+
+def cmd_vc(args):
+    """Run validator duties against an in-process dev node for N slots."""
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.validator_client import (
+        SlashingProtectionDB,
+        ValidatorClient,
+    )
+
+    spec = _spec_for(args.network)
+    h = Harness(spec, args.validators, backend=args.bls_backend)
+    chain = BeaconChain(h.state.copy(), spec, backend=args.bls_backend)
+    db = SlashingProtectionDB(args.slashing_db or ":memory:")
+    vc = ValidatorClient(
+        chain, dict(enumerate(h.keypairs)), slashing_db=db
+    )
+
+    def producer(slot, proposer):
+        blk = h.produce_block(slot, h.pending_attestations[:128])
+        h.pending_attestations = h.pending_attestations[128:]
+        return blk.message
+
+    for slot in range(1, args.slots + 1):
+        chain.set_slot(slot)
+        signed = vc.propose(slot, producer)
+        if signed is not None:
+            chain.process_block(signed)
+            h.import_block(signed)
+        atts = vc.attest(slot)
+        chain.process_unaggregated_attestations(atts)
+        h.pending_attestations.extend(
+            chain.naive_pool.aggregates_at_slot(slot)
+        )
+    print(
+        json.dumps(
+            {
+                "slots": args.slots,
+                "proposed": vc.metrics["blocks_proposed"],
+                "attestations": vc.metrics["attestations_published"],
+                "finalized_epoch": chain.finalized_checkpoint.epoch,
+            }
+        )
+    )
+    return 0
+
+
+def cmd_account(args):
+    from lighthouse_tpu import bls
+    from lighthouse_tpu.accounts import (
+        Keystore,
+        derive_path,
+        mnemonic_to_seed,
+    )
+
+    if args.account_cmd == "new":
+        if args.mnemonic:
+            seed = mnemonic_to_seed(args.mnemonic)
+            sk_int = derive_path(seed, f"m/12381/3600/{args.index}/0")
+            sk = bls.SecretKey(sk_int)
+        else:
+            sk = bls.SecretKey.random()
+        pk = sk.public_key()
+        ks = Keystore.encrypt(
+            sk.to_bytes(),
+            args.password,
+            path=f"m/12381/3600/{args.index}/0",
+            kdf=args.kdf,
+            pubkey=pk.to_bytes(),
+        )
+        payload = ks.to_json()
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(payload)
+        else:
+            print(payload)
+        print(f"pubkey: 0x{pk.to_bytes().hex()}", file=sys.stderr)
+        return 0
+    if args.account_cmd == "import":
+        with open(args.keystore) as f:
+            ks = Keystore.from_json(f.read())
+        secret = ks.decrypt(args.password)
+        sk = bls.SecretKey.from_bytes(secret)
+        print(f"imported 0x{sk.public_key().to_bytes().hex()}")
+        return 0
+    raise SystemExit(f"unknown account command {args.account_cmd}")
+
+
+def cmd_lcli(args):
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.state_processing.per_slot import process_slots
+
+    spec = _spec_for(args.network)
+    if args.lcli_cmd == "skip-slots":
+        h = Harness(spec, args.validators)
+        state = process_slots(h.state, args.slots, spec)
+        print(
+            json.dumps(
+                {
+                    "slot": state.slot,
+                    "state_root": "0x"
+                    + type(state).hash_tree_root(state).hex(),
+                }
+            )
+        )
+        return 0
+    if args.lcli_cmd == "transition-blocks":
+        h = Harness(spec, args.validators)
+        h.run_slots(args.slots)
+        print(
+            json.dumps(
+                {
+                    "slot": h.state.slot,
+                    "state_root": "0x"
+                    + type(h.state).hash_tree_root(h.state).hex(),
+                    "finalized_epoch": h.finalized_epoch,
+                }
+            )
+        )
+        return 0
+    if args.lcli_cmd == "new-testnet":
+        from lighthouse_tpu import bls
+
+        kps = bls.interop_keypairs(args.validators)
+        from lighthouse_tpu.state_processing.genesis import (
+            interop_genesis_state,
+        )
+
+        state = interop_genesis_state(
+            [k.pk.to_bytes() for k in kps], args.genesis_time, spec
+        )
+        data = state.to_bytes()
+        with open(args.out, "wb") as f:
+            f.write(data)
+        print(
+            json.dumps(
+                {
+                    "genesis_validators_root": "0x"
+                    + bytes(state.genesis_validators_root).hex(),
+                    "bytes": len(data),
+                }
+            )
+        )
+        return 0
+    raise SystemExit(f"unknown lcli command {args.lcli_cmd}")
+
+
+def cmd_db(args):
+    from lighthouse_tpu.store import SqliteStore
+
+    kv = SqliteStore(args.path)
+    if args.db_cmd == "inspect":
+        from lighthouse_tpu.store.hot_cold import (
+            COL_BLOCK,
+            COL_COLD_STATE,
+            COL_HOT_STATE,
+        )
+
+        print(
+            json.dumps(
+                {
+                    "blocks": len(kv.keys(COL_BLOCK)),
+                    "hot_states": len(kv.keys(COL_HOT_STATE)),
+                    "cold_states": len(kv.keys(COL_COLD_STATE)),
+                }
+            )
+        )
+        return 0
+    raise SystemExit(f"unknown db command {args.db_cmd}")
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="lighthouse_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    bn = sub.add_parser("bn", help="beacon node")
+    bn.add_argument("--network", default="minimal")
+    bn.add_argument("--validators", type=int, default=32)
+    bn.add_argument("--slots", type=int, default=8)
+    bn.add_argument("--http-port", type=int, default=0)
+    bn.add_argument("--datadir", default=None)
+    bn.add_argument("--bls-backend", default="ref")
+    bn.add_argument("--serve-seconds", type=float, default=0)
+    bn.set_defaults(fn=cmd_bn)
+
+    vc = sub.add_parser("vc", help="validator client")
+    vc.add_argument("--network", default="minimal")
+    vc.add_argument("--validators", type=int, default=32)
+    vc.add_argument("--slots", type=int, default=8)
+    vc.add_argument("--slashing-db", default=None)
+    vc.add_argument("--bls-backend", default="ref")
+    vc.set_defaults(fn=cmd_vc)
+
+    acct = sub.add_parser("account", help="keys & keystores")
+    acct.add_argument("account_cmd", choices=["new", "import"])
+    acct.add_argument("--password", required=True)
+    acct.add_argument("--kdf", default="pbkdf2")
+    acct.add_argument("--mnemonic", default=None)
+    acct.add_argument("--index", type=int, default=0)
+    acct.add_argument("--out", default=None)
+    acct.add_argument("--keystore", default=None)
+    acct.set_defaults(fn=cmd_account)
+
+    lcli = sub.add_parser("lcli", help="dev tools")
+    lcli.add_argument(
+        "lcli_cmd",
+        choices=["skip-slots", "transition-blocks", "new-testnet"],
+    )
+    lcli.add_argument("--network", default="minimal")
+    lcli.add_argument("--validators", type=int, default=16)
+    lcli.add_argument("--slots", type=int, default=8)
+    lcli.add_argument("--genesis-time", type=int, default=0)
+    lcli.add_argument("--out", default="genesis.ssz")
+    lcli.set_defaults(fn=cmd_lcli)
+
+    db = sub.add_parser("db", help="database tools")
+    db.add_argument("db_cmd", choices=["inspect"])
+    db.add_argument("--path", required=True)
+    db.set_defaults(fn=cmd_db)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,62 @@
+"""Task executor: tracked spawns with graceful-shutdown propagation.
+
+Role of common/task_executor (spawn/spawn_blocking wrappers with per-task
+metrics and a `ShutdownReason` channel): every long-lived service thread is
+spawned through one executor so shutdown is coordinated and observable.
+"""
+
+import enum
+import threading
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+
+class ShutdownReason(enum.Enum):
+    SUCCESS = "success"
+    FAILURE = "failure"
+
+
+class TaskExecutor:
+    def __init__(self, name: str = "node"):
+        self.name = name
+        self._threads: list[threading.Thread] = []
+        self._shutdown = threading.Event()
+        self._reason: ShutdownReason | None = None
+        self._reason_msg = ""
+        self._gauge = REGISTRY.gauge(
+            f"{name}_tasks_running", "live executor tasks"
+        )
+
+    @property
+    def shutdown_requested(self) -> bool:
+        return self._shutdown.is_set()
+
+    def shutdown(self, reason: ShutdownReason, message: str = ""):
+        """Signal every task to stop (the ShutdownReason channel)."""
+        self._reason = reason
+        self._reason_msg = message
+        self._shutdown.set()
+
+    def shutdown_reason(self):
+        return self._reason, self._reason_msg
+
+    def spawn(self, fn, name: str):
+        """Run fn(stop_event) on a tracked daemon thread."""
+
+        def runner():
+            self._gauge.set(self._gauge.value + 1)
+            try:
+                fn(self._shutdown)
+            except Exception as e:
+                self.shutdown(ShutdownReason.FAILURE, f"{name}: {e}")
+            finally:
+                self._gauge.set(self._gauge.value - 1)
+
+        th = threading.Thread(target=runner, name=name, daemon=True)
+        th.start()
+        self._threads.append(th)
+        return th
+
+    def join_all(self, timeout: float = 5.0):
+        for th in self._threads:
+            th.join(timeout=timeout)

@@ -134,7 +134,15 @@ class Harness:
 
     # -------------------------------------------------------- production
 
-    def produce_block(self, slot: int, attestations):
+    def produce_block(
+        self,
+        slot: int,
+        attestations,
+        deposits=(),
+        voluntary_exits=(),
+        proposer_slashings=(),
+        attester_slashings=(),
+    ):
         """Produce a signed block for `slot` on top of the current state."""
         spec = self.spec
         t = self.t
@@ -157,6 +165,10 @@ class Harness:
             eth1_data=state.eth1_data,
             graffiti=b"\x00" * 32,
             attestations=list(attestations),
+            deposits=list(deposits),
+            voluntary_exits=list(voluntary_exits),
+            proposer_slashings=list(proposer_slashings),
+            attester_slashings=list(attester_slashings),
         )
         parent_root = self.head_block_root(state)
         if fork_name != "phase0":

@@ -1,0 +1,2 @@
+from lighthouse_tpu.http_api.server import BeaconApiServer  # noqa: F401
+from lighthouse_tpu.http_api.json_codec import to_json, from_json  # noqa: F401

@@ -1,0 +1,96 @@
+"""Typed HTTP client for the beacon API.
+
+Role of the reference's common/eth2 `BeaconNodeHttpClient` (the ONLY
+channel between validator client and beacon node, common/eth2/src/lib.rs):
+a thin typed wrapper over the REST routes served by
+`http_api.BeaconApiServer`.
+"""
+
+import json
+import urllib.request
+from urllib.error import HTTPError
+
+
+class ApiClientError(Exception):
+    pass
+
+
+class BeaconNodeHttpClient:
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str):
+        try:
+            with urllib.request.urlopen(
+                self.base + path, timeout=self.timeout
+            ) as r:
+                return json.loads(r.read())
+        except HTTPError as e:
+            raise ApiClientError(f"GET {path}: {e.code}") from e
+
+    def _post(self, path: str, payload):
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.base + path,
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read() or b"{}")
+        except HTTPError as e:
+            raise ApiClientError(
+                f"POST {path}: {e.code} {e.read()[:200]!r}"
+            ) from e
+
+    # ------------------------------------------------------------- routes
+
+    def get_version(self) -> str:
+        return self._get("/eth/v1/node/version")["data"]["version"]
+
+    def get_health_ok(self) -> bool:
+        try:
+            self._get("/eth/v1/node/health")
+            return True
+        except ApiClientError:
+            return False
+
+    def get_syncing(self):
+        return self._get("/eth/v1/node/syncing")["data"]
+
+    def get_genesis(self):
+        return self._get("/eth/v1/beacon/genesis")["data"]
+
+    def get_finality_checkpoints(self, state_id: str = "head"):
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/finality_checkpoints"
+        )["data"]
+
+    def get_state_root(self, state_id: str = "head") -> bytes:
+        data = self._get(f"/eth/v1/beacon/states/{state_id}/root")
+        return bytes.fromhex(data["data"]["root"][2:])
+
+    def get_header(self, block_id: str = "head"):
+        return self._get(f"/eth/v1/beacon/headers/{block_id}")["data"]
+
+    def get_block_json(self, block_id: str = "head"):
+        return self._get(f"/eth/v2/beacon/blocks/{block_id}")
+
+    def get_proposer_duties(self, epoch: int):
+        return self._get(f"/eth/v1/validator/duties/proposer/{epoch}")[
+            "data"
+        ]
+
+    def post_block_json(self, block_json):
+        return self._post("/eth/v1/beacon/blocks", block_json)
+
+    def post_attestations_json(self, atts_json):
+        return self._post("/eth/v1/beacon/pool/attestations", atts_json)
+
+    def get_metrics_text(self) -> str:
+        with urllib.request.urlopen(
+            self.base + "/metrics", timeout=self.timeout
+        ) as r:
+            return r.read().decode()

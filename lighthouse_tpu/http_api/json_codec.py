@@ -1,0 +1,66 @@
+"""Beacon-API JSON codec for SSZ containers.
+
+The standard beacon API (reference common/eth2 + http_api) serializes
+containers as JSON objects with: uint64 as decimal strings, byte vectors as
+0x-hex, bitlists/bitvectors as 0x-hex of their SSZ encoding, lists as
+arrays, containers as objects with snake_case keys.
+"""
+
+from lighthouse_tpu import ssz
+from lighthouse_tpu.ssz.codec import (
+    Bitlist,
+    Bitvector,
+    Boolean,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    UInt,
+    Vector,
+)
+
+
+def to_json(ftype, value):
+    if isinstance(ftype, UInt):
+        return str(int(value))
+    if isinstance(ftype, Boolean):
+        return bool(value)
+    if isinstance(ftype, (ByteVector, ByteList)):
+        return "0x" + bytes(value).hex()
+    if isinstance(ftype, (Bitlist, Bitvector)):
+        return "0x" + ftype.encode(value).hex()
+    if isinstance(ftype, (List, Vector)):
+        return [to_json(ftype.elem, v) for v in value]
+    if isinstance(ftype, type) and issubclass(ftype, Container):
+        return {
+            name: to_json(ft, getattr(value, name))
+            for name, ft in ftype._fields
+        }
+    raise TypeError(f"unsupported type {ftype!r}")
+
+
+def from_json(ftype, obj):
+    if isinstance(ftype, UInt):
+        return int(obj)
+    if isinstance(ftype, Boolean):
+        return bool(obj)
+    if isinstance(ftype, (ByteVector, ByteList)):
+        return bytes.fromhex(obj[2:] if obj.startswith("0x") else obj)
+    if isinstance(ftype, Bitlist):
+        return ftype.decode(
+            bytes.fromhex(obj[2:] if obj.startswith("0x") else obj)
+        )
+    if isinstance(ftype, Bitvector):
+        return ftype.decode(
+            bytes.fromhex(obj[2:] if obj.startswith("0x") else obj)
+        )
+    if isinstance(ftype, (List, Vector)):
+        return [from_json(ftype.elem, v) for v in obj]
+    if isinstance(ftype, type) and issubclass(ftype, Container):
+        return ftype(
+            **{
+                name: from_json(ft, obj[name])
+                for name, ft in ftype._fields
+            }
+        )
+    raise TypeError(f"unsupported type {ftype!r}")

@@ -1,0 +1,281 @@
+"""REST beacon API server (standard endpoints + metrics scrape).
+
+Role of the reference's warp-based http_api (beacon_node/http_api/src/
+lib.rs, 3,119 LoC: beacon, node, validator, debug namespaces) and
+http_metrics (Prometheus scrape). Implemented over stdlib http.server
+(threaded) so the surface carries no extra dependencies; the validator
+client's HTTP transport (`BeaconNodeHttpClient` analog) talks to exactly
+these routes.
+
+Endpoints (the operative subset):
+  GET  /eth/v1/node/version | health | syncing
+  GET  /eth/v1/beacon/genesis
+  GET  /eth/v1/beacon/states/{state_id}/finality_checkpoints | root
+  GET  /eth/v1/beacon/headers/{block_id}
+  GET  /eth/v2/beacon/blocks/{block_id}
+  POST /eth/v1/beacon/blocks
+  POST /eth/v1/beacon/pool/attestations
+  GET  /eth/v1/validator/duties/proposer/{epoch}
+  GET  /metrics
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.http_api.json_codec import from_json, to_json
+
+VERSION = "lighthouse-tpu/0.1.0"
+
+
+class ApiError(Exception):
+    def __init__(self, code, message):
+        self.code = code
+        self.message = message
+
+
+class BeaconApiServer:
+    def __init__(self, chain, host: str = "127.0.0.1", port: int = 0):
+        self.chain = chain
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code, payload, content_type="application/json"):
+                body = (
+                    payload
+                    if isinstance(payload, bytes)
+                    else json.dumps(payload).encode()
+                )
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    out = api.handle_get(self.path)
+                    if isinstance(out, tuple):
+                        self._send(200, out[0], content_type=out[1])
+                    else:
+                        self._send(200, out)
+                except ApiError as e:
+                    self._send(
+                        e.code, {"code": e.code, "message": e.message}
+                    )
+                except Exception as e:  # pragma: no cover
+                    self._send(500, {"code": 500, "message": str(e)})
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
+                    out = api.handle_post(self.path, body)
+                    self._send(200, out)
+                except ApiError as e:
+                    self._send(
+                        e.code, {"code": e.code, "message": e.message}
+                    )
+                except Exception as e:
+                    self._send(400, {"code": 400, "message": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = None
+
+    # ------------------------------------------------------------ routing
+
+    def handle_get(self, path: str):
+        chain = self.chain
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if path == "/metrics":
+            return (REGISTRY.render().encode(), "text/plain; version=0.0.4")
+        if parts[:3] == ["eth", "v1", "node"]:
+            if parts[3] == "version":
+                return {"data": {"version": VERSION}}
+            if parts[3] == "health":
+                return {}
+            if parts[3] == "syncing":
+                return {
+                    "data": {
+                        "head_slot": str(chain.head_state.slot),
+                        "sync_distance": "0",
+                        "is_syncing": False,
+                        "is_optimistic": False,
+                    }
+                }
+        if parts[:3] == ["eth", "v1", "beacon"]:
+            if parts[3] == "genesis":
+                st = chain.head_state
+                return {
+                    "data": {
+                        "genesis_time": str(st.genesis_time),
+                        "genesis_validators_root": "0x"
+                        + bytes(st.genesis_validators_root).hex(),
+                        "genesis_fork_version": "0x"
+                        + bytes(chain.spec.GENESIS_FORK_VERSION).hex(),
+                    }
+                }
+            if parts[3] == "states" and len(parts) >= 6:
+                state = self._resolve_state(parts[4])
+                if parts[5] == "finality_checkpoints":
+                    def cp(c):
+                        return {
+                            "epoch": str(c.epoch),
+                            "root": "0x" + bytes(c.root).hex(),
+                        }
+
+                    return {
+                        "data": {
+                            "previous_justified": cp(
+                                state.previous_justified_checkpoint
+                            ),
+                            "current_justified": cp(
+                                state.current_justified_checkpoint
+                            ),
+                            "finalized": cp(state.finalized_checkpoint),
+                        }
+                    }
+                if parts[5] == "root":
+                    return {
+                        "data": {
+                            "root": "0x"
+                            + type(state).hash_tree_root(state).hex()
+                        }
+                    }
+            if parts[3] == "headers" and len(parts) >= 5:
+                block = self._resolve_block(parts[4])
+                header = self._header_json(block)
+                return {"data": header}
+        if parts[:3] == ["eth", "v2", "beacon"]:
+            if parts[3] == "blocks" and len(parts) >= 5:
+                block = self._resolve_block(parts[4])
+                return {
+                    "version": chain.spec.fork_name_at_epoch(
+                        chain.spec.slot_to_epoch(block.message.slot)
+                    ),
+                    "data": to_json(type(block), block),
+                }
+        if parts[:3] == ["eth", "v1", "validator"]:
+            if parts[3] == "duties" and parts[4] == "proposer":
+                epoch = int(parts[5])
+                return self._proposer_duties(epoch)
+        raise ApiError(404, f"unknown route {path}")
+
+    def handle_post(self, path: str, body: bytes):
+        chain = self.chain
+        if path == "/eth/v1/beacon/blocks":
+            doc = json.loads(body)
+            slot = int(doc["message"]["slot"])
+            fork = chain.spec.fork_name_at_epoch(
+                chain.spec.slot_to_epoch(slot)
+            )
+            cls = chain.t.signed_block_classes[fork]
+            block = from_json(cls, doc)
+            chain.process_block(block)
+            return {}
+        if path == "/eth/v1/beacon/pool/attestations":
+            docs = json.loads(body)
+            atts = [from_json(self.chain.t.Attestation, d) for d in docs]
+            results = chain.process_unaggregated_attestations(atts)
+            failures = [
+                {"index": i, "message": str(r)}
+                for i, r in enumerate(results)
+                if isinstance(r, Exception)
+            ]
+            if failures:
+                raise ApiError(400, json.dumps(failures))
+            return {}
+        raise ApiError(404, f"unknown route {path}")
+
+    # ------------------------------------------------------------ helpers
+
+    def _resolve_state(self, state_id: str):
+        chain = self.chain
+        if state_id in ("head", "justified", "finalized"):
+            return chain.head_state
+        if state_id.startswith("0x"):
+            raise ApiError(404, "state lookup by root unsupported")
+        state = chain.store.state_at_slot(int(state_id))
+        if state is None:
+            raise ApiError(404, "state not found")
+        return state
+
+    def _resolve_block(self, block_id: str):
+        chain = self.chain
+        if block_id == "head":
+            root = chain.head_root
+        elif block_id.startswith("0x"):
+            root = bytes.fromhex(block_id[2:])
+        else:
+            root = chain.store.get_canonical_block_root(int(block_id))
+            if root is None:
+                raise ApiError(404, "no canonical block at slot")
+        block = chain.store.get_block(root)
+        if block is None:
+            raise ApiError(404, "block not found")
+        return block
+
+    def _header_json(self, block):
+        msg = block.message
+        body_root = type(msg.body).hash_tree_root(msg.body)
+        root = type(msg).hash_tree_root(msg)
+        return {
+            "root": "0x" + root.hex(),
+            "canonical": True,
+            "header": {
+                "message": {
+                    "slot": str(msg.slot),
+                    "proposer_index": str(msg.proposer_index),
+                    "parent_root": "0x" + bytes(msg.parent_root).hex(),
+                    "state_root": "0x" + bytes(msg.state_root).hex(),
+                    "body_root": "0x" + body_root.hex(),
+                },
+                "signature": "0x" + bytes(block.signature).hex(),
+            },
+        }
+
+    def _proposer_duties(self, epoch: int):
+        from lighthouse_tpu.state_processing.helpers import (
+            get_beacon_proposer_index,
+        )
+        from lighthouse_tpu.state_processing.per_slot import process_slots
+
+        chain = self.chain
+        state = chain.state_for_epoch(epoch)
+        duties = []
+        for slot in range(
+            chain.spec.epoch_start_slot(epoch),
+            chain.spec.epoch_start_slot(epoch + 1),
+        ):
+            st = state
+            if st.slot < slot:
+                st = process_slots(state.copy(), slot, chain.spec)
+            idx = get_beacon_proposer_index(st, chain.spec)
+            duties.append(
+                {
+                    "pubkey": "0x"
+                    + bytes(st.validators[idx].pubkey).hex(),
+                    "validator_index": str(idx),
+                    "slot": str(slot),
+                }
+            )
+        return {"data": duties}
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,74 @@
+"""Native (C) components with pure-Python fallbacks.
+
+`build()` compiles the _hashtree extension in-place with the system
+toolchain (no pip); `hash_pairs` resolves to the native implementation when
+the extension is present, else the hashlib fallback.
+"""
+
+import hashlib
+import os
+import subprocess
+import sysconfig
+
+_HERE = os.path.dirname(__file__)
+
+
+def _so_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_HERE, "_hashtree" + suffix)
+
+
+def build(force: bool = False) -> bool:
+    """Compile the extension with cc; returns True on success."""
+    so = _so_path()
+    src = os.path.join(_HERE, "hashtree.c")
+    if os.path.exists(so) and not force:
+        return True
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        os.environ.get("CC", "cc"),
+        "-O3",
+        "-shared",
+        "-fPIC",
+        f"-I{include}",
+        src,
+        "-o",
+        so,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    try:
+        from lighthouse_tpu.native import _hashtree  # noqa: F401
+
+        return _hashtree
+    except ImportError:
+        if build():
+            try:
+                from lighthouse_tpu.native import _hashtree  # noqa: F811
+
+                return _hashtree
+            except ImportError:
+                return None
+        return None
+
+
+_mod = _load()
+NATIVE_AVAILABLE = _mod is not None
+
+
+def hash_pairs(data: bytes) -> bytes:
+    """SHA-256 of each consecutive 64-byte block -> concatenated digests."""
+    if _mod is not None:
+        return _mod.hash_pairs(data)
+    out = bytearray()
+    for i in range(0, len(data), 64):
+        out += hashlib.sha256(data[i : i + 64]).digest()
+    return bytes(out)

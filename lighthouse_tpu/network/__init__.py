@@ -1,0 +1,5 @@
+from lighthouse_tpu.network.beacon_processor import (  # noqa: F401
+    BeaconProcessor,
+    WorkItem,
+)
+from lighthouse_tpu.network.gossip import GossipHub  # noqa: F401

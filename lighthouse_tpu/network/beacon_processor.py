@@ -1,0 +1,175 @@
+"""Beacon processor: bounded priority work queues feeding worker threads.
+
+Role of the reference's `BeaconProcessor`
+(beacon_node/network/src/beacon_processor/mod.rs:1-40 design doc,
+:85-120 queue bounds): a manager drains per-kind bounded FIFO/LIFO queues
+in priority order into a capped worker pool. Two reference behaviors are
+preserved because they shape the TPU data plane:
+
+  * attestation COALESCING — queued gossip attestations are handed to one
+    worker as a batch (mod.rs attestation queues), which downstream becomes
+    ONE device signature batch;
+  * the reprocessing queue — early (future-slot) or unknown-parent work is
+    delayed and re-injected (work_reprocessing_queue.rs).
+"""
+
+import heapq
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass(order=True)
+class WorkItem:
+    priority: int
+    seq: int
+    kind: str = field(compare=False)
+    payload: object = field(compare=False)
+
+
+# priority per work kind (lower = more urgent), mirroring the reference's
+# drain order: chain segments/blocks first, then aggregates, attestations,
+# then the long tail.
+PRIORITIES = {
+    "gossip_block": 0,
+    "chain_segment": 1,
+    "gossip_aggregate": 2,
+    "gossip_attestation": 3,
+    "sync_message": 4,
+    "rpc_request": 5,
+    "gossip_exit": 6,
+    "gossip_slashing": 6,
+}
+
+DEFAULT_BOUNDS = {
+    "gossip_block": 1024,
+    "chain_segment": 64,
+    "gossip_aggregate": 4096,
+    "gossip_attestation": 16384,
+    "sync_message": 4096,
+    "rpc_request": 1024,
+    "gossip_exit": 512,
+    "gossip_slashing": 512,
+}
+
+ATTESTATION_BATCH_MAX = 64
+AGGREGATE_BATCH_MAX = 64
+
+
+class BeaconProcessor:
+    def __init__(self, handlers, max_workers: int = 2, bounds=None):
+        """handlers: kind -> callable(payload_or_batch). Attestation and
+        aggregate kinds receive LISTS (coalesced batches)."""
+        self.handlers = handlers
+        self.bounds = dict(DEFAULT_BOUNDS)
+        if bounds:
+            self.bounds.update(bounds)
+        self._queues: dict[str, list] = {k: [] for k in PRIORITIES}
+        self._dropped: dict[str, int] = {k: 0 for k in PRIORITIES}
+        self._lock = threading.Lock()
+        self._work_available = threading.Condition(self._lock)
+        self._seq = 0
+        self._workers = []
+        self._max_workers = max_workers
+        self._stop = False
+        self._reprocess: list = []  # (ready_time, kind, payload)
+        self.metrics = {"processed": 0, "reprocessed": 0, "dropped": 0}
+
+    # -------------------------------------------------------------- submit
+
+    def submit(self, kind: str, payload) -> bool:
+        """Enqueue work; returns False when the bounded queue dropped it."""
+        with self._lock:
+            q = self._queues[kind]
+            if len(q) >= self.bounds[kind]:
+                self._dropped[kind] += 1
+                self.metrics["dropped"] += 1
+                return False
+            self._seq += 1
+            q.append(WorkItem(PRIORITIES[kind], self._seq, kind, payload))
+            self._work_available.notify()
+        return True
+
+    def submit_delayed(self, kind: str, payload, delay_s: float):
+        """Reprocessing queue: re-inject after `delay_s` (early blocks,
+        unknown-parent attestations)."""
+        with self._lock:
+            heapq.heappush(
+                self._reprocess,
+                (time.monotonic() + delay_s, self._seq, kind, payload),
+            )
+            self._seq += 1
+            self.metrics["reprocessed"] += 1
+
+    # --------------------------------------------------------------- drain
+
+    def _next_batch(self):
+        """Pop the highest-priority work; coalesce attestation kinds."""
+        now = time.monotonic()
+        while self._reprocess and self._reprocess[0][0] <= now:
+            _, _, kind, payload = heapq.heappop(self._reprocess)
+            self.submit(kind, payload)
+
+        for kind in sorted(PRIORITIES, key=PRIORITIES.get):
+            q = self._queues[kind]
+            if not q:
+                continue
+            if kind == "gossip_attestation":
+                batch = [w.payload for w in q[:ATTESTATION_BATCH_MAX]]
+                del q[: len(batch)]
+                return kind, batch
+            if kind == "gossip_aggregate":
+                batch = [w.payload for w in q[:AGGREGATE_BATCH_MAX]]
+                del q[: len(batch)]
+                return kind, batch
+            w = q.pop(0)
+            return kind, w.payload
+        return None
+
+    def process_pending(self, max_items: int | None = None):
+        """Synchronous drain (deterministic testing mode — the manual-clock
+        analog of the async worker loop)."""
+        n = 0
+        while max_items is None or n < max_items:
+            with self._lock:
+                nxt = self._next_batch()
+            if nxt is None:
+                return n
+            kind, payload = nxt
+            self.handlers[kind](payload)
+            self.metrics["processed"] += 1
+            n += 1
+        return n
+
+    # ------------------------------------------------------ threaded mode
+
+    def start(self):
+        self._stop = False
+        for _ in range(self._max_workers):
+            th = threading.Thread(target=self._worker_loop, daemon=True)
+            th.start()
+            self._workers.append(th)
+
+    def stop(self):
+        with self._lock:
+            self._stop = True
+            self._work_available.notify_all()
+        for th in self._workers:
+            th.join(timeout=5)
+        self._workers = []
+
+    def _worker_loop(self):
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                nxt = self._next_batch()
+                if nxt is None:
+                    self._work_available.wait(timeout=0.05)
+                    continue
+            kind, payload = nxt
+            try:
+                self.handlers[kind](payload)
+            except Exception:  # worker errors must not kill the pool
+                pass
+            self.metrics["processed"] += 1

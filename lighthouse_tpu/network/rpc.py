@@ -1,0 +1,147 @@
+"""Req/resp RPC: Status, Ping, Metadata, Goodbye, BlocksByRange/ByRoot.
+
+Role of the reference's rpc stack (lighthouse_network/src/rpc/: methods,
+protocol negotiation, ssz_snappy codec, per-protocol rate limiting). SSZ
+payloads over an abstract peer channel (in-process here; the framing layer
+is transport-agnostic), with a token-bucket rate limiter per (peer,
+method) mirroring rpc/rate_limiter.rs.
+"""
+
+import time
+from dataclasses import dataclass
+
+from lighthouse_tpu import ssz
+
+
+class StatusMessage(ssz.Container):
+    fork_digest: ssz.bytes4
+    finalized_root: ssz.bytes32
+    finalized_epoch: ssz.uint64
+    head_root: ssz.bytes32
+    head_slot: ssz.uint64
+
+
+class Ping(ssz.Container):
+    data: ssz.uint64
+
+
+class MetaData(ssz.Container):
+    seq_number: ssz.uint64
+    attnets: ssz.Bitvector(64)
+
+
+class Goodbye(ssz.Container):
+    reason: ssz.uint64
+
+
+class BlocksByRangeRequest(ssz.Container):
+    start_slot: ssz.uint64
+    count: ssz.uint64
+    step: ssz.uint64
+
+
+MAX_REQUEST_BLOCKS = 1024
+
+# token-bucket quotas per method: (tokens, per_seconds)
+QUOTAS = {
+    "status": (5, 15),
+    "ping": (2, 10),
+    "metadata": (2, 5),
+    "goodbye": (1, 10),
+    "blocks_by_range": (1024, 10),
+    "blocks_by_root": (128, 10),
+}
+
+
+class RateLimitExceeded(Exception):
+    pass
+
+
+class _Bucket:
+    def __init__(self, tokens, per_seconds):
+        self.capacity = tokens
+        self.refill = tokens / per_seconds
+        self.tokens = float(tokens)
+        self.last = time.monotonic()
+
+    def take(self, n=1.0):
+        now = time.monotonic()
+        self.tokens = min(
+            self.capacity, self.tokens + (now - self.last) * self.refill
+        )
+        self.last = now
+        if self.tokens < n:
+            raise RateLimitExceeded
+        self.tokens -= n
+
+
+@dataclass
+class RpcError(Exception):
+    code: int
+    message: str
+
+
+class RpcServer:
+    """Per-node RPC endpoint serving the standard methods from a chain."""
+
+    def __init__(self, chain, node_id: str, fork_digest: bytes):
+        self.chain = chain
+        self.node_id = node_id
+        self.fork_digest = fork_digest
+        self.seq_number = 0
+        self._buckets: dict[tuple, _Bucket] = {}
+
+    def _limit(self, peer_id: str, method: str, n=1.0):
+        key = (peer_id, method)
+        if key not in self._buckets:
+            self._buckets[key] = _Bucket(*QUOTAS[method])
+        self._buckets[key].take(n)
+
+    # ------------------------------------------------------------ methods
+
+    def status(self, peer_id: str) -> StatusMessage:
+        self._limit(peer_id, "status")
+        chain = self.chain
+        head = chain.head_state
+        fin = head.finalized_checkpoint
+        return StatusMessage(
+            fork_digest=self.fork_digest,
+            finalized_root=bytes(fin.root)
+            if fin.epoch
+            else chain.genesis_root,
+            finalized_epoch=fin.epoch,
+            head_root=chain.head_root,
+            head_slot=head.slot,
+        )
+
+    def ping(self, peer_id: str, data: int) -> int:
+        self._limit(peer_id, "ping")
+        return self.seq_number
+
+    def metadata(self, peer_id: str) -> MetaData:
+        self._limit(peer_id, "metadata")
+        return MetaData(seq_number=self.seq_number, attnets=[True] * 64)
+
+    def blocks_by_range(self, peer_id: str, req: BlocksByRangeRequest):
+        count = min(req.count, MAX_REQUEST_BLOCKS)
+        self._limit(peer_id, "blocks_by_range", float(count))
+        if req.step != 1:
+            raise RpcError(1, "step != 1 unsupported")
+        out = []
+        for slot in range(req.start_slot, req.start_slot + count):
+            root = self.chain.store.get_canonical_block_root(slot)
+            if root is None:
+                continue
+            block = self.chain.store.get_block(root)
+            if block is not None:
+                out.append(block)
+        return out
+
+    def blocks_by_root(self, peer_id: str, roots):
+        self._limit(peer_id, "blocks_by_root", float(len(roots)))
+        out = []
+        for root in roots:
+            block = self.chain.store.get_block(bytes(root))
+            if block is not None:
+                out.append(block)
+        return out

@@ -1,0 +1,207 @@
+"""Beacon node assembly: chain + processor + gossip + RPC + sync + API.
+
+Role of the reference's `ClientBuilder` (beacon_node/client/src/builder.rs:
+90-948): construct the store and chain from genesis (or checkpoint state),
+wire the network services (gossip handlers through the beacon processor),
+attach the slasher, HTTP API, and per-slot timer. `Simulator` composes
+several nodes over one in-process gossip hub — the testing/simulator
+analog (multiple nodes, one process, real message flow).
+"""
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.common.slot_clock import ManualSlotClock
+from lighthouse_tpu.network.beacon_processor import BeaconProcessor
+from lighthouse_tpu.network.gossip import (
+    SCORE_INVALID_MESSAGE,
+    SCORE_VALID,
+    GossipHub,
+    topic,
+)
+from lighthouse_tpu.network.rpc import RpcServer
+from lighthouse_tpu.network.sync import SyncManager
+from lighthouse_tpu.types.helpers import compute_fork_digest
+
+
+class BeaconNode:
+    def __init__(
+        self,
+        node_id: str,
+        genesis_state,
+        spec,
+        hub: GossipHub | None = None,
+        kv=None,
+        backend: str = "ref",
+        slasher=None,
+    ):
+        self.node_id = node_id
+        self.spec = spec
+        self.clock = ManualSlotClock(
+            genesis_state.genesis_time, spec.SECONDS_PER_SLOT
+        )
+        self.chain = BeaconChain(
+            genesis_state.copy(),
+            spec,
+            kv=kv,
+            backend=backend,
+            slot_clock=self.clock,
+        )
+        self.fork_digest = compute_fork_digest(
+            spec.fork_version_at_epoch(0),
+            bytes(genesis_state.genesis_validators_root),
+        )
+        self.slasher = slasher
+        self.rpc = RpcServer(self.chain, node_id, self.fork_digest)
+        self.sync = SyncManager(self.chain, spec)
+        self.processor = BeaconProcessor(
+            handlers={
+                "gossip_block": self._on_block,
+                "chain_segment": self._on_segment,
+                "gossip_aggregate": self._on_aggregates,
+                "gossip_attestation": self._on_attestations,
+                "sync_message": lambda p: None,
+                "rpc_request": lambda p: None,
+                "gossip_exit": self._on_exit,
+                "gossip_slashing": self._on_slashing,
+            }
+        )
+        self.hub = hub
+        if hub is not None:
+            hub.join(node_id, self._deliver)
+            for name in (
+                "beacon_block",
+                "beacon_aggregate_and_proof",
+                "beacon_attestation_0",
+                "voluntary_exit",
+                "attester_slashing",
+            ):
+                hub.subscribe(node_id, topic(self.fork_digest, name))
+
+    # ---------------------------------------------------------- transport
+
+    def _topic_name(self, topic_str: str) -> str:
+        return topic_str.split("/")[3]
+
+    def _deliver(self, topic_str: str, data: bytes, from_peer: str):
+        name = self._topic_name(topic_str)
+        if name == "beacon_block":
+            fork = self.spec.fork_name_at_epoch(0)
+            block = self.chain.t.signed_block_classes[fork].decode(data)
+            self.processor.submit(
+                "gossip_block", (block, from_peer)
+            )
+        elif name == "beacon_aggregate_and_proof":
+            sap = self.chain.t.SignedAggregateAndProof.decode(data)
+            self.processor.submit("gossip_aggregate", (sap, from_peer))
+        elif name.startswith("beacon_attestation"):
+            att = self.chain.t.Attestation.decode(data)
+            self.processor.submit("gossip_attestation", (att, from_peer))
+        elif name == "voluntary_exit":
+            exit_ = self.chain.t.SignedVoluntaryExit.decode(data)
+            self.processor.submit("gossip_exit", (exit_, from_peer))
+        elif name == "attester_slashing":
+            sl = self.chain.t.AttesterSlashing.decode(data)
+            self.processor.submit("gossip_slashing", (sl, from_peer))
+
+    def publish_block(self, signed_block):
+        if self.hub is None:
+            return
+        self.hub.publish(
+            self.node_id,
+            topic(self.fork_digest, "beacon_block"),
+            signed_block.to_bytes(),
+        )
+
+    def publish_attestation(self, att):
+        if self.hub is None:
+            return
+        self.hub.publish(
+            self.node_id,
+            topic(self.fork_digest, "beacon_attestation_0"),
+            att.to_bytes(),
+        )
+
+    def publish_aggregate(self, sap):
+        if self.hub is None:
+            return
+        self.hub.publish(
+            self.node_id,
+            topic(self.fork_digest, "beacon_aggregate_and_proof"),
+            sap.to_bytes(),
+        )
+
+    # ------------------------------------------------------------ handlers
+
+    def _on_block(self, payload):
+        block, from_peer = payload
+        try:
+            self.chain.process_block(block)
+            if self.slasher is not None:
+                hdr = self.chain.t.SignedBeaconBlockHeader(
+                    message=self.chain.t.BeaconBlockHeader(
+                        slot=block.message.slot,
+                        proposer_index=block.message.proposer_index,
+                        parent_root=block.message.parent_root,
+                        state_root=block.message.state_root,
+                        body_root=type(
+                            block.message.body
+                        ).hash_tree_root(block.message.body),
+                    ),
+                    signature=block.signature,
+                )
+                self.slasher.accept_block_header(hdr)
+            if self.hub is not None:
+                self.hub.report(from_peer, SCORE_VALID)
+        except Exception as e:
+            msg = str(e)
+            if "unknown parent" in msg:
+                # parent lookup via RPC, then retry through reprocessing
+                if self.sync.lookup_parent(
+                    bytes(block.message.parent_root)
+                ):
+                    self.processor.submit(
+                        "gossip_block", (block, from_peer)
+                    )
+            elif self.hub is not None and "already" not in msg:
+                self.hub.report(from_peer, SCORE_INVALID_MESSAGE)
+
+    def _on_segment(self, payload):
+        blocks, _from = payload
+        self.chain.process_chain_segment(blocks)
+
+    def _on_attestations(self, batch):
+        atts = [a for a, _ in batch]
+        self.chain.process_unaggregated_attestations(atts)
+
+    def _on_aggregates(self, batch):
+        saps = [s for s, _ in batch]
+        results = self.chain.process_aggregated_attestations(saps)
+        if self.slasher is not None:
+            from lighthouse_tpu.beacon_chain.attestation_verification import (
+                VerifiedAttestation,
+            )
+
+            for r in results:
+                if isinstance(r, VerifiedAttestation):
+                    self.slasher.accept_attestation(
+                        self.chain.t.IndexedAttestation(
+                            attesting_indices=r.indexed_indices,
+                            data=r.attestation.data,
+                            signature=r.attestation.signature,
+                        )
+                    )
+
+    def _on_exit(self, payload):
+        exit_, _from = payload
+        self.chain.op_pool.insert_voluntary_exit(exit_)
+
+    def _on_slashing(self, payload):
+        sl, _from = payload
+        self.chain.op_pool.insert_attester_slashing(sl)
+
+    # ------------------------------------------------------------- timers
+
+    def on_slot(self, slot: int):
+        """Per-slot tick (timer/src/lib.rs:12 + state_advance_timer)."""
+        self.clock.set_slot(slot)
+        self.chain.set_slot(slot)
+        self.processor.process_pending()

@@ -1,0 +1,41 @@
+"""Node notifier: periodic human-readable status line.
+
+Role of beacon_node/client/src/notifier.rs: per-slot summary of head slot,
+sync state, peers, finalization — emitted through the structured logger.
+"""
+
+from lighthouse_tpu.common.logging import TimeLatch, get_logger, kv
+
+import logging
+
+
+class Notifier:
+    def __init__(self, chain, sync=None, interval_s: float = 0.0):
+        self.chain = chain
+        self.sync = sync
+        self.latch = TimeLatch(interval_s)
+        self.log = get_logger("notifier")
+
+    def tick(self, slot: int):
+        if not self.latch.elapsed():
+            return
+        chain = self.chain
+        kv(
+            self.log,
+            logging.INFO,
+            "synced" if self._synced(slot) else "syncing",
+            slot=slot,
+            head_slot=chain.head_state.slot,
+            head=f"0x{chain.head_root.hex()[:8]}",
+            justified=chain.head_state.current_justified_checkpoint.epoch,
+            finalized=chain.finalized_checkpoint.epoch,
+            peers=len(self.sync.peers) if self.sync else 0,
+            blocks=chain.metrics["blocks_imported"],
+        )
+
+    def _synced(self, slot: int) -> bool:
+        return chainable(self.chain.head_state.slot, slot)
+
+
+def chainable(head_slot: int, wall_slot: int) -> bool:
+    return head_slot + 2 >= wall_slot

@@ -1,86 +1,73 @@
-"""Device data plane for batched BLS signature-set verification.
+"""Device data plane for batched BLS signature-set verification (bundles).
 
-This is the TPU-native core of the framework's north-star boundary — the
-role of `verify_signature_sets` in the reference client
-(crypto/bls/src/impls/blst.rs:36-119): given S signature sets, each with a
-message point H(m) in G2, a signature in G2, and up to K public keys in G1,
-verify all of them with ONE multi-pairing using the random-linear-combination
-trick (same scheme as the reference: >=64-bit random scalars, one
-multi-pairing for the whole batch):
+The TPU-native core of the north-star boundary — the role of
+`verify_signature_sets` in the reference client
+(crypto/bls/src/impls/blst.rs:36-119): S signature sets, each with a
+message point H(m) in G2, a signature in G2, and up to K public keys in
+G1, verified with ONE multi-pairing via the random-linear-combination
+trick:
 
     prod_i [ e(r_i * agg_pk_i, H_i) ] * e(-G1, sum_i r_i * sig_i)  ==  1
 
-All inputs are device arrays with static shapes (S sets x K padded keys);
-variable real sizes are carried by boolean masks — the TPU-native
-replacement for the reference's per-set heap-allocated pubkey vectors.
-A pair whose RLC'd aggregate pubkey is infinity is masked out of the
-multi-pairing, which is exact (e(inf, H) == 1); a forged or missing
-signature still breaks the identity through the signature-sum pair.
+Static shapes (S sets x K padded keys) with boolean masks — the TPU-native
+replacement for per-set heap vectors. A pair whose RLC'd aggregate pubkey
+is infinity is masked out (exact: e(inf, H) == 1); a forged signature
+still breaks the identity through the signature-sum pair.
+
+Shapes: G2 affine = pair of (..., 2, NB) bundles; G1 affine = pair of
+(..., 1, NB); pubkeys = ((S, K, 1, NB), (S, K, 1, NB)).
 
 Host-side policy (empty-set rejection, infinity-pubkey rejection, point
-decompression, subgroup checks, RLC scalar sampling) lives in
-`lighthouse_tpu.bls`; this module is pure device math.
+decompression, subgroup checks, RLC sampling) lives in `lighthouse_tpu.bls`.
 """
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
-from lighthouse_tpu.crypto.constants import G1_X, G1_Y, NLIMBS, P, int_to_limbs
-from lighthouse_tpu.ops import curve, fp, fp2, pairing
+from lighthouse_tpu.crypto.constants import G1_X, G1_Y, P
+from lighthouse_tpu.ops import curve, fieldb as fb, pairing
+
+NB = fb.NB
 
 
-def _mont(v: int) -> np.ndarray:
-    return np.array(int_to_limbs((v << 384) % P), dtype=np.int32)
+def _mont1(v: int) -> np.ndarray:
+    return fb._limbs((v << 384) % P, NB)[None, :]
 
 
 # -G1 generator, affine Montgomery (static constant for the signature pair).
-NEG_G1_AFFINE = (_mont(G1_X), _mont((P - G1_Y) % P))
+NEG_G1_AFFINE = (_mont1(G1_X), _mont1((P - G1_Y) % P))
 
-RAND_BITS = 64  # >= 64-bit RLC scalars, matching the reference's coefficients
+RAND_BITS = 64  # >= 64-bit RLC scalars, matching the reference
 
 
 def _lift_g1(aff, valid):
-    """Affine G1 + validity mask -> Jacobian (Z = 1, or Z = 0 => infinity)."""
     x, y = aff
-    z = jnp.where(
-        valid[..., None],
-        jnp.broadcast_to(jnp.asarray(fp.ONE_MONT), x.shape),
-        jnp.zeros_like(x),
-    )
+    one = jnp.broadcast_to(jnp.asarray(curve.F1.ONE), x.shape)
+    z = jnp.where(valid[..., None, None], one, jnp.zeros_like(x))
     return (x, y, z)
 
 
 def _lift_g2(aff, valid):
     x, y = aff
-    one = fp2.broadcast_const(fp2.ONE_MONT, x[0])
-    zero = (jnp.zeros_like(x[0]), jnp.zeros_like(x[1]))
-    return (x, y, fp2.select(valid, one, zero))
+    one = jnp.broadcast_to(jnp.asarray(curve.F2.ONE), x.shape)
+    z = jnp.where(valid[..., None, None], one, jnp.zeros_like(x))
+    return (x, y, z)
 
 
-def _expand0(tree):
-    return jax.tree_util.tree_map(lambda t: t[None], tree)
-
-
-def _concat0(a, b):
-    return jax.tree_util.tree_map(
-        lambda x, y: jnp.concatenate([x, y], axis=0), a, b
-    )
+def _expand0(pt):
+    return tuple(c[None] for c in pt)
 
 
 def aggregate_pubkeys(pubkeys_g1_aff, key_mask):
-    """Per-set pubkey aggregation: (S, K) affine G1 + mask -> (S,) Jacobian.
-
-    The reference aggregates per-set pubkeys by serial point addition on the
-    CPU; here it is a masked log-depth tree fold over the padded key axis.
-    """
+    """(S, K) affine G1 + mask -> (S,) Jacobian aggregate per set (masked
+    log-depth tree fold over the key axis)."""
     pts = _lift_g1(pubkeys_g1_aff, key_mask)
     return curve.G1.masked_sum_axis(pts, key_mask, axis=1)
 
 
 def rlc_combined_signature(sigs_g2_aff, rand_bits, set_mask):
-    """sum_i r_i * sig_i over the set axis -> single Jacobian G2 point."""
+    """sum_i r_i * sig_i -> single Jacobian G2 point."""
     sig_jac = _lift_g2(sigs_g2_aff, set_mask)
     sig_r = curve.G2.mul_scalar_bits(sig_jac, rand_bits)
     return curve.G2.masked_sum_axis(sig_r, set_mask, axis=0)
@@ -89,8 +76,8 @@ def rlc_combined_signature(sigs_g2_aff, rand_bits, set_mask):
 def miller_inputs(
     msgs_g2_aff, sigs_g2_aff, pubkeys_g1_aff, key_mask, rand_bits, set_mask
 ):
-    """Everything up to the Miller loop: build the (S+1)-pair multi-pairing
-    inputs. Split out so the sharded path can run it per-shard."""
+    """Build the (S+1)-pair multi-pairing inputs; shared with the sharded
+    path."""
     agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)
     agg_pk_r = curve.G1.mul_scalar_bits(agg_pk, rand_bits)
     pk_x, pk_y, pk_inf = curve.G1.to_affine(agg_pk_r)
@@ -106,7 +93,10 @@ def miller_inputs(
         jnp.concatenate([pk_x, neg_g1[0]], axis=0),
         jnp.concatenate([pk_y, neg_g1[1]], axis=0),
     )
-    g2_side = _concat0(msgs_g2_aff, (s_x, s_y))
+    g2_side = (
+        jnp.concatenate([msgs_g2_aff[0], s_x], axis=0),
+        jnp.concatenate([msgs_g2_aff[1], s_y], axis=0),
+    )
     pair_mask = jnp.concatenate([set_mask & ~pk_inf, ~s_inf], axis=0)
     return g1_side, g2_side, pair_mask
 
@@ -120,20 +110,9 @@ def verify_signature_sets(
     set_mask,
 ):
     """One-shot batched verification of S signature sets on one chip.
-
-    Args:
-      msgs_g2_aff:    affine Montgomery G2 message points H(m_i), Fp2 pair
-                      of (S, NLIMBS) limb arrays per coordinate.
-      sigs_g2_aff:    affine G2 signatures, same layout.
-      pubkeys_g1_aff: ((S, K, NLIMBS), (S, K, NLIMBS)) affine G1 pubkeys.
-      key_mask:       (S, K) bool — real pubkeys per set.
-      rand_bits:      (S, RAND_BITS) int32 LSB-first RLC scalar bits
-                      (sampled host-side so device code stays deterministic).
-      set_mask:       (S,) bool — real sets (padding sets are skipped).
-
-    Returns: scalar bool — True iff every real set verifies.
-    """
+    Returns a scalar bool — True iff every real set verifies."""
     g1_side, g2_side, pair_mask = miller_inputs(
-        msgs_g2_aff, sigs_g2_aff, pubkeys_g1_aff, key_mask, rand_bits, set_mask
+        msgs_g2_aff, sigs_g2_aff, pubkeys_g1_aff, key_mask, rand_bits,
+        set_mask,
     )
     return pairing.multi_pairing_is_one(g1_side, g2_side, pair_mask)

@@ -1,17 +1,15 @@
-"""Batched, branchless Jacobian point arithmetic for BLS12-381 G1 and G2.
+"""Batched, branchless Jacobian point arithmetic for BLS12-381 G1 and G2,
+on slot bundles.
 
-A point is a 3-tuple `(X, Y, Z)` of field elements — Fp limb arrays for G1,
-Fp2 tuples for G2 — in **Montgomery form**. Infinity is marked by Z == 0
-(coordinates at infinity may be garbage; every op treats Z == 0 as the
-definitive flag). All ops broadcast over leading batch axes and are valid
-inside jit/vmap/scan: no Python branches on traced values anywhere.
+A point is a 3-tuple `(X, Y, Z)` of coordinate bundles — `(..., 1, NB)`
+for G1 (Fp) or `(..., 2, NB)` for G2 (Fp2) — Montgomery domain, lazily
+reduced. Infinity is Z == 0 (value-exact test via canonicalizing
+predicates). All ops broadcast over leading batch axes; no Python branches
+on traced values.
 
-The exceptional cases the reference handles with branches
-(reference crypto/bls/src/impls/blst.rs delegating to blst's C point ops)
-are handled here with lane-wise selects: unified `add` computes the generic
-chord result, the doubling result, and the infinity cases, then selects.
-
-Validated against `lighthouse_tpu.crypto.ref_curve`.
+The group formulas are the same unified Jacobian ones validated against
+crypto/ref_curve in the scalar implementation; here each formula step runs
+its independent field multiplies as ONE stacked bundle multiply.
 """
 
 import numpy as np
@@ -27,110 +25,172 @@ from lighthouse_tpu.crypto.constants import (
     G2_X,
     G2_Y,
     P,
-    int_to_limbs,
 )
-from lighthouse_tpu.ops import fp, fp2
+from lighthouse_tpu.ops import fieldb as fb
+from lighthouse_tpu.ops import fp2 as fp2m
+from lighthouse_tpu.ops.programs import FP2_MUL
+
+NB = fb.NB
 
 
-def _mont(v: int) -> np.ndarray:
-    """Static python int -> Montgomery-form limb constant."""
-    return np.array(int_to_limbs((v << 384) % P), dtype=np.int32)
+def _mont1(v: int) -> np.ndarray:
+    return fb._limbs((v << 384) % P, NB)[None, :]  # (1, NB)
+
+
+class FieldW:
+    """Width-w field namespace over bundles: w=1 (Fp) or w=2 (Fp2)."""
+
+    def __init__(self, w: int):
+        self.w = w
+        if w == 1:
+            self.ONE = np.asarray(fb.ONE_MONT_B)[None, :]
+        else:
+            self.ONE = np.asarray(fp2m.ONE_MONT)
+        self.ZERO = np.zeros((w, NB), dtype=np.int32)
+
+    def mul(self, a, b):
+        if self.w == 1:
+            return fb.mul_lazy(a, b)
+        return fp2m.bilinear(a, b, FP2_MUL)
+
+    def sqr(self, a):
+        return self.mul(a, a)
+
+    add = staticmethod(fb.add)
+    sub = staticmethod(fb.sub)
+
+    def neg(self, a):
+        return fb.apply_combo(a, -np.eye(self.w, dtype=np.int32))
+
+    scalar_small = staticmethod(fb.scalar_small)
+    select = staticmethod(fb.select)
+    is_zero = staticmethod(fb.is_zero)
+    eq = staticmethod(fb.eq)
+
+    def inv(self, a):
+        if self.w == 1:
+            return fb.inv(a)
+        return fp2m.inv(a)
+
+
+F1 = FieldW(1)
+F2 = FieldW(2)
 
 
 class JacobianGroup:
-    """Short-Weierstrass y^2 = x^3 + b in Jacobian coordinates over a device
-    field module (`ops.fp` or `ops.fp2`)."""
-
-    def __init__(self, F, b_mont, gen_affine_mont, name):
+    def __init__(self, F: FieldW, b_mont, gen_affine_mont, name):
         self.F = F
-        self.b = b_mont  # Montgomery-form static constant
+        self.b = b_mont
         self.name = name
-        self.gen = (gen_affine_mont[0], gen_affine_mont[1], F.ONE_MONT)
+        self.gen = (gen_affine_mont[0], gen_affine_mont[1], F.ONE)
 
-    # -- representation helpers ------------------------------------------------
-
-    def const(self, elem):
-        """Identity hook: static constants are numpy arrays/tuples that JAX
-        treats as leaves; nothing to do."""
-        return elem
+    # -- representation helpers ------------------------------------------
 
     def infinity_like(self, pt):
-        """Infinity with the same batch shape as `pt`."""
-        F = self.F
-        x, y, z = pt
-        one = jax.tree_util.tree_map(
-            lambda c, ref: jnp.broadcast_to(jnp.asarray(c), ref.shape),
-            F.ONE_MONT,
-            x,
-        )
-        zero = jax.tree_util.tree_map(jnp.zeros_like, x)
-        return (one, one, zero)
+        x = pt[0]
+        one = jnp.broadcast_to(jnp.asarray(self.F.ONE), x.shape)
+        return (one, one, jnp.zeros_like(x))
 
     def generator_like(self, batch_shape):
-        """Generator broadcast to the given leading batch shape."""
         def bc(c):
             c = jnp.asarray(c)
             return jnp.broadcast_to(c, tuple(batch_shape) + c.shape)
 
-        return jax.tree_util.tree_map(bc, self.gen)
+        return tuple(bc(c) for c in self.gen)
 
     def is_infinity(self, pt):
         return self.F.is_zero(pt[2])
 
-    # -- group ops -------------------------------------------------------------
+    # -- group ops -------------------------------------------------------
 
     def neg(self, pt):
         return (pt[0], self.F.neg(pt[1]), pt[2])
 
     def double(self, pt):
-        """2001 Bernstein dbl: total — Z=0 or Y=0 inputs yield Z3=0."""
+        """dbl-2001-b: total — Z=0 or Y=0 inputs yield Z3=0. Independent
+        multiplies stacked per layer."""
         F = self.F
         x, y, z = pt
-        a = F.sqr(x)
-        b = F.sqr(y)
-        c = F.sqr(b)
-        d = F.scalar_small(F.sub(F.sub(F.sqr(F.add(x, b)), a), c), 2)
+        # layer 1: a = x^2, b = y^2, yz = y*z
+        l1 = F.mul(
+            jnp.stack([x, y, y], axis=-3),
+            jnp.stack([x, y, z], axis=-3),
+        )
+        a, b, yz = l1[..., 0, :, :], l1[..., 1, :, :], l1[..., 2, :, :]
+        # layer 2: c = b^2, xb2 = (x+b)^2, f = (3a)^2
         e = F.scalar_small(a, 3)
-        f = F.sqr(e)
+        xb = F.add(x, b)
+        l2 = F.mul(
+            jnp.stack([b, xb, e], axis=-3),
+            jnp.stack([b, xb, e], axis=-3),
+        )
+        c, xb2, f = l2[..., 0, :, :], l2[..., 1, :, :], l2[..., 2, :, :]
+        d = F.scalar_small(F.sub(F.sub(xb2, a), c), 2)
         x3 = F.sub(f, F.scalar_small(d, 2))
-        y3 = F.sub(F.mul(e, F.sub(d, x3)), F.scalar_small(c, 8))
-        z3 = F.scalar_small(F.mul(y, z), 2)
+        # layer 3: y3 = e*(d - x3) - 8c
+        y3 = F.sub(
+            F.mul(e, F.sub(d, x3)), F.scalar_small(c, 8)
+        )
+        z3 = F.scalar_small(yz, 2)
         return (x3, y3, z3)
 
     def add(self, p, q):
-        """Unified add: handles p==q, p==-q, and either side at infinity via
-        branchless selects."""
+        """Unified add handling p==q, p==-q, and infinities via selects."""
         F = self.F
         x1, y1, z1 = p
         x2, y2, z2 = q
         inf_p = self.is_infinity(p)
         inf_q = self.is_infinity(q)
 
-        z1s = F.sqr(z1)
-        z2s = F.sqr(z2)
-        u1 = F.mul(x1, z2s)
-        u2 = F.mul(x2, z1s)
-        s1 = F.mul(y1, F.mul(z2s, z2))
-        s2 = F.mul(y2, F.mul(z1s, z1))
+        # layer 1: z1^2, z2^2
+        l1 = F.mul(
+            jnp.stack([z1, z2], axis=-3), jnp.stack([z1, z2], axis=-3)
+        )
+        z1s, z2s = l1[..., 0, :, :], l1[..., 1, :, :]
+        # layer 2: u1 = x1 z2s, u2 = x2 z1s, z2c' = z2s*z2, z1c' = z1s*z1
+        l2 = F.mul(
+            jnp.stack([x1, x2, z2s, z1s], axis=-3),
+            jnp.stack([z2s, z1s, z2, z1], axis=-3),
+        )
+        u1, u2 = l2[..., 0, :, :], l2[..., 1, :, :]
+        z2c, z1c = l2[..., 2, :, :], l2[..., 3, :, :]
+        # layer 3: s1 = y1 z2c, s2 = y2 z1c
+        l3 = F.mul(
+            jnp.stack([y1, y2], axis=-3), jnp.stack([z2c, z1c], axis=-3)
+        )
+        s1, s2 = l3[..., 0, :, :], l3[..., 1, :, :]
+
         h = F.sub(u2, u1)
         r = F.sub(s2, s1)
         same_x = F.is_zero(h)
         same_y = F.is_zero(r)
 
-        # generic chord
-        i = F.sqr(F.scalar_small(h, 2))
-        j = F.mul(h, i)
+        h2 = F.scalar_small(h, 2)
         rr = F.scalar_small(r, 2)
-        v = F.mul(u1, i)
-        x3 = F.sub(F.sub(F.sqr(rr), j), F.scalar_small(v, 2))
-        y3 = F.sub(
-            F.mul(rr, F.sub(v, x3)), F.scalar_small(F.mul(s1, j), 2)
+        zz = F.mul(z1, z2)
+        # layer 4: i = (2h)^2, rr2 = rr^2, z3' = zz*h
+        l4 = F.mul(
+            jnp.stack([h2, rr, zz], axis=-3),
+            jnp.stack([h2, rr, h], axis=-3),
         )
-        z3 = F.scalar_small(F.mul(F.mul(z1, z2), h), 2)
+        i = l4[..., 0, :, :]
+        rr2 = l4[..., 1, :, :]
+        z3 = F.scalar_small(l4[..., 2, :, :], 2)
+        # layer 5: j = h*i, v = u1*i
+        l5 = F.mul(
+            jnp.stack([h, u1], axis=-3), jnp.stack([i, i], axis=-3)
+        )
+        j, v = l5[..., 0, :, :], l5[..., 1, :, :]
+        x3 = F.sub(F.sub(rr2, j), F.scalar_small(v, 2))
+        # layer 6: rr*(v - x3), s1*j
+        l6 = F.mul(
+            jnp.stack([rr, s1], axis=-3),
+            jnp.stack([F.sub(v, x3), j], axis=-3),
+        )
+        y3 = F.sub(l6[..., 0, :, :], F.scalar_small(l6[..., 1, :, :], 2))
         generic = (x3, y3, z3)
 
         dbl = self.double(p)
-        # p == -q (same x, different y) -> generic already yields z3 == 0.
         use_dbl = (~inf_p) & (~inf_q) & same_x & same_y
         out = self.select(use_dbl, dbl, generic)
         out = self.select(inf_q, p, out)
@@ -144,42 +204,42 @@ class JacobianGroup:
     def eq(self, p, q):
         F = self.F
         inf_p, inf_q = self.is_infinity(p), self.is_infinity(q)
-        z1s, z2s = F.sqr(p[2]), F.sqr(q[2])
-        ex = F.eq(F.mul(p[0], z2s), F.mul(q[0], z1s))
-        ey = F.eq(
-            F.mul(p[1], F.mul(z2s, q[2])), F.mul(q[1], F.mul(z1s, p[2]))
+        l1 = F.mul(
+            jnp.stack([p[2], q[2]], axis=-3),
+            jnp.stack([p[2], q[2]], axis=-3),
         )
+        z1s, z2s = l1[..., 0, :, :], l1[..., 1, :, :]
+        l2 = F.mul(
+            jnp.stack([p[0], q[0], z2s, z1s], axis=-3),
+            jnp.stack([z2s, z1s, q[2], p[2]], axis=-3),
+        )
+        ex = F.eq(l2[..., 0, :, :], l2[..., 1, :, :])
+        l3 = F.mul(
+            jnp.stack([p[1], q[1]], axis=-3),
+            jnp.stack([l2[..., 2, :, :], l2[..., 3, :, :]], axis=-3),
+        )
+        ey = F.eq(l3[..., 0, :, :], l3[..., 1, :, :])
         return (inf_p & inf_q) | ((~inf_p) & (~inf_q) & ex & ey)
 
     def to_affine(self, pt):
-        """Batched Jacobian -> affine: (x, y, is_infinity).
-
-        Uses the field inv(0) == 0 convention, so infinity maps to the
-        harmless sentinel (0, 0) with its mask bit set; downstream pairing
-        code masks those lanes out.
-        """
+        """(x_affine, y_affine, is_infinity); infinity maps to (0, 0)."""
         F = self.F
         x, y, z = pt
         zinv = F.inv(z)
         zinv2 = F.sqr(zinv)
-        return (
-            F.mul(x, zinv2),
-            F.mul(y, F.mul(zinv2, zinv)),
-            self.is_infinity(pt),
+        l = F.mul(
+            jnp.stack([x, zinv2], axis=-3),
+            jnp.stack([zinv2, zinv], axis=-3),
         )
+        x_aff = l[..., 0, :, :]
+        y_aff = F.mul(y, l[..., 1, :, :])
+        return (x_aff, y_aff, self.is_infinity(pt))
 
-    # -- scalar multiplication -------------------------------------------------
+    # -- scalar multiplication -------------------------------------------
 
     def mul_scalar_bits(self, pt, bits):
-        """Variable-scalar multiplication.
-
-        `bits` is an int32 array of shape (..., nbits), LSB-first, matching
-        pt's batch shape. One lax.scan over the bit axis: double-and-add with
-        a select per step.
-        """
-        F = self.F
-        nbits = bits.shape[-1]
-        bits_seq = jnp.moveaxis(bits, -1, 0)  # (nbits, ...)
+        """bits: (..., nbits) int32 LSB-first; one lax.scan ladder."""
+        bits_seq = jnp.moveaxis(bits, -1, 0)
 
         def step(carry, bit):
             acc, addend = carry
@@ -193,15 +253,12 @@ class JacobianGroup:
         return acc
 
     def mul_scalar_static(self, pt, k: int):
-        """Static-scalar multiplication via the same one-step scan graph as
-        `mul_scalar_bits` (a Python-unrolled ladder would inflate the HLO by
-        the bit length and blow up compile time)."""
         if k < 0:
             return self.mul_scalar_static(self.neg(pt), -k)
         if k == 0:
             return self.infinity_like(pt)
         nbits = k.bit_length()
-        batch = jax.tree_util.tree_leaves(pt)[0].shape[:-1]
+        batch = pt[0].shape[:-2]
         bits = jnp.broadcast_to(
             jnp.asarray(
                 np.array([(k >> i) & 1 for i in range(nbits)], np.int32)
@@ -210,106 +267,105 @@ class JacobianGroup:
         )
         return self.mul_scalar_bits(pt, bits)
 
-    # -- reductions ------------------------------------------------------------
+    # -- reductions ------------------------------------------------------
 
     def sum_axis(self, pts, axis: int = 0):
-        """Tree-fold sum of points along `axis` (log-depth batched adds).
-
-        Works on any length; odd levels carry the tail element through.
-        """
-        n = jax.tree_util.tree_leaves(pts)[0].shape[axis]
+        """Log-depth tree fold of points along a batch axis."""
+        n = pts[0].shape[axis]
         while n > 1:
             half = n // 2
-            a = jax.tree_util.tree_map(
-                lambda x: jax.lax.slice_in_dim(x, 0, half, axis=axis), pts
+            a = tuple(
+                jax.lax.slice_in_dim(c, 0, half, axis=axis) for c in pts
             )
-            b = jax.tree_util.tree_map(
-                lambda x: jax.lax.slice_in_dim(x, half, 2 * half, axis=axis),
-                pts,
+            b = tuple(
+                jax.lax.slice_in_dim(c, half, 2 * half, axis=axis)
+                for c in pts
             )
             s = self.add(a, b)
             if n % 2:
-                tail = jax.tree_util.tree_map(
-                    lambda x: jax.lax.slice_in_dim(x, n - 1, n, axis=axis),
-                    pts,
+                tail = tuple(
+                    jax.lax.slice_in_dim(c, n - 1, n, axis=axis)
+                    for c in pts
                 )
-                s = jax.tree_util.tree_map(
-                    lambda x, t: jnp.concatenate([x, t], axis=axis), s, tail
+                s = tuple(
+                    jnp.concatenate([x, t], axis=axis)
+                    for x, t in zip(s, tail)
                 )
             pts = s
             n = half + (n % 2)
-        return jax.tree_util.tree_map(
-            lambda x: jnp.squeeze(x, axis=axis), pts
-        )
+        return tuple(jnp.squeeze(c, axis=axis) for c in pts)
 
     def masked_sum_axis(self, pts, mask, axis: int = 0):
-        """Sum with a boolean mask (False lanes contribute infinity)."""
         inf = self.infinity_like(pts)
         masked = self.select(mask, pts, inf)
         return self.sum_axis(masked, axis=axis)
 
 
-# -- host conversion helpers ----------------------------------------------------
+# -- host conversion helpers ---------------------------------------------
 
 
 def g1_pack(ref_pts):
-    """Host: list of ref Jacobian G1 points (int tuples) -> device batch in
-    Montgomery form."""
-    xs = fp.to_mont(fp.pack([p[0] for p in ref_pts]))
-    ys = fp.to_mont(fp.pack([p[1] for p in ref_pts]))
-    zs = fp.to_mont(fp.pack([p[2] for p in ref_pts]))
-    return (xs, ys, zs)
+    """Host: ref Jacobian G1 points -> device bundles (Montgomery)."""
+    coords = []
+    for idx in range(3):
+        arr = np.stack(
+            [fb.pack_ints([p[idx]]) for p in ref_pts]
+        )  # (N, 1, NB)
+        coords.append(fb.to_mont(jnp.asarray(arr)))
+    return tuple(coords)
 
 
 def g1_unpack(pt):
-    """Host: device G1 batch -> list of ref Jacobian int tuples."""
-    xs, ys, zs = (np.asarray(fp.from_mont(c)) for c in pt)
-    flat = lambda a: a.reshape(-1, a.shape[-1])
-    return [
-        (fp.to_int(x), fp.to_int(y), fp.to_int(z))
-        for x, y, z in zip(flat(xs), flat(ys), flat(zs))
-    ]
-
-
-def g2_pack(ref_pts):
-    """Host: list of ref Jacobian G2 points (Fp2 tuples) -> device batch."""
-    comps = []
-    for idx in range(3):
-        comps.append(fp2.to_mont(fp2.pack([p[idx] for p in ref_pts])))
-    return tuple(comps)
-
-
-def g2_unpack(pt):
+    xs, ys, zs = (np.asarray(fb.from_mont(c)) for c in pt)
     out = []
-    comps = [fp2.to_ints(fp2.from_mont(c)) for c in pt]
-    for x, y, z in zip(*comps):
-        out.append((x, y, z))
+    for x, y, z in zip(
+        xs.reshape(-1, NB), ys.reshape(-1, NB), zs.reshape(-1, NB)
+    ):
+        vals = fb.unpack_ints(np.stack([x, y, z]))
+        out.append((vals[0], vals[1], vals[2]))
     return out
 
 
+def g2_pack(ref_pts):
+    coords = []
+    for idx in range(3):
+        arr = np.stack(
+            [fb.pack_ints([p[idx][0], p[idx][1]]) for p in ref_pts]
+        )  # (N, 2, NB)
+        coords.append(fb.to_mont(jnp.asarray(arr)))
+    return tuple(coords)
+
+
+def g2_unpack(pt):
+    comps = []
+    for c in pt:
+        arr = np.asarray(fb.from_mont(c)).reshape(-1, 2, NB)
+        comps.append([tuple(fb.unpack_ints(row)) for row in arr])
+    return list(zip(*comps))
+
+
 def scalars_to_bits(scalars, nbits: int) -> np.ndarray:
-    """Host: list of ints -> (N, nbits) int32 LSB-first bit array."""
     return np.array(
         [[(s >> i) & 1 for i in range(nbits)] for s in scalars],
         dtype=np.int32,
     )
 
 
-# -- concrete groups -------------------------------------------------------------
+# -- concrete groups -------------------------------------------------------
 
 G1 = JacobianGroup(
-    fp,
-    _mont(B_G1),
-    (_mont(G1_X), _mont(G1_Y)),
+    F1,
+    _mont1(B_G1),
+    (_mont1(G1_X), _mont1(G1_Y)),
     "G1",
 )
 
 G2 = JacobianGroup(
-    fp2,
-    (_mont(B_G2[0]), _mont(B_G2[1])),
+    F2,
+    fp2m.const_mont(B_G2[0], B_G2[1]),
     (
-        (_mont(G2_X[0]), _mont(G2_X[1])),
-        (_mont(G2_Y[0]), _mont(G2_Y[1])),
+        fp2m.const_mont(G2_X[0], G2_X[1]),
+        fp2m.const_mont(G2_Y[0], G2_Y[1]),
     ),
     "G2",
 )

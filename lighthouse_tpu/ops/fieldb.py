@@ -1,0 +1,337 @@
+"""Array-native ("bundled") BLS12-381 field arithmetic.
+
+The scalar-composed tower in ops.fp/fp2/tower builds one jaxpr equation per
+limb-level operation, which made the Miller-loop graph ~30k equations —
+infeasible to trace/compile. This module is the TPU-native layout:
+
+- A value bundle is an int32 array `(..., S, NB)`: S field "slots"
+  (Fp2 = 2, Fp6 = 6, Fp12 = 12, a G2 coordinate = 2, ...), NB = 33 limbs of
+  12 bits (one spare limb beyond 384 bits gives linear-combination
+  headroom).
+- LINEAR algebra over slots (Karatsuba sums, xi-multiplications, tower
+  recombination, negation, small scalars) is ONE einsum against a small
+  static integer matrix — `apply_combo` — instead of per-slot graphs.
+- All the independent Montgomery products of a tower multiplication run as
+  ONE stacked convolution (`mul_lazy`), e.g. an Fp12 product is a single
+  18-slot multiply.
+- Values are kept *lazily reduced*: canonical limbs in [0, 2^12), value in
+  [0, ~2.2p). Exact canonicalization to [0, p) happens only in predicates
+  (`canon`, `eq`, `is_zero`) and at host boundaries. Bound bookkeeping:
+    mul_lazy inputs  < 2.2p  -> T < 4.84 p^2 < R p  (REDC valid)
+    mul_lazy output  < T/R + 1.0003p < 1.5p
+    apply_combo: |result before offset| < L1 * 2.2p; adding the 120p
+    spread offset keeps limbs non-negative for L1 <= 12, and
+    `reduce_small` (top-two-limb quotient estimate against 2p) returns
+    values < 2.2p.
+
+The multiplication *programs* (which slot combinations feed which product,
+and how products recombine) are built symbolically at import time from the
+same tower formulas validated in crypto/ref_fields — see `_BilinearBuilder`.
+
+Parity note: this plane replaces blst's field/tower arithmetic behind the
+reference's BLS boundary (crypto/bls/src/impls/blst.rs), re-laid-out for
+MXU/VPU execution.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import (
+    LIMB_BITS,
+    LIMB_MASK,
+    MONT_R_MOD_P,
+    MONT_R2_MOD_P,
+    NLIMBS,
+    P,
+    int_to_limbs,
+)
+
+NB = NLIMBS + 1  # bundle limb count (one headroom limb)
+_TOP = NB - 1
+
+_NPRIME_INT = (-pow(P, -1, 1 << (LIMB_BITS * NLIMBS))) % (
+    1 << (LIMB_BITS * NLIMBS)
+)
+NPRIME_LIMBS = np.array(int_to_limbs(_NPRIME_INT), dtype=np.int32)
+P_LIMBS32 = np.array(int_to_limbs(P), dtype=np.int32)
+
+
+def _limbs(v: int, n: int) -> np.ndarray:
+    return np.array(
+        [(v >> (LIMB_BITS * i)) & LIMB_MASK for i in range(n)],
+        dtype=np.int32,
+    )
+
+
+ZERO_B = np.zeros(NB, dtype=np.int32)
+ONE_MONT_B = _limbs(MONT_R_MOD_P, NB)
+R2_B = _limbs(MONT_R2_MOD_P, NB)
+
+# 2^396 - 2p: adding q copies == subtracting q*2p mod 2^396.
+COMP_2P = _limbs((1 << (LIMB_BITS * NB)) - 2 * P, NB)
+# 2^396 - p (for canonicalization cond-subtract)
+COMP_P = _limbs((1 << (LIMB_BITS * NB)) - P, NB)
+
+# Offset constant for signed combos: value 360p, limbs spread so every limb
+# except the top is >= 36*4096 - 36 (covers combos with L1 norm <= 36 — the
+# Fp12 recombination rows reach 36). Bound chain: combo result + offset
+# < (36*2.2 + 360)p = 439p < 2^391 << 2^396, and reduce_small's top-two-limb
+# quotient estimate stays exact for values < 2^24 * 2^372.
+_OFF_K = 36
+OFF_CONST = _limbs(360 * P, NB)
+for _i in range(NB - 1):
+    OFF_CONST[_i] += _OFF_K << LIMB_BITS
+    OFF_CONST[_i + 1] -= _OFF_K
+assert OFF_CONST.min() >= 0 and OFF_CONST[:-1].min() >= _OFF_K * 4095
+
+# Subtraction constant: value 16p, limbs spread by one unit (covers
+# subtracting any canonical-limbed value < 2.2p... limbs <= 4095).
+SPREAD_16P = _limbs(16 * P, NB)
+for _i in range(NB - 1):
+    SPREAD_16P[_i] += 1 << LIMB_BITS
+    SPREAD_16P[_i + 1] -= 1
+assert SPREAD_16P.min() >= 0 and SPREAD_16P[:-1].min() >= 4095
+
+# Convolution masks (i + j == k), full and low-truncated.
+_CONV_FULL = np.zeros((NB, NB, 2 * NB - 1), dtype=np.int32)
+for _i in range(NB):
+    for _j in range(NB):
+        _CONV_FULL[_i, _j, _i + _j] = 1
+_CONV_LOW32 = np.zeros((NLIMBS, NLIMBS, NLIMBS), dtype=np.int32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        if _i + _j < NLIMBS:
+            _CONV_LOW32[_i, _j, _i + _j] = 1
+_CONV_MP = np.zeros((NLIMBS, NLIMBS, 2 * NLIMBS - 1), dtype=np.int32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _CONV_MP[_i, _j, _i + _j] = 1
+
+
+# ----------------------------------------------------------- carry handling
+
+
+def _pad_last(x, n):
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, n)])
+
+
+def _partial_pass(x):
+    c = x >> LIMB_BITS
+    d = x & LIMB_MASK
+    return d + jnp.pad(c[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+
+
+def _ks_resolve(x):
+    """Kogge-Stone carry resolution; limbs must be in [0, 2*2^12 - 2] with
+    unit carries. Returns (canonical limbs, top carry-out)."""
+    g = x > LIMB_MASK
+    p = x == LIMB_MASK
+    shift = 1
+    L = x.shape[-1]
+    gg, pp = g, p
+    while shift < L:
+        pad = [(0, 0)] * (x.ndim - 1) + [(shift, 0)]
+        gg_prev = jnp.pad(gg[..., :-shift], pad)
+        pp_prev = jnp.pad(pp[..., :-shift], pad)
+        gg = gg | (pp & gg_prev)
+        pp = pp & pp_prev
+        shift *= 2
+    carry_in = jnp.pad(
+        gg[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)]
+    ).astype(jnp.int32)
+    return (x + carry_in) & LIMB_MASK, gg[..., -1]
+
+
+def _normalize(x, out_len):
+    """Non-negative limbs (< 2^30) -> canonical limbs. Value beyond
+    2^(12*out_len) is truncated (callers use this deliberately for mod-R /
+    mod-2^396 arithmetic)."""
+    in_len = x.shape[-1]
+    if in_len < out_len:
+        x = _pad_last(x, out_len - in_len)
+    elif in_len > out_len:
+        x = x[..., :out_len]
+        # carries out of the kept range are multiples of the modulus the
+        # caller reduces by; dropping them is intentional
+    x = _partial_pass(x)
+    x = _partial_pass(x)
+    x = _partial_pass(x)
+    out, _ = _ks_resolve(x)
+    return out
+
+
+def reduce_small(x):
+    """Canonical-limbed x (NB limbs, value < ~2^24 * 2^372) -> value < 2.2p.
+
+    Quotient estimate from the top two limbs against 2p (2p < 833*2^372):
+    q = (x >> 372) // 833 satisfies q*2p <= x, and the remainder is
+    bounded < 2.2p (see module docstring analysis)."""
+    t2 = x[..., _TOP] * (1 << LIMB_BITS) + x[..., _TOP - 1]
+    q = t2 // 833
+    return _normalize(x + q[..., None] * jnp.asarray(COMP_2P), NB)
+
+
+def _cond_sub(x, comp_const):
+    """Subtract the complement's value iff x >= value (exact compare)."""
+    s = x + jnp.asarray(comp_const)
+    c = s >> LIMB_BITS
+    d = s & LIMB_MASK
+    top1 = c[..., -1]
+    s = d + jnp.pad(c[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    out, top2 = _ks_resolve(s)
+    ge = (top1 + top2.astype(jnp.int32)) > 0
+    return jnp.where(ge[..., None], out, x)
+
+
+def canon(x):
+    """Lazy value (< 2.2p... < 3p) -> exact canonical [0, p)."""
+    x = _cond_sub(x, COMP_2P)
+    return _cond_sub(x, COMP_P)
+
+
+# ------------------------------------------------------------- multiplies
+
+
+def mul_lazy(a, b):
+    """Stacked Montgomery product over the slot axis: (..., S, NB) x
+    (..., S, NB) -> (..., S, NB); inputs < 2.2p, output < 1.5p."""
+    t = _normalize(
+        jnp.einsum(
+            "...ij,ijk->...k",
+            a[..., :, None] * b[..., None, :],
+            jnp.asarray(_CONV_FULL),
+        ),
+        2 * NB,
+    )
+    t_low = t[..., :NLIMBS]
+    m = _normalize(
+        jnp.einsum(
+            "...ij,ijk->...k",
+            t_low[..., :, None] * jnp.asarray(NPRIME_LIMBS)[None, :],
+            jnp.asarray(_CONV_LOW32),
+        ),
+        NLIMBS + 1,
+    )[..., :NLIMBS]
+    mp = jnp.einsum(
+        "...ij,ijk->...k",
+        m[..., :, None] * jnp.asarray(P_LIMBS32)[None, :],
+        jnp.asarray(_CONV_MP),
+    )
+    full = _normalize(t + _pad_last(mp, 2 * NB - mp.shape[-1]), 2 * NB)
+    return full[..., NLIMBS : NLIMBS + NB]
+
+
+def sqr_lazy(a):
+    return mul_lazy(a, a)
+
+
+# --------------------------------------------------------------- combos
+
+
+def apply_combo(x, matrix):
+    """Static small-integer slot recombination: (..., S_in, NB) -> (...,
+    S_out, NB), each output < 2.2p. Matrix L1 row norms must be <= 12."""
+    m = np.asarray(matrix, dtype=np.int32)
+    assert np.abs(m).sum(axis=1).max() <= _OFF_K, "combo L1 too large"
+    y = jnp.einsum("os,...sn->...on", jnp.asarray(m), x)
+    y = _normalize(y + jnp.asarray(OFF_CONST), NB)
+    return reduce_small(y)
+
+
+def add(a, b):
+    s = _partial_pass(a + b)
+    out, _ = _ks_resolve(s)
+    return reduce_small(out)
+
+
+def sub(a, b):
+    s = _partial_pass(a - b + jnp.asarray(SPREAD_16P))
+    out, _ = _ks_resolve(s)
+    return reduce_small(out)
+
+
+def neg(a):
+    return sub(jnp.zeros_like(a), a)
+
+
+def scalar_small(a, k: int):
+    if k == 0:
+        return jnp.zeros_like(a)
+    s = a * k  # limbs <= 12*4095 for k <= 12
+    assert k <= _OFF_K
+    return reduce_small(_normalize(s, NB))
+
+
+# ------------------------------------------------------------- predicates
+
+
+def is_zero(a):
+    """Batched per-slot-group zero test; reduces over the slot axis."""
+    return jnp.all(canon(a) == 0, axis=(-2, -1))
+
+
+def eq(a, b):
+    return jnp.all(canon(a) == canon(b), axis=(-2, -1))
+
+
+def select(cond, a, b):
+    """cond broadcasts over (slots, limbs)."""
+    return jnp.where(cond[..., None, None], a, b)
+
+
+# ----------------------------------------------------- static powers / inv
+
+
+def pow_const(a, exponent: int):
+    """a^e per slot (Montgomery), static exponent, fori_loop ladder."""
+    nbits = max(1, exponent.bit_length())
+    bits = jnp.asarray(
+        np.array([(exponent >> i) & 1 for i in range(nbits)], np.int32)
+    )
+
+    def body(i, carry):
+        result, base = carry
+        mult = mul_lazy(result, base)
+        result = jnp.where(bits[i] == 1, mult, result)
+        base = sqr_lazy(base)
+        return result, base
+
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT_B), a.shape)
+    result, _ = jax.lax.fori_loop(0, nbits, body, (one, a))
+    return result
+
+
+def inv(a):
+    """Per-slot Fermat inverse; inv(0) == 0."""
+    return pow_const(a, P - 2)
+
+
+# --------------------------------------------------------- host converters
+
+
+def pack_ints(values) -> np.ndarray:
+    """Host: list of ints -> (S, NB) canonical limb bundle (plain domain)."""
+    return np.stack([_limbs(v % P, NB) for v in values])
+
+
+def unpack_ints(bundle) -> list:
+    out = []
+    arr = np.asarray(bundle)
+    flat = arr.reshape(-1, arr.shape[-1])
+    for row in flat:
+        acc = 0
+        for i, limb in enumerate(row):
+            acc += int(limb) << (LIMB_BITS * i)
+        out.append(acc % P)
+    return out
+
+
+def to_mont(a):
+    return mul_lazy(a, jnp.broadcast_to(jnp.asarray(R2_B), a.shape))
+
+
+def from_mont(a):
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    return canon(mul_lazy(a, one))

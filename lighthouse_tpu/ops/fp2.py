@@ -1,125 +1,132 @@
-"""Batched BLS12-381 quadratic-extension (Fp2) arithmetic on device limbs.
+"""Batched BLS12-381 Fp2 arithmetic on slot bundles.
 
-Fp2 = Fp[u]/(u^2 + 1). An element is a 2-tuple `(c0, c1)` of `(..., NLIMBS)`
-int32 limb arrays (see `lighthouse_tpu.ops.fp`), giving c0 + c1*u. Tuples are
-JAX pytrees, so Fp2 values flow through jit/vmap/scan unchanged.
+An Fp2 value is an int32 bundle `(..., 2, NB)` (see ops.fieldb): slot 0 =
+c0, slot 1 = c1 of c0 + c1*u, u^2 = -1. Multiplication is the 3-product
+Karatsuba bilinear program applied as ONE stacked Montgomery multiply.
 
-Multiplicative ops assume the Montgomery domain (as all device field values
-on the hot path are); additive ops are domain-agnostic.
-
-Parity note: fills the role of blst's fp2 arithmetic behind the reference
-client's BLS boundary (reference crypto/bls/src/impls/blst.rs); validated
-against `lighthouse_tpu.crypto.ref_fields` (fp2_*).
+Values are lazily reduced (< 2.2p); canonicalization happens in predicates
+and at the host boundary. Validated against crypto/ref_fields.fp2_*.
 """
+
+import numpy as np
 
 import jax.numpy as jnp
 
-from lighthouse_tpu.ops import fp
+from lighthouse_tpu.crypto.constants import P
+from lighthouse_tpu.ops import fieldb as fb
+from lighthouse_tpu.ops.programs import FP2_MUL
 
-ZERO = (fp.ZERO, fp.ZERO)
-ONE_MONT = (fp.ONE_MONT, fp.ZERO)
+NB = fb.NB
+
+ZERO = np.zeros((2, NB), dtype=np.int32)
+ONE_MONT = np.stack([fb.ONE_MONT_B, fb.ZERO_B])
+
+# combo matrices
+_CONJ = np.array([[1, 0], [0, -1]], dtype=np.int32)
+_MUL_BY_XI = np.array([[1, -1], [1, 1]], dtype=np.int32)
+_NEG = -np.eye(2, dtype=np.int32)
 
 
-def pack(values):
-    """Host: iterable of (c0, c1) int tuples -> Fp2 batch (canonical form)."""
-    return (
-        fp.pack([v[0] for v in values]),
-        fp.pack([v[1] for v in values]),
-    )
+def bilinear(x, y, prog):
+    left = fb.apply_combo(x, prog.A)
+    right = fb.apply_combo(y, prog.B)
+    return fb.apply_combo(fb.mul_lazy(left, right), prog.C)
+
+
+# ------------------------------------------------------------- host helpers
+
+
+def pack(values) -> np.ndarray:
+    """Host: iterable of (c0, c1) int tuples -> (N, 2, NB) bundle (plain
+    domain, canonical)."""
+    return np.stack([fb.pack_ints([v[0], v[1]]) for v in values])
 
 
 def to_ints(a):
-    """Host: Fp2 batch -> list of (c0, c1) int tuples."""
-    c0, c1 = a
-    import numpy as np
-
-    c0 = np.asarray(c0).reshape(-1, c0.shape[-1])
-    c1 = np.asarray(c1).reshape(-1, c1.shape[-1])
-    return [(fp.to_int(x), fp.to_int(y)) for x, y in zip(c0, c1)]
+    """Host: (..., 2, NB) bundle -> list of (c0, c1) int tuples."""
+    vals = fb.unpack_ints(a)
+    return [(vals[i], vals[i + 1]) for i in range(0, len(vals), 2)]
 
 
 def to_mont(a):
-    return (fp.to_mont(a[0]), fp.to_mont(a[1]))
+    return fb.to_mont(a)
 
 
 def from_mont(a):
-    return (fp.from_mont(a[0]), fp.from_mont(a[1]))
+    return fb.from_mont(a)
+
+
+# -------------------------------------------------------------- field ops
 
 
 def add(a, b):
-    return (fp.add(a[0], b[0]), fp.add(a[1], b[1]))
+    return fb.add(a, b)
 
 
 def sub(a, b):
-    return (fp.sub(a[0], b[0]), fp.sub(a[1], b[1]))
+    return fb.sub(a, b)
 
 
 def neg(a):
-    return (fp.neg(a[0]), fp.neg(a[1]))
+    return fb.apply_combo(a, _NEG)
 
 
 def conj(a):
-    return (a[0], fp.neg(a[1]))
+    return fb.apply_combo(a, _CONJ)
 
 
 def scalar_small(a, k: int):
-    return (fp.scalar_small(a[0], k), fp.scalar_small(a[1], k))
+    return fb.scalar_small(a, k)
 
 
 def mul(a, b):
-    """Karatsuba: 3 base-field Montgomery products."""
-    a0, a1 = a
-    b0, b1 = b
-    t0 = fp.mont_mul(a0, b0)
-    t1 = fp.mont_mul(a1, b1)
-    cross = fp.mont_mul(fp.add(a0, a1), fp.add(b0, b1))
-    return (fp.sub(t0, t1), fp.sub(fp.sub(cross, t0), t1))
+    return bilinear(a, b, FP2_MUL)
 
 
 def sqr(a):
-    """(a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u — 2 products."""
-    a0, a1 = a
-    c0 = fp.mont_mul(fp.add(a0, a1), fp.sub(a0, a1))
-    t = fp.mont_mul(a0, a1)
-    return (c0, fp.add(t, t))
+    return bilinear(a, a, FP2_MUL)
 
 
 def mul_fp(a, s):
-    """Multiply Fp2 element by an Fp element (both Montgomery)."""
-    return (fp.mont_mul(a[0], s), fp.mont_mul(a[1], s))
+    """Fp2 bundle times an Fp bundle (..., 1, NB): per-slot product."""
+    return fb.mul_lazy(a, jnp.broadcast_to(s, a.shape))
 
 
 def mul_by_xi(a):
-    """Multiply by xi = 1 + u: (c0 - c1) + (c0 + c1) u."""
-    return (fp.sub(a[0], a[1]), fp.add(a[0], a[1]))
+    return fb.apply_combo(a, _MUL_BY_XI)
 
 
 def inv(a):
-    """1 / (a0 + a1 u) = (a0 - a1 u) / (a0^2 + a1^2). inv(0) = 0."""
-    a0, a1 = a
-    norm = fp.add(fp.mont_mul(a0, a0), fp.mont_mul(a1, a1))
-    ninv = fp.inv(norm)
-    return (fp.mont_mul(a0, ninv), fp.neg(fp.mont_mul(a1, ninv)))
+    """1/(c0 + c1 u) = (c0 - c1 u)/(c0^2 + c1^2); inv(0) == 0."""
+    sq = fb.mul_lazy(a, a)  # (c0^2, c1^2)
+    norm = fb.apply_combo(sq, np.array([[1, 1]], dtype=np.int32))
+    ninv = fb.inv(norm)  # (..., 1, NB)
+    scaled = fb.mul_lazy(a, jnp.broadcast_to(ninv, a.shape))
+    return fb.apply_combo(scaled, _CONJ)
 
 
 def is_zero(a):
-    return fp.is_zero(a[0]) & fp.is_zero(a[1])
+    return fb.is_zero(a)
 
 
 def eq(a, b):
-    return fp.eq(a[0], b[0]) & fp.eq(a[1], b[1])
+    return fb.eq(a, b)
 
 
 def select(cond, a, b):
-    """Branchless select; cond broadcasts over the limb axis."""
-    return (fp.select(cond, a[0], b[0]), fp.select(cond, a[1], b[1]))
+    return fb.select(cond, a, b)
 
 
-def broadcast_const(const_limbs, shape_like):
-    """Broadcast a static (2, NLIMBS)-style tuple constant over batch dims of
-    `shape_like` (an Fp limb array)."""
-    batch = shape_like.shape[:-1]
-    return tuple(
-        jnp.broadcast_to(jnp.asarray(c), batch + (c.shape[-1],))
-        for c in const_limbs
+def broadcast_const(const_bundle, batch_shape):
+    c = jnp.asarray(const_bundle)
+    return jnp.broadcast_to(c, tuple(batch_shape) + c.shape)
+
+
+def const_mont(c0: int, c1: int) -> np.ndarray:
+    """Static (c0, c1) -> Montgomery-form bundle constant."""
+    return np.stack(
+        [
+            fb._limbs((c0 << 384) % P, NB),
+            fb._limbs((c1 << 384) % P, NB),
+        ]
     )

@@ -1,32 +1,15 @@
-"""Batched optimal ate pairing on BLS12-381, on-device.
+"""Batched optimal ate pairing on BLS12-381, on slot bundles.
 
-Mirrors the math of `lighthouse_tpu.crypto.ref_pairing` (the validated
-ground truth) but re-derived for device execution:
+Same math as the validated scalar implementation (see ops history /
+crypto/ref_pairing): inversion-free Jacobian twist Miller loop whose line
+scalings live in Fp2 (annihilated by the final exponentiation), one
+`lax.scan` over the 63 fixed bits of |x|, sparse line multiplication
+(ops.programs.LINE_MUL — one 13-product stacked multiply), and the
+(x-1)^2 (x+p)(x^2+p^2-1)+3 final-exponentiation addition chain.
 
-- The Miller loop runs in **Jacobian twist coordinates with no field
-  inversions**. The affine line through T (slope lam = 3x^2/2y resp.
-  (y2-y1)/(x2-x1)) is scaled by the nonzero Fp2 factors 2*Y*Z^3 resp.
-  Z1*gamma; such factors lie in a proper subfield of Fp12 and are
-  annihilated by the final exponentiation, so the pairing value is
-  unchanged (same argument as the w^3 scaling in ref_pairing).
-
-      dbl line * 2YZ^3   = (3X^3 - 2Y^2) - (3 X^2 Z^2 px) w^2 + (2 Y Z^3 py) w^3
-      add line * Z1*gam  = (th*x2 - y2*Z1*gam) - (th*px) w^2 + (Z1*gam*py) w^3
-          with th = y2 Z1^3 - Y1, gam = x2 Z1^2 - X1
-
-- The loop over the 63 fixed bits of |x| is a single `lax.scan`: every step
-  doubles and (mask-)adds branchlessly, so the compiled graph is one step
-  long. Pairs are batched along leading axes; infinity on either side is
-  handled by forcing that pair's line to 1 (so it contributes nothing),
-  matching ref_pairing's skip of infinity pairs.
-
-- `multi_pairing_is_one` = per-pair Miller loops -> tree product ->
-  ONE shared final exponentiation, the exact structure of the reference
-  backend's batch verify (crypto/bls/src/impls/blst.rs:36-119, one
-  multi-pairing for the whole signature-set batch).
-
-Sparse Fp12 line multiplication (only the w^0, w^2, w^3 tower slots are
-nonzero) is exploited in `_mul_by_line`.
+`multi_pairing_is_one` = per-pair Miller -> tree product -> ONE shared
+final exponentiation, the exact structure of the reference backend's batch
+verify (crypto/bls/src/impls/blst.rs:36-119).
 """
 
 import numpy as np
@@ -35,103 +18,102 @@ import jax
 import jax.numpy as jnp
 
 from lighthouse_tpu.crypto.constants import BLS_X, BLS_X_ABS
-from lighthouse_tpu.ops import curve, fp, fp2, tower
+from lighthouse_tpu.ops import curve, fieldb as fb, fp2, tower
+from lighthouse_tpu.ops.programs import LINE_MUL
 
-# Bits of |x| after the leading one, MSB-first (static loop program).
-_X_BITS = np.array(
-    [int(b) for b in bin(BLS_X_ABS)[3:]], dtype=np.int32
-)
+NB = fb.NB
 
-
-# ------------------------------------------------------------- line algebra
-
-
-def _line_elements(c0, c2, c3):
-    """Assemble the sparse Fp12 line (w^0: Fp2, w^2: Fp2, w^3: Fp2).
-
-    Tower slots: w^2 = v -> (part0, v^1); w^3 = w*v -> (part1, v^1).
-    """
-    return (c0, c2, c3)
+_X_BITS = np.array([int(b) for b in bin(BLS_X_ABS)[3:]], dtype=np.int32)
 
 
 def _mul_by_line(f, line):
-    """f * (c0 + c2 w^2 + c3 w^3) exploiting sparsity.
-
-    The line as a full Fp12 element is ((c0, c2, 0), (0, c3, 0)) over
-    Fp6 = Fp2 + Fp2 v + Fp2 v^2, Fp12 = Fp6 + Fp6 w. We expand the
-    Karatsuba fp12_mul with b0 = (c0, c2, 0), b1 = (0, c3, 0).
-    """
-    c0, c2, c3 = line
-    b0 = (c0, c2, fp2_zero_like(c0))
-    b1 = (fp2_zero_like(c0), c3, fp2_zero_like(c0))
-    return tower.fp12_mul(f, (b0, b1))
+    """f (..., 12, NB) times the sparse line (..., 6, NB)."""
+    return fp2.bilinear(f, line, LINE_MUL)
 
 
-def fp2_zero_like(a):
-    return jax.tree_util.tree_map(jnp.zeros_like, a)
-
-
-def _line_one_like(c0):
-    one = fp2.broadcast_const(fp2.ONE_MONT, c0[0])
-    zero = fp2_zero_like(c0)
-    return (one, zero, zero)
+def _batch_shape(f):
+    return f.shape[:-2]
 
 
 # ---------------------------------------------------------------- the loop
 
 
 def _dbl_step(t, px, py):
-    """Tangent line at Jacobian twist point t, evaluated at affine P=(px,py)
-    (Fp Montgomery limbs), and the doubled point. No inversions."""
+    """Tangent line at Jacobian twist point t evaluated at affine
+    P=(px, py) (Fp bundles), plus 2t. Line = 3X^3 - 2Y^2
+    - (3 X^2 Z^2 px) w^2 + (2 Y Z^3 py) w^3 (scaled by 2YZ^3 in Fp2)."""
     X, Y, Z = t
-    x2 = fp2.sqr(X)
-    x3 = fp2.mul(x2, X)
-    y2 = fp2.sqr(Y)
-    z2 = fp2.sqr(Z)
-    z3 = fp2.mul(z2, Z)
-    yz3 = fp2.mul(Y, z3)
-    c0 = fp2.sub(fp2.scalar_small(x3, 3), fp2.scalar_small(y2, 2))
-    c2 = fp2.neg(fp2.mul_fp(fp2.scalar_small(fp2.mul(x2, z2), 3), px))
-    c3 = fp2.mul_fp(fp2.scalar_small(yz3, 2), py)
-    t_next = curve.G2.double(t)
-    return t_next, _line_elements(c0, c2, c3)
+    F = curve.F2
+    l1 = F.mul(
+        jnp.stack([X, Y, Z], axis=-3), jnp.stack([X, Y, Z], axis=-3)
+    )
+    x2, y2, z2 = l1[..., 0, :, :], l1[..., 1, :, :], l1[..., 2, :, :]
+    l2 = F.mul(
+        jnp.stack([x2, z2, x2], axis=-3),
+        jnp.stack([X, Z, z2], axis=-3),
+    )
+    x3c, z3c, x2z2 = (
+        l2[..., 0, :, :],
+        l2[..., 1, :, :],
+        l2[..., 2, :, :],
+    )
+    yz3 = F.mul(Y, z3c)
+    c0 = F.sub(F.scalar_small(x3c, 3), F.scalar_small(y2, 2))
+    c2 = F.neg(
+        fb.mul_lazy(
+            F.scalar_small(x2z2, 3), jnp.broadcast_to(px, x2z2.shape)
+        )
+    )
+    c3 = fb.mul_lazy(
+        F.scalar_small(yz3, 2), jnp.broadcast_to(py, yz3.shape)
+    )
+    line = jnp.concatenate([c0, c2, c3], axis=-2)
+    return curve.G2.double(t), line
 
 
 def _add_step(t, q_affine, px, py):
-    """Chord line through t and the affine twist point q, evaluated at P,
-    plus t + q. No inversions; q must not equal +-t (guaranteed in the
-    Miller loop for points of odd prime order r since the running T is
-    always a proper multiple of q in (1, r))."""
+    """Chord line through t and affine twist q evaluated at P, plus t+q.
+    Valid when q != +-t (guaranteed: the running T is a proper multiple of
+    q below the group order)."""
     X1, Y1, Z1 = t
     qx, qy = q_affine
-    z1s = fp2.sqr(Z1)
-    z1c = fp2.mul(z1s, Z1)
-    theta = fp2.sub(fp2.mul(qy, z1c), Y1)
-    gamma = fp2.sub(fp2.mul(qx, z1s), X1)
-    z1gam = fp2.mul(Z1, gamma)
-    c0 = fp2.sub(fp2.mul(theta, qx), fp2.mul(qy, z1gam))
-    c2 = fp2.neg(fp2.mul_fp(theta, px))
-    c3 = fp2.mul_fp(z1gam, py)
-    q_jac = (qx, qy, fp2.broadcast_const(fp2.ONE_MONT, qx[0]))
-    t_next = curve.G2.add(t, q_jac)
-    return t_next, _line_elements(c0, c2, c3)
+    F = curve.F2
+    z1s = F.sqr(Z1)
+    l2 = F.mul(
+        jnp.stack([z1s, qx], axis=-3), jnp.stack([Z1, z1s], axis=-3)
+    )
+    z1c, qxz = l2[..., 0, :, :], l2[..., 1, :, :]
+    qyz = F.mul(qy, z1c)
+    theta = F.sub(qyz, Y1)
+    gamma = F.sub(qxz, X1)
+    z1gam = F.mul(Z1, gamma)
+    l3 = F.mul(
+        jnp.stack([theta, qy], axis=-3),
+        jnp.stack([qx, z1gam], axis=-3),
+    )
+    c0 = F.sub(l3[..., 0, :, :], l3[..., 1, :, :])
+    c2 = F.neg(
+        fb.mul_lazy(theta, jnp.broadcast_to(px, theta.shape))
+    )
+    c3 = fb.mul_lazy(z1gam, jnp.broadcast_to(py, z1gam.shape))
+    line = jnp.concatenate([c0, c2, c3], axis=-2)
+    one = jnp.broadcast_to(jnp.asarray(curve.F2.ONE), qx.shape)
+    t_next = curve.G2.add(t, (qx, qy, one))
+    return t_next, line
 
 
 def miller_loop(p_g1_affine, q_g2_affine, valid_mask=None):
-    """Batched Miller loop f_{x,Q}(P) over pairs of affine points.
+    """Batched Miller loop f_{x,Q}(P).
 
-    p_g1_affine: (px, py) Fp limb arrays (Montgomery), batched.
-    q_g2_affine: (qx, qy) Fp2 tuples (Montgomery), batched.
-    valid_mask:  optional bool batch; False pairs contribute f = 1
-                 (the analog of ref_pairing skipping infinity pairs).
-
-    Returns a batched Fp12 value (one per pair, before final exp).
+    p_g1_affine: (px, py) Fp bundles (..., 1, NB), Montgomery.
+    q_g2_affine: (qx, qy) Fp2 bundles (..., 2, NB).
+    valid_mask: optional bool batch; False pairs contribute f = 1.
     """
     px, py = p_g1_affine
     qx, qy = q_g2_affine
-    t0 = (qx, qy, fp2.broadcast_const(fp2.ONE_MONT, qx[0]))
-    f0 = tower.fp12_broadcast_one(px)
-
+    one2 = jnp.broadcast_to(jnp.asarray(curve.F2.ONE), qx.shape)
+    t0 = (qx, qy, one2)
+    f0 = tower.fp12_broadcast_one(px.shape[:-2])
     bits = jnp.asarray(_X_BITS)
 
     def step(carry, bit):
@@ -141,67 +123,52 @@ def miller_loop(p_g1_affine, q_g2_affine, valid_mask=None):
         f = _mul_by_line(f, line)
         t_add, line_add = _add_step(t, (qx, qy), px, py)
         f_add = _mul_by_line(f, line_add)
-        use_add = bit == 1
-        t = curve.G2.select(
-            jnp.broadcast_to(use_add, tower_batch_shape(f)), t_add, t
-        )
-        f = tower.fp12_select(
-            jnp.broadcast_to(use_add, tower_batch_shape(f)), f_add, f
-        )
+        use = jnp.broadcast_to(bit == 1, _batch_shape(f))
+        t = curve.G2.select(use, t_add, t)
+        f = tower.fp12_select(use, f_add, f)
         return (f, t), None
 
     (f, _), _ = jax.lax.scan(step, (f0, t0), bits)
     if BLS_X < 0:
         f = tower.fp12_conj(f)
     if valid_mask is not None:
-        one = tower.fp12_broadcast_one(px)
+        one = tower.fp12_broadcast_one(px.shape[:-2])
         f = tower.fp12_select(valid_mask, f, one)
     return f
-
-
-def tower_batch_shape(f):
-    return jax.tree_util.tree_leaves(f)[0].shape[:-1]
 
 
 # ------------------------------------------------------- final exponentiation
 
 
 def _pow_x_abs(f):
-    """f^|x| via one lax.scan over the fixed 64-bit parameter (LSB-first
-    square-and-multiply with masked multiplies, as fp._pow_const)."""
     nbits = BLS_X_ABS.bit_length()
     bits = jnp.asarray(
-        np.array([(BLS_X_ABS >> i) & 1 for i in range(nbits)], dtype=np.int32)
+        np.array(
+            [(BLS_X_ABS >> i) & 1 for i in range(nbits)], dtype=np.int32
+        )
     )
 
     def step(carry, bit):
         result, base = carry
         mult = tower.fp12_mul(result, base)
-        result = tower.fp12_select(
-            jnp.broadcast_to(bit == 1, tower_batch_shape(result)),
-            mult,
-            result,
-        )
+        use = jnp.broadcast_to(bit == 1, _batch_shape(result))
+        result = tower.fp12_select(use, mult, result)
         base = tower.fp12_sqr(base)
         return (result, base), None
 
-    one = tower.fp12_broadcast_one(jax.tree_util.tree_leaves(f)[0])
+    one = tower.fp12_broadcast_one(f.shape[:-2])
     (result, _), _ = jax.lax.scan(step, (one, f), bits)
     return result
 
 
 def _pow_neg_x(f):
-    """f^x for the (negative) BLS parameter."""
     return tower.fp12_conj(_pow_x_abs(f))
 
 
 def final_exponentiation(f):
-    """f^(3*(p^12-1)/r) — same addition chain as ref_pairing (validated
-    there against the integer exponent)."""
+    """f^(3 (p^12-1)/r) — addition chain validated in ref_pairing."""
     f = tower.fp12_mul(tower.fp12_conj(f), tower.fp12_inv(f))
-    f = tower.fp12_mul(
-        tower.fp12_frobenius(tower.fp12_frobenius(f)), f
-    )
+    f = tower.fp12_mul(tower.fp12_frobenius(tower.fp12_frobenius(f)), f)
     t0 = tower.fp12_mul(_pow_neg_x(f), tower.fp12_conj(f))
     t1 = tower.fp12_mul(_pow_neg_x(t0), tower.fp12_conj(t0))
     t2 = tower.fp12_mul(_pow_neg_x(t1), tower.fp12_frobenius(t1))
@@ -220,16 +187,12 @@ def final_exponentiation(f):
 
 
 def pairing(p_g1_affine, q_g2_affine):
-    """Full pairing e(P, Q), batched."""
     return final_exponentiation(miller_loop(p_g1_affine, q_g2_affine))
 
 
 def multi_pairing_is_one(p_g1_affine, q_g2_affine, valid_mask=None):
-    """prod_i e(P_i, Q_i) == 1 with one shared final exponentiation.
-
-    The pair axis is the leading batch axis; returns a scalar bool (or a
-    batch of bools if there are extra leading axes before the pair axis).
-    """
+    """prod_i e(P_i, Q_i) == 1 over the leading pair axis, one shared
+    final exponentiation."""
     f = miller_loop(p_g1_affine, q_g2_affine, valid_mask=valid_mask)
     prod = tower.fp12_product_axis(f, axis=0)
     return tower.fp12_is_one(final_exponentiation(prod))

@@ -1,15 +1,13 @@
-"""Batched Fp6/Fp12 tower arithmetic on device limbs.
+"""Batched Fp6/Fp12 tower arithmetic on slot bundles.
 
-Tower (same as the reference math, see `lighthouse_tpu.crypto.ref_fields`):
-    Fp6  = Fp2[v]/(v^3 - xi),  xi = 1 + u
-    Fp12 = Fp6[w]/(w^2 - v)
+Fp6 = (..., 6, NB), Fp12 = (..., 12, NB) int32 bundles (ops.fieldb). A full
+Fp12 multiplication is ONE 18-slot stacked Montgomery multiply between two
+small einsums (ops.programs.FP12_MUL) — the layout that keeps the
+Miller-loop graph small and MXU-friendly.
 
-Representations (all JAX pytrees):
-    Fp6  : 3-tuple of Fp2
-    Fp12 : 2-tuple of Fp6
-
-All multiplicative ops operate in the Montgomery domain. Validated against
-`ref_fields.fp6_*` / `fp12_*`.
+Slot order: Fp6 = [a0c0, a0c1, a1c0, a1c1, a2c0, a2c1] (coefficients of
+v^0, v^1, v^2, each an Fp2); Fp12 = [c0-part (6), c1-part (6)] over w.
+Validated against crypto/ref_fields.fp6_*/fp12_*.
 """
 
 import numpy as np
@@ -17,234 +15,270 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from lighthouse_tpu.crypto.constants import FROB_GAMMA, NLIMBS, P, int_to_limbs
-from lighthouse_tpu.ops import fp, fp2
+from lighthouse_tpu.crypto.constants import FROB_GAMMA, P
+from lighthouse_tpu.ops import fieldb as fb
+from lighthouse_tpu.ops import fp2
+from lighthouse_tpu.ops.programs import FP6_MUL, FP12_MUL
 
-# ------------------------------------------------------------------ constants
+NB = fb.NB
 
+FP6_ZERO = np.zeros((6, NB), dtype=np.int32)
+FP6_ONE = np.concatenate([fp2.ONE_MONT, np.zeros((4, NB), np.int32)])
+FP12_ZERO = np.zeros((12, NB), dtype=np.int32)
+FP12_ONE = np.concatenate([FP6_ONE, FP6_ZERO])
 
-def _mont_fp2(v) -> tuple:
-    """Static (c0, c1) int tuple -> Montgomery-form Fp2 limb constant."""
-    return (
-        np.array(int_to_limbs((v[0] << 384) % P), dtype=np.int32),
-        np.array(int_to_limbs((v[1] << 384) % P), dtype=np.int32),
-    )
-
-
-FROB_GAMMA_MONT = [_mont_fp2(g) for g in FROB_GAMMA]
-
-FP6_ZERO = (fp2.ZERO, fp2.ZERO, fp2.ZERO)
-FP6_ONE = (fp2.ONE_MONT, fp2.ZERO, fp2.ZERO)
-FP12_ZERO = (FP6_ZERO, FP6_ZERO)
-FP12_ONE = (FP6_ONE, FP6_ZERO)
+# ---------------------------------------------------------- combo matrices
 
 
-# ---------------------------------------------------------------------- Fp6
+def _block_diag(blocks):
+    n = sum(b.shape[0] for b in blocks)
+    m = sum(b.shape[1] for b in blocks)
+    out = np.zeros((n, m), dtype=np.int32)
+    r = c = 0
+    for b in blocks:
+        out[r : r + b.shape[0], c : c + b.shape[1]] = b
+        r += b.shape[0]
+        c += b.shape[1]
+    return out
+
+
+_XI = np.array([[1, -1], [1, 1]], dtype=np.int32)
+_I2 = np.eye(2, dtype=np.int32)
+_Z2 = np.zeros((2, 2), dtype=np.int32)
+
+# Fp6 * v: (a0, a1, a2) -> (xi*a2, a0, a1)
+_MUL_BY_V6 = np.block(
+    [[_Z2, _Z2, _XI], [_I2, _Z2, _Z2], [_Z2, _I2, _Z2]]
+).astype(np.int32)
+
+# Fp12 conj (negate the w-part)
+_CONJ12 = _block_diag(
+    [np.eye(6, dtype=np.int32), -np.eye(6, dtype=np.int32)]
+)
+
+# Fp2-conjugate every coefficient (for Frobenius)
+_CONJ_EACH = _block_diag([np.array([[1, 0], [0, -1]], np.int32)] * 6)
+
+
+def _gamma_bundle():
+    """(6, 2, NB) Montgomery constants: FROB_GAMMA[i] per Fp2 coefficient
+    in Frobenius order [g0(=1), g2, g4, g1, g3, g5]."""
+    order = [0, 2, 4, 1, 3, 5]
+    rows = []
+    for i in order:
+        g = FROB_GAMMA[i]
+        rows.append(fp2.const_mont(g[0] % P, g[1] % P))
+    return np.stack(rows)
+
+
+_FROB_GAMMAS = _gamma_bundle()
+
+
+# ---------------------------------------------------------------- Fp6 ops
 
 
 def fp6_add(a, b):
-    return tuple(fp2.add(x, y) for x, y in zip(a, b))
+    return fb.add(a, b)
 
 
 def fp6_sub(a, b):
-    return tuple(fp2.sub(x, y) for x, y in zip(a, b))
+    return fb.sub(a, b)
 
 
 def fp6_neg(a):
-    return tuple(fp2.neg(x) for x in a)
+    return fb.apply_combo(a, -np.eye(6, dtype=np.int32))
 
 
 def fp6_mul(a, b):
-    """Toom/Karatsuba-style 6-multiplication schedule."""
-    a0, a1, a2 = a
-    b0, b1, b2 = b
-    t0 = fp2.mul(a0, b0)
-    t1 = fp2.mul(a1, b1)
-    t2 = fp2.mul(a2, b2)
-    c0 = fp2.add(
-        t0,
-        fp2.mul_by_xi(
-            fp2.sub(
-                fp2.sub(fp2.mul(fp2.add(a1, a2), fp2.add(b1, b2)), t1), t2
-            )
-        ),
-    )
-    c1 = fp2.add(
-        fp2.sub(
-            fp2.sub(fp2.mul(fp2.add(a0, a1), fp2.add(b0, b1)), t0), t1
-        ),
-        fp2.mul_by_xi(t2),
-    )
-    c2 = fp2.add(
-        fp2.sub(fp2.sub(fp2.mul(fp2.add(a0, a2), fp2.add(b0, b2)), t0), t2),
-        t1,
-    )
-    return (c0, c1, c2)
+    return fp2.bilinear(a, b, FP6_MUL)
 
 
 def fp6_sqr(a):
-    return fp6_mul(a, a)
+    return fp2.bilinear(a, a, FP6_MUL)
 
 
 def fp6_mul_by_v(a):
-    return (fp2.mul_by_xi(a[2]), a[0], a[1])
+    return fb.apply_combo(a, _MUL_BY_V6)
+
+
+def _as_fp2_batch(a6):
+    """(..., 6, NB) -> (..., 3, 2, NB) for per-coefficient Fp2 work."""
+    return a6.reshape(a6.shape[:-2] + (3, 2, NB))
+
+
+def _from_fp2_batch(a):
+    return a.reshape(a.shape[:-3] + (6, NB))
 
 
 def fp6_inv(a):
-    a0, a1, a2 = a
-    c0 = fp2.sub(fp2.sqr(a0), fp2.mul_by_xi(fp2.mul(a1, a2)))
-    c1 = fp2.sub(fp2.mul_by_xi(fp2.sqr(a2)), fp2.mul(a0, a1))
-    c2 = fp2.sub(fp2.sqr(a1), fp2.mul(a0, a2))
-    norm = fp2.add(
-        fp2.mul(a0, c0),
-        fp2.mul_by_xi(fp2.add(fp2.mul(a2, c1), fp2.mul(a1, c2))),
+    """Tower inversion (same shape as ref_fields.fp6_inv)."""
+    a2 = _as_fp2_batch(a)  # (..., 3, 2, NB): a0, a1, a2
+    a0, a1, a2_ = a2[..., 0, :, :], a2[..., 1, :, :], a2[..., 2, :, :]
+    # products: a0^2, a1^2, a2^2, a0a1, a1a2, a0a2 — one stacked fp2 mul
+    lhs = jnp.stack([a0, a1, a2_, a0, a1, a0], axis=-3)
+    rhs = jnp.stack([a0, a1, a2_, a1, a2_, a2_], axis=-3)
+    prods = fp2.bilinear(lhs, rhs, fp2.FP2_MUL)
+    sq0, sq1, sq2 = (
+        prods[..., 0, :, :],
+        prods[..., 1, :, :],
+        prods[..., 2, :, :],
+    )
+    p01, p12, p02 = (
+        prods[..., 3, :, :],
+        prods[..., 4, :, :],
+        prods[..., 5, :, :],
+    )
+    c0 = fb.sub(sq0, fp2.mul_by_xi(p12))
+    c1 = fb.sub(fp2.mul_by_xi(sq2), p01)
+    c2 = fb.sub(sq1, p02)
+    # norm = a0 c0 + xi (a2 c1 + a1 c2)
+    lhs2 = jnp.stack([a0, a2_, a1], axis=-3)
+    rhs2 = jnp.stack([c0, c1, c2], axis=-3)
+    pr = fp2.bilinear(lhs2, rhs2, fp2.FP2_MUL)
+    norm = fb.add(
+        pr[..., 0, :, :],
+        fp2.mul_by_xi(fb.add(pr[..., 1, :, :], pr[..., 2, :, :])),
     )
     ninv = fp2.inv(norm)
-    return (fp2.mul(c0, ninv), fp2.mul(c1, ninv), fp2.mul(c2, ninv))
+    scaled = fp2.bilinear(
+        jnp.stack([c0, c1, c2], axis=-3),
+        jnp.broadcast_to(
+            ninv[..., None, :, :],
+            c0.shape[:-2] + (3, 2, NB),
+        ),
+        fp2.FP2_MUL,
+    )
+    return _from_fp2_batch(scaled)
 
 
 def fp6_select(cond, a, b):
-    return tuple(fp2.select(cond, x, y) for x, y in zip(a, b))
+    return fb.select(cond, a, b)
 
 
-# --------------------------------------------------------------------- Fp12
+# --------------------------------------------------------------- Fp12 ops
 
 
 def fp12_add(a, b):
-    return (fp6_add(a[0], b[0]), fp6_add(a[1], b[1]))
+    return fb.add(a, b)
 
 
 def fp12_mul(a, b):
-    a0, a1 = a
-    b0, b1 = b
-    t0 = fp6_mul(a0, b0)
-    t1 = fp6_mul(a1, b1)
-    c0 = fp6_add(t0, fp6_mul_by_v(t1))
-    c1 = fp6_sub(
-        fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1
-    )
-    return (c0, c1)
+    return fp2.bilinear(a, b, FP12_MUL)
 
 
 def fp12_sqr(a):
-    return fp12_mul(a, a)
+    return fp2.bilinear(a, a, FP12_MUL)
 
 
 def fp12_conj(a):
-    return (a[0], fp6_neg(a[1]))
+    return fb.apply_combo(a, _CONJ12)
 
 
 def fp12_inv(a):
-    a0, a1 = a
-    norm = fp6_sub(fp6_sqr(a0), fp6_mul_by_v(fp6_sqr(a1)))
+    """1/(b0 + b1 w) = (b0 - b1 w)/(b0^2 - v b1^2)."""
+    b0, b1 = a[..., :6, :], a[..., 6:, :]
+    sq = fp2.bilinear(
+        jnp.stack([b0, b1], axis=-3),
+        jnp.stack([b0, b1], axis=-3),
+        FP6_MUL,
+    )
+    norm = fb.sub(sq[..., 0, :, :], fp6_mul_by_v(sq[..., 1, :, :]))
     ninv = fp6_inv(norm)
-    return (fp6_mul(a0, ninv), fp6_neg(fp6_mul(a1, ninv)))
-
-
-def _gamma_like(i, ref):
-    """Broadcast Frobenius constant i over ref's batch shape (ref: Fp limbs)."""
-    return fp2.broadcast_const(FROB_GAMMA_MONT[i], ref)
+    scaled = fp2.bilinear(
+        jnp.stack([b0, b1], axis=-3),
+        jnp.broadcast_to(
+            ninv[..., None, :, :], b0.shape[:-2] + (2, 6, NB)
+        ),
+        FP6_MUL,
+    )
+    return jnp.concatenate(
+        [scaled[..., 0, :, :], fp6_neg(scaled[..., 1, :, :])], axis=-2
+    )
 
 
 def fp12_frobenius(a):
     """a^p: conjugate every Fp2 coefficient, scale by gamma powers."""
-    (a00, a01, a02), (a10, a11, a12) = a
-    ref = a00[0]
-    c0 = (
-        fp2.conj(a00),
-        fp2.mul(fp2.conj(a01), _gamma_like(2, ref)),
-        fp2.mul(fp2.conj(a02), _gamma_like(4, ref)),
+    conjed = fb.apply_combo(a, _CONJ_EACH)
+    pairs = conjed.reshape(conjed.shape[:-2] + (6, 2, NB))
+    gammas = jnp.broadcast_to(
+        jnp.asarray(_FROB_GAMMAS), pairs.shape
     )
-    c1 = (
-        fp2.mul(fp2.conj(a10), _gamma_like(1, ref)),
-        fp2.mul(fp2.conj(a11), _gamma_like(3, ref)),
-        fp2.mul(fp2.conj(a12), _gamma_like(5, ref)),
-    )
-    return (c0, c1)
+    out = fp2.bilinear(pairs, gammas, fp2.FP2_MUL)
+    return out.reshape(a.shape)
 
 
 def fp12_select(cond, a, b):
-    return (fp6_select(cond, a[0], b[0]), fp6_select(cond, a[1], b[1]))
+    return fb.select(cond, a, b)
 
 
 def fp12_eq(a, b):
-    leaves_a = jax.tree_util.tree_leaves(a)
-    leaves_b = jax.tree_util.tree_leaves(b)
-    acc = None
-    for x, y in zip(leaves_a, leaves_b):
-        e = jnp.all(x == y, axis=-1)
-        acc = e if acc is None else (acc & e)
-    return acc
+    return fb.eq(a, b)
 
 
 def fp12_is_one(a):
-    """Batched check a == 1 (Montgomery domain)."""
-    one = fp12_broadcast_one(a)
-    return fp12_eq(a, one)
+    one = jnp.broadcast_to(jnp.asarray(FP12_ONE), a.shape)
+    return fb.eq(a, one)
 
 
-def fp12_broadcast_one(like):
-    ref = jax.tree_util.tree_leaves(like)[0]
-    batch = ref.shape[:-1]
-
-    def bc(c):
-        return jnp.broadcast_to(jnp.asarray(c), batch + (NLIMBS,))
-
-    return jax.tree_util.tree_map(bc, FP12_ONE)
+def fp12_broadcast_one(batch_shape_or_like):
+    if hasattr(batch_shape_or_like, "shape"):
+        batch_shape = batch_shape_or_like.shape[:-2]
+    else:
+        batch_shape = tuple(batch_shape_or_like)
+    return jnp.broadcast_to(
+        jnp.asarray(FP12_ONE), batch_shape + (12, NB)
+    )
 
 
 def fp12_product_axis(a, axis: int = 0):
-    """Tree-fold product of a batch of Fp12 values along `axis` — the
-    reduction that merges per-pair Miller-loop outputs before one shared
-    final exponentiation (reference semantics: one multi-pairing per batch,
-    crypto/bls/src/impls/blst.rs verify_multiple_aggregate_signatures)."""
-    n = jax.tree_util.tree_leaves(a)[0].shape[axis]
+    """Tree-fold product along `axis` — merges per-pair Miller outputs
+    before one shared final exponentiation (the reference's one
+    multi-pairing per batch, crypto/bls/src/impls/blst.rs:114-116)."""
+    if axis < 0:
+        axis += a.ndim
+    n = a.shape[axis]
     while n > 1:
         half = n // 2
-        x = jax.tree_util.tree_map(
-            lambda t: jax.lax.slice_in_dim(t, 0, half, axis=axis), a
-        )
-        y = jax.tree_util.tree_map(
-            lambda t: jax.lax.slice_in_dim(t, half, 2 * half, axis=axis), a
-        )
+        x = jax.lax.slice_in_dim(a, 0, half, axis=axis)
+        y = jax.lax.slice_in_dim(a, half, 2 * half, axis=axis)
         prod = fp12_mul(x, y)
         if n % 2:
-            tail = jax.tree_util.tree_map(
-                lambda t: jax.lax.slice_in_dim(t, n - 1, n, axis=axis), a
-            )
-            prod = jax.tree_util.tree_map(
-                lambda p, t: jnp.concatenate([p, t], axis=axis), prod, tail
-            )
+            tail = jax.lax.slice_in_dim(a, n - 1, n, axis=axis)
+            prod = jnp.concatenate([prod, tail], axis=axis)
         a = prod
         n = half + (n % 2)
-    return jax.tree_util.tree_map(lambda t: jnp.squeeze(t, axis=axis), a)
+    return jnp.squeeze(a, axis=axis)
 
 
-# ------------------------------------------------------------- host helpers
+# ------------------------------------------------------------ host helpers
 
 
 def fp12_pack(vals):
-    """Host: list of ref-format Fp12 values -> device batch (Montgomery)."""
-
-    def gather(path_fn):
-        return fp2.to_mont(fp2.pack([path_fn(v) for v in vals]))
-
-    c0 = tuple(gather(lambda v, i=i: v[0][i]) for i in range(3))
-    c1 = tuple(gather(lambda v, i=i: v[1][i]) for i in range(3))
-    return (c0, c1)
+    """Host: ref-format Fp12 values -> (N, 12, NB) Montgomery bundle."""
+    rows = []
+    for v in vals:
+        ints = []
+        for part in v:  # two fp6
+            for c in part:  # three fp2
+                ints.extend([c[0], c[1]])
+        rows.append(fb.pack_ints(ints))
+    return fb.to_mont(np.stack(rows))
 
 
 def fp12_unpack(a):
-    """Host: device Fp12 batch -> list of ref-format values."""
-    c0 = [fp2.to_ints(fp2.from_mont(c)) for c in a[0]]
-    c1 = [fp2.to_ints(fp2.from_mont(c)) for c in a[1]]
-    n = len(c0[0])
+    """Host: Montgomery (N, 12, NB) bundle -> ref-format values."""
+    arr = np.asarray(fb.from_mont(a))
+    flat = arr.reshape(-1, 12, arr.shape[-1])
     out = []
-    for i in range(n):
-        out.append(
-            (
-                (c0[0][i], c0[1][i], c0[2][i]),
-                (c1[0][i], c1[1][i], c1[2][i]),
-            )
-        )
+    for row in flat:
+        ints = fb.unpack_ints(row)
+        fp6s = []
+        for i in range(2):
+            coeffs = []
+            for j in range(3):
+                coeffs.append(
+                    (ints[i * 6 + 2 * j], ints[i * 6 + 2 * j + 1])
+                )
+            fp6s.append(tuple(coeffs))
+        out.append((fp6s[0], fp6s[1]))
     return out

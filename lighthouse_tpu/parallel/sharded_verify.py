@@ -56,16 +56,15 @@ def sharded_verify_signature_sets(mesh):
     Returns fn(msgs, sigs, pubkeys, key_mask, rand_bits, set_mask) -> bool.
     Global shapes: S divisible by mesh 'sets' size, K by 'keys' size.
     """
-    fp_leaf = P("sets", None)          # (S, NLIMBS)
-    fp2_leaf = (fp_leaf, fp_leaf)
-    pk_leaf = P("sets", "keys", None)  # (S, K, NLIMBS)
+    bundle = P("sets", None, None)        # (S, slots, NB)
+    pk_leaf = P("sets", "keys", None, None)  # (S, K, 1, NB)
 
     in_specs = (
-        (fp2_leaf, fp2_leaf),          # msgs (x, y) each Fp2
-        (fp2_leaf, fp2_leaf),          # sigs
-        (pk_leaf, pk_leaf),            # pubkeys (x, y) each Fp
+        (bundle, bundle),              # msgs (x, y) Fp2 bundles
+        (bundle, bundle),              # sigs
+        (pk_leaf, pk_leaf),            # pubkeys (x, y) Fp bundles
         P("sets", "keys"),             # key_mask
-        fp_leaf,                       # rand_bits (S, 64)
+        P("sets", None),               # rand_bits (S, 64)
         P("sets"),                     # set_mask
     )
     out_specs = P()

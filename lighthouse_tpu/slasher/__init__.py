@@ -1,0 +1,1 @@
+from lighthouse_tpu.slasher.slasher import Slasher  # noqa: F401

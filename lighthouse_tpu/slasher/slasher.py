@@ -1,0 +1,229 @@
+"""Slasher: double-vote and surround-vote detection over chunked arrays.
+
+Role of the reference's slasher crate (array math doc slasher/src/array.rs:
+15-45, Slasher::process_queued slasher/src/slasher.rs:79, MDBX-backed
+SlasherDB): attestations are queued and batch-processed per epoch against
+two per-validator arrays over history epochs:
+
+    max_targets[v][e] = max target of v's attestations with source <= e
+    min_targets[v][e] = min target of v's attestations with source >= e
+
+A new attestation (s, t):
+    * is SURROUNDED by an existing one iff max_targets[v][s-1] > t
+    * SURROUNDS an existing one      iff min_targets[v][s+1] < t
+    * is a DOUBLE VOTE iff another attestation with the same target but a
+      different data root exists.
+
+Arrays are numpy int32 chunks (validator-chunk x epoch-chunk), persisted in
+the shared KV store — the dense-array layout that later moves onto the
+device as one vectorized min/max update kernel. Block double-proposals are
+detected from a (slot, proposer) -> root map.
+"""
+
+import numpy as np
+
+from lighthouse_tpu.store.kv import MemoryStore
+
+COL_MIN = b"sl_min"
+COL_MAX = b"sl_max"
+COL_ATT = b"sl_att"
+COL_BLK = b"sl_blk"
+
+NO_TARGET_MIN = np.iinfo(np.int32).max
+NO_TARGET_MAX = -1
+
+
+class SlasherConfig:
+    def __init__(
+        self,
+        history_length: int = 4096,
+        chunk_size: int = 16,
+        validator_chunk_size: int = 256,
+    ):
+        self.history_length = history_length
+        self.chunk_size = chunk_size
+        self.validator_chunk_size = validator_chunk_size
+
+
+class Slasher:
+    def __init__(self, t, kv=None, config: SlasherConfig | None = None):
+        self.t = t
+        self.kv = kv or MemoryStore()
+        self.config = config or SlasherConfig()
+        self._queue = []
+        self._block_queue = []
+        self.slashings_found = []
+        self.proposer_slashings_found = []
+
+    # ------------------------------------------------------------- queues
+
+    def accept_attestation(self, indexed_attestation):
+        """Queue an already-verified IndexedAttestation."""
+        self._queue.append(indexed_attestation)
+
+    def accept_block_header(self, signed_header):
+        self._block_queue.append(signed_header)
+
+    # ------------------------------------------------------ chunk storage
+
+    def _chunk_key(self, vchunk: int, echunk: int) -> bytes:
+        return vchunk.to_bytes(4, "big") + echunk.to_bytes(4, "big")
+
+    def _load(self, col, vchunk, echunk, fill) -> np.ndarray:
+        raw = self.kv.get(col, self._chunk_key(vchunk, echunk))
+        if raw is None:
+            return np.full(
+                (self.config.validator_chunk_size, self.config.chunk_size),
+                fill,
+                dtype=np.int64,
+            )
+        return np.frombuffer(raw, dtype=np.int64).reshape(
+            self.config.validator_chunk_size, self.config.chunk_size
+        ).copy()
+
+    def _store(self, col, vchunk, echunk, arr):
+        self.kv.put(col, self._chunk_key(vchunk, echunk), arr.tobytes())
+
+    def _get_cell(self, col, validator, epoch, fill) -> int:
+        cfg = self.config
+        e = epoch % cfg.history_length
+        arr = self._load(
+            col, validator // cfg.validator_chunk_size, e // cfg.chunk_size,
+            fill,
+        )
+        return int(
+            arr[validator % cfg.validator_chunk_size, e % cfg.chunk_size]
+        )
+
+    def _update_range(self, col, validator, epochs, value, op):
+        """Apply op (min/max) of value over the epoch range for one
+        validator, chunk by chunk."""
+        cfg = self.config
+        fill = NO_TARGET_MIN if op is min else NO_TARGET_MAX
+        by_chunk = {}
+        for epoch in epochs:
+            e = epoch % cfg.history_length
+            by_chunk.setdefault(e // cfg.chunk_size, []).append(e)
+        vchunk = validator // cfg.validator_chunk_size
+        row = validator % cfg.validator_chunk_size
+        for echunk, es in by_chunk.items():
+            arr = self._load(col, vchunk, echunk, fill)
+            for e in es:
+                cur = arr[row, e % cfg.chunk_size]
+                arr[row, e % cfg.chunk_size] = op(int(cur), value)
+            self._store(col, vchunk, echunk, arr)
+
+    # ----------------------------------------------------- attestation db
+
+    def _att_key(self, validator: int, target: int) -> bytes:
+        return validator.to_bytes(8, "big") + target.to_bytes(8, "big")
+
+    def _find_conflicting(self, validator, source, target):
+        """Scan stored attestations of `validator` for one the new (source,
+        target) surrounds / is surrounded by (used to build the proof once
+        the arrays flag a hit)."""
+        prefix = validator.to_bytes(8, "big")
+        for key in self.kv.keys(COL_ATT):
+            if not key.startswith(prefix):
+                continue
+            data = self.kv.get(COL_ATT, key)
+            att = self.t.IndexedAttestation.decode(data)
+            s2, t2 = att.data.source.epoch, att.data.target.epoch
+            if (s2 < source and target < t2) or (
+                source < s2 and t2 < target
+            ):
+                return att
+        return None
+
+    # ---------------------------------------------------------- processing
+
+    def process_queued(self, current_epoch: int):
+        """Batch-process queued attestations & blocks; returns (attester
+        slashings, proposer slashings) discovered."""
+        cfg = self.config
+        found, pfound = [], []
+
+        for att in self._queue:
+            s = att.data.source.epoch
+            t = att.data.target.epoch
+            root = self.t.AttestationData.hash_tree_root(att.data)
+            for v in att.attesting_indices:
+                # double vote
+                existing_raw = self.kv.get(COL_ATT, self._att_key(v, t))
+                if existing_raw is not None:
+                    existing = self.t.IndexedAttestation.decode(
+                        existing_raw
+                    )
+                    if (
+                        self.t.AttestationData.hash_tree_root(
+                            existing.data
+                        )
+                        != root
+                    ):
+                        found.append(
+                            self.t.AttesterSlashing(
+                                attestation_1=existing, attestation_2=att
+                            )
+                        )
+                        continue
+                # surround checks via min/max arrays
+                if s > 0:
+                    max_t = self._get_cell(
+                        COL_MAX, v, s - 1, NO_TARGET_MAX
+                    )
+                    if max_t > t:
+                        other = self._find_conflicting(v, s, t)
+                        if other is not None:
+                            found.append(
+                                self.t.AttesterSlashing(
+                                    attestation_1=other,
+                                    attestation_2=att,
+                                )
+                            )
+                            continue
+                min_t = self._get_cell(COL_MIN, v, s + 1, NO_TARGET_MIN)
+                if min_t < t:
+                    other = self._find_conflicting(v, s, t)
+                    if other is not None:
+                        found.append(
+                            self.t.AttesterSlashing(
+                                attestation_1=att, attestation_2=other
+                            )
+                        )
+                        continue
+                # record
+                self.kv.put(
+                    COL_ATT, self._att_key(v, t), att.to_bytes()
+                )
+                lo = max(0, current_epoch - cfg.history_length + 1)
+                self._update_range(
+                    COL_MAX, v, range(s, current_epoch + 1), t, max
+                )
+                self._update_range(
+                    COL_MIN, v, range(lo, s + 1), t, min
+                )
+        self._queue = []
+
+        seen = {}
+        for sh in self._block_queue:
+            h = sh.message
+            key = h.slot.to_bytes(8, "big") + h.proposer_index.to_bytes(
+                8, "big"
+            )
+            raw = self.kv.get(COL_BLK, key)
+            if raw is None:
+                self.kv.put(COL_BLK, key, sh.to_bytes())
+            else:
+                prev = self.t.SignedBeaconBlockHeader.decode(raw)
+                if prev.message != h:
+                    pfound.append(
+                        self.t.ProposerSlashing(
+                            signed_header_1=prev, signed_header_2=sh
+                        )
+                    )
+            seen[key] = True
+        self._block_queue = []
+
+        self.slashings_found.extend(found)
+        self.proposer_slashings_found.extend(pfound)
+        return found, pfound

@@ -22,9 +22,13 @@ def hash_concat(a: bytes, b: bytes) -> bytes:
 def hash32_many(pairs):
     """Hash a list of 64-byte inputs -> list of 32-byte digests.
 
-    Single point to swap in a vectorized backend (C++ or device kernel).
+    Routed through the native batch hasher when built
+    (lighthouse_tpu/native/hashtree.c), hashlib otherwise.
     """
-    return [hashlib.sha256(p).digest() for p in pairs]
+    from lighthouse_tpu.native import hash_pairs
+
+    out = hash_pairs(b"".join(pairs))
+    return [out[i : i + 32] for i in range(0, len(out), 32)]
 
 
 # zero_hash(0) = 32 zero bytes; zero_hash(i) = H(zero_hash(i-1) * 2)

@@ -28,14 +28,16 @@ def merkleize_chunks(chunks, limit: int | None = None) -> bytes:
     if count == 0:
         return zero_hash(depth)
 
+    from lighthouse_tpu.native import hash_pairs
+
     layer = list(chunks)
     for d in range(depth):
-        nxt = []
-        for i in range(0, len(layer), 2):
-            left = layer[i]
-            right = layer[i + 1] if i + 1 < len(layer) else zero_hash(d)
-            nxt.append(hash_concat(left, right))
-        layer = nxt
+        if len(layer) % 2:
+            layer.append(zero_hash(d))
+        digests = hash_pairs(b"".join(layer))
+        layer = [
+            digests[i : i + 32] for i in range(0, len(digests), 32)
+        ]
     return layer[0]
 
 

@@ -15,21 +15,22 @@ import numpy as np
 from lighthouse_tpu.crypto import constants as C
 from lighthouse_tpu.crypto.ref_curve import G1 as RG1
 from lighthouse_tpu.crypto.ref_curve import G2 as RG2
-from lighthouse_tpu.ops import batch_verify, curve, fp, fp2
+from lighthouse_tpu.ops import batch_verify, curve, fieldb as fb, fp2
 
 
 def _pack_g1_affine(pts):
-    """[(x, y) or None, ...] -> device affine Montgomery pair; None -> (0,0)."""
-    xs = fp.to_mont(fp.pack([0 if p is None else p[0] for p in pts]))
-    ys = fp.to_mont(fp.pack([0 if p is None else p[1] for p in pts]))
-    return (xs, ys)
+    """[(x, y) or None, ...] -> affine Montgomery (N, 1, NB) bundle pair;
+    None -> (0, 0) placeholder (masked out downstream)."""
+    xs = np.stack([fb.pack_ints([0 if p is None else p[0]]) for p in pts])
+    ys = np.stack([fb.pack_ints([0 if p is None else p[1]]) for p in pts])
+    return (fb.to_mont(xs), fb.to_mont(ys))
 
 
 def _pack_g2_affine(pts):
     zero2 = (0, 0)
-    xs = fp2.to_mont(fp2.pack([zero2 if p is None else p[0] for p in pts]))
-    ys = fp2.to_mont(fp2.pack([zero2 if p is None else p[1] for p in pts]))
-    return (xs, ys)
+    xs = fp2.pack([zero2 if p is None else p[0] for p in pts])
+    ys = fp2.pack([zero2 if p is None else p[1] for p in pts])
+    return (fb.to_mont(xs), fb.to_mont(ys))
 
 
 def make_signature_set_batch(
@@ -92,10 +93,9 @@ def make_signature_set_batch(
 
     flat_pks = [p for row in pk_rows for p in row]
     pk_x, pk_y = _pack_g1_affine(flat_pks)
-    nl = pk_x.shape[-1]
     pubkeys = (
-        pk_x.reshape(n_sets, max_keys, nl),
-        pk_y.reshape(n_sets, max_keys, nl),
+        np.asarray(pk_x).reshape(n_sets, max_keys, 1, fb.NB),
+        np.asarray(pk_y).reshape(n_sets, max_keys, 1, fb.NB),
     )
     key_mask = np.array(mask_rows, dtype=bool)
     set_mask = np.ones(n_sets, dtype=bool)

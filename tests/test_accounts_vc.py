@@ -1,0 +1,199 @@
+"""Accounts (key derivation, keystores) + validator client services +
+slashing protection."""
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.accounts import (
+    Keystore,
+    derive_child_sk,
+    derive_master_sk,
+    derive_path,
+    mnemonic_to_seed,
+)
+from lighthouse_tpu.crypto.constants import R
+from lighthouse_tpu.harness import Harness
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.validator_client import (
+    SlashingError,
+    SlashingProtectionDB,
+    ValidatorClient,
+)
+
+N = 32
+
+
+# ------------------------------------------------------------ derivation
+
+
+def test_eip2333_derivation_properties():
+    seed = bytes(range(64))
+    master = derive_master_sk(seed)
+    assert 0 < master < R
+    c0 = derive_child_sk(master, 0)
+    c1 = derive_child_sk(master, 1)
+    assert c0 != c1 and 0 < c0 < R
+    # deterministic
+    assert derive_child_sk(master, 0) == c0
+    # path derivation composes
+    assert derive_path(seed, "m/12381/3600/0/0") == derive_child_sk(
+        derive_child_sk(
+            derive_child_sk(derive_child_sk(master, 12381), 3600), 0
+        ),
+        0,
+    )
+    with pytest.raises(ValueError):
+        derive_master_sk(b"short")
+
+
+def test_mnemonic_seed_is_bip39():
+    # standard BIP-39 test vector (the published "abandon ... about" seed)
+    m = (
+        "abandon abandon abandon abandon abandon abandon abandon abandon "
+        "abandon abandon abandon about"
+    )
+    seed = mnemonic_to_seed(m, "TREZOR")
+    assert seed.hex().startswith("c55257c360c07c72029aebc1b53c05ed")
+
+
+# -------------------------------------------------------------- keystores
+
+
+def test_keystore_roundtrip_pbkdf2():
+    secret = bytes(range(32))
+    ks = Keystore.encrypt(secret, "hunter2密码", kdf="pbkdf2")
+    back = Keystore.from_json(ks.to_json())
+    assert back.decrypt("hunter2密码") == secret
+    with pytest.raises(ValueError):
+        back.decrypt("wrong")
+
+
+def test_keystore_roundtrip_scrypt():
+    secret = b"\x11" * 32
+    ks = Keystore.encrypt(secret, "correct horse", kdf="scrypt")
+    assert Keystore.from_json(ks.to_json()).decrypt("correct horse") == secret
+
+
+# ------------------------------------------------------ slashing protection
+
+
+def test_slashing_protection_blocks():
+    db = SlashingProtectionDB()
+    pk = b"\xaa" * 48
+    db.check_and_insert_block(pk, 10, b"\x01" * 32)
+    # same slot, same root: idempotent
+    db.check_and_insert_block(pk, 10, b"\x01" * 32)
+    with pytest.raises(SlashingError):
+        db.check_and_insert_block(pk, 10, b"\x02" * 32)
+    with pytest.raises(SlashingError):
+        db.check_and_insert_block(pk, 9, b"\x03" * 32)
+    db.check_and_insert_block(pk, 11, b"\x04" * 32)
+
+
+def test_slashing_protection_attestations():
+    db = SlashingProtectionDB()
+    pk = b"\xbb" * 48
+    db.check_and_insert_attestation(pk, 2, 5, b"\x01" * 32)
+    with pytest.raises(SlashingError):  # double vote
+        db.check_and_insert_attestation(pk, 3, 5, b"\x02" * 32)
+    with pytest.raises(SlashingError):  # new surrounds existing
+        db.check_and_insert_attestation(pk, 1, 6, b"\x03" * 32)
+    with pytest.raises(SlashingError):  # existing surrounds new
+        db.check_and_insert_attestation(pk, 3, 4, b"\x04" * 32)
+    db.check_and_insert_attestation(pk, 5, 6, b"\x05" * 32)
+
+
+def test_interchange_roundtrip():
+    db = SlashingProtectionDB()
+    pk = b"\xcc" * 48
+    db.check_and_insert_block(pk, 3, b"\x01" * 32)
+    db.check_and_insert_attestation(pk, 0, 1, b"\x02" * 32)
+    payload = db.export_interchange(b"\x00" * 32)
+    db2 = SlashingProtectionDB()
+    db2.import_interchange(payload)
+    with pytest.raises(SlashingError):
+        db2.check_and_insert_block(pk, 3, b"\x09" * 32)
+    with pytest.raises(SlashingError):
+        db2.check_and_insert_attestation(pk, 0, 1, b"\x0a" * 32)
+
+
+# --------------------------------------------------------- validator client
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec(ALTAIR_FORK_EPOCH=2**64 - 1)
+
+
+def test_validator_client_drives_chain(spec):
+    h = Harness(spec, N)
+    chain = BeaconChain(h.state.copy(), spec, backend="ref")
+    vc = ValidatorClient(
+        chain, {i: kp for i, kp in enumerate(h.keypairs)}
+    )
+    vc.update_duties(0)
+
+    def producer(slot, proposer):
+        block = h.produce_block(slot, h.pending_attestations[:128])
+        h.pending_attestations = h.pending_attestations[128:]
+        return block.message
+
+    for slot in range(1, 9):
+        chain.set_slot(slot)
+        signed = vc.propose(slot, producer)
+        assert signed is not None, "we own all validators"
+        chain.process_block(signed)
+        h.import_block(signed)
+        atts = vc.attest(slot)
+        assert atts, "attestation duties every slot"
+        chain.process_unaggregated_attestations(atts)
+        h.pending_attestations.extend(
+            chain.naive_pool.aggregates_at_slot(slot)
+        )
+        saps = vc.aggregate(slot)
+        if saps:
+            chain.process_aggregated_attestations(saps)
+    assert chain.head_state.slot == 8
+    assert vc.metrics["blocks_proposed"] == 8
+    assert vc.metrics["attestations_published"] >= 8
+
+
+def test_doppelganger_blocks_early_signing(spec):
+    h = Harness(spec, N)
+    chain = BeaconChain(h.state.copy(), spec, backend="ref")
+    vc = ValidatorClient(
+        chain,
+        {i: kp for i, kp in enumerate(h.keypairs)},
+        doppelganger_epochs=2,
+    )
+    vc.start_epoch(0)
+    assert not vc.signing_enabled(0)
+    assert not vc.signing_enabled(1)
+    assert vc.signing_enabled(2)
+    assert vc.attest(1) == []
+    assert vc.metrics["signings_blocked"] >= 1
+
+
+def test_slashing_db_blocks_vc_equivocation(spec):
+    h = Harness(spec, N)
+    chain = BeaconChain(h.state.copy(), spec, backend="ref")
+    db = SlashingProtectionDB()
+    vc = ValidatorClient(
+        chain, {i: kp for i, kp in enumerate(h.keypairs)}, slashing_db=db
+    )
+
+    def producer(slot, proposer):
+        return h.produce_block(slot, []).message
+
+    chain.set_slot(1)
+    signed = vc.propose(1, producer)
+    assert signed is not None
+    # proposing a DIFFERENT block at the same slot must be refused
+    def producer2(slot, proposer):
+        blk = h.produce_block(slot, []).message
+        blk.state_root = b"\x66" * 32
+        return blk
+
+    with pytest.raises(SlashingError):
+        vc.propose(1, producer2)

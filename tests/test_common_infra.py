@@ -1,0 +1,146 @@
+"""Common infrastructure: slot clocks, metrics, task executor, events,
+validator monitor, discovery registry, eth1 deposit tree."""
+
+import time
+
+from lighthouse_tpu.common.metrics import Registry
+from lighthouse_tpu.common.slot_clock import ManualSlotClock
+from lighthouse_tpu.common.task_executor import ShutdownReason, TaskExecutor
+from lighthouse_tpu.beacon_chain.events import EventBus
+from lighthouse_tpu.beacon_chain.validator_monitor import ValidatorMonitor
+from lighthouse_tpu.network.discovery import BootstrapRegistry, PeerRecord
+
+
+def test_manual_slot_clock():
+    clock = ManualSlotClock(genesis_time=1000, seconds_per_slot=12)
+    assert clock.current_slot() == 0
+    clock.set_slot(5)
+    assert clock.current_slot() == 5
+    assert clock.slot_start(5) == 1060
+    assert clock.attestation_deadline(5) == 1064
+    assert clock.aggregate_deadline(5) == 1068
+    clock.advance_seconds(13)
+    assert clock.current_slot() == 6
+
+
+def test_metrics_render():
+    reg = Registry()
+    c = reg.counter("requests_total", "total requests")
+    c.inc()
+    c.inc(2)
+    g = reg.gauge("head_slot")
+    g.set(42)
+    h = reg.histogram("proc_seconds", buckets=(0.1, 1.0))
+    with h.time():
+        pass
+    out = reg.render()
+    assert "requests_total 3.0" in out
+    assert "head_slot 42.0" in out
+    assert 'proc_seconds_bucket{le="+Inf"} 1' in out
+    assert "# TYPE requests_total counter" in out
+
+
+def test_task_executor_shutdown_propagates():
+    ex = TaskExecutor("test")
+    seen = []
+
+    def svc(stop):
+        stop.wait(timeout=5)
+        seen.append("stopped")
+
+    ex.spawn(svc, "svc1")
+    ex.shutdown(ShutdownReason.SUCCESS, "done")
+    ex.join_all()
+    assert seen == ["stopped"]
+    assert ex.shutdown_reason()[0] == ShutdownReason.SUCCESS
+
+
+def test_task_executor_failure_triggers_shutdown():
+    ex = TaskExecutor("test2")
+
+    def bad(stop):
+        raise RuntimeError("boom")
+
+    ex.spawn(bad, "bad")
+    deadline = time.time() + 2
+    while not ex.shutdown_requested and time.time() < deadline:
+        time.sleep(0.01)
+    assert ex.shutdown_requested
+    assert ex.shutdown_reason()[0] == ShutdownReason.FAILURE
+
+
+def test_event_bus_bounded_delivery():
+    bus = EventBus(capacity=2)
+    q = bus.subscribe(["head", "block"])
+    bus.publish("head", {"slot": 1})
+    bus.publish("block", {"slot": 1})
+    bus.publish("head", {"slot": 2})  # dropped (full)
+    bus.publish("attestation", {"x": 1})  # not subscribed
+    assert q.get_nowait()["event"] == "head"
+    assert q.get_nowait()["event"] == "block"
+    assert q.empty()
+
+
+def test_validator_monitor_tracking():
+    class FakeSpec:
+        SLOTS_PER_EPOCH = 8
+
+        @staticmethod
+        def slot_to_epoch(slot):
+            return slot // 8
+
+    class Blk:
+        slot = 9
+        proposer_index = 1
+
+    class Data:
+        slot = 8
+
+        class target:
+            epoch = 1
+
+    class Indexed:
+        data = Data
+        attesting_indices = [1, 2]
+
+    mon = ValidatorMonitor({1, 2, 3})
+    mon.register_block(Blk, [Indexed], FakeSpec)
+    s = mon.epoch_summary(1)
+    assert s["hits"] == 2 and s["misses"] == 1
+    assert s["missed_validators"] == [3]
+    assert s["mean_inclusion_delay"] == 1.0
+    assert s["proposals"] == 1
+
+
+def test_discovery_registry():
+    reg = BootstrapRegistry()
+    a = PeerRecord("a")
+    b = PeerRecord("b")
+    b.attnets[5] = True
+    reg.register(a)
+    reg.register(b)
+    assert {r.node_id for r in reg.find_peers("a")} == {"b"}
+    assert [r.node_id for r in reg.find_subnet_peers([5], "a")] == ["b"]
+    assert reg.find_subnet_peers([6], "a") == []
+    # seq update wins, stale seq ignored
+    reg.register(PeerRecord("b", seq=3))
+    reg.register(PeerRecord("b", seq=2, attnets=[True] * 64))
+    assert reg.records["b"].seq == 3
+
+
+def test_deposit_tree_proofs():
+    from lighthouse_tpu.eth1 import DepositTree
+    from lighthouse_tpu.ssz.merkle import verify_merkle_proof
+
+    tree = DepositTree()
+    leaves = [bytes([i]) * 32 for i in range(5)]
+    for leaf in leaves:
+        tree.push(leaf)
+    root = tree.root()
+    for i, leaf in enumerate(leaves):
+        proof = tree.proof(i)
+        assert len(proof) == 33
+        assert verify_merkle_proof(leaf, proof, i, root), f"leaf {i}"
+    # root changes as deposits append
+    tree.push(b"\x09" * 32)
+    assert tree.root() != root

@@ -14,21 +14,23 @@ from lighthouse_tpu.crypto import constants as C
 from lighthouse_tpu.crypto import ref_pairing
 from lighthouse_tpu.crypto.ref_curve import G1 as RG1
 from lighthouse_tpu.crypto.ref_curve import G2 as RG2
-from lighthouse_tpu.ops import curve, fp, fp2, pairing, tower
+from lighthouse_tpu.ops import curve, fieldb as fb, fp2, pairing, tower
 
 rng = random.Random(777)
 
 
 def pack_g1_affine(pts):
-    """Affine ref G1 points [(x, y), ...] -> device Montgomery Fp pairs."""
-    px = fp.to_mont(fp.pack([p[0] for p in pts]))
-    py = fp.to_mont(fp.pack([p[1] for p in pts]))
+    """Affine ref G1 points [(x, y), ...] -> Montgomery (N,1,NB) bundles."""
+    import numpy as np
+
+    px = fb.to_mont(np.stack([fb.pack_ints([p[0]]) for p in pts]))
+    py = fb.to_mont(np.stack([fb.pack_ints([p[1]]) for p in pts]))
     return (px, py)
 
 
 def pack_g2_affine(pts):
-    qx = fp2.to_mont(fp2.pack([p[0] for p in pts]))
-    qy = fp2.to_mont(fp2.pack([p[1] for p in pts]))
+    qx = fb.to_mont(fp2.pack([p[0] for p in pts]))
+    qy = fb.to_mont(fp2.pack([p[1] for p in pts]))
     return (qx, qy)
 
 

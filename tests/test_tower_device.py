@@ -7,7 +7,7 @@ import numpy as np
 
 from lighthouse_tpu.crypto import ref_fields as ff
 from lighthouse_tpu.crypto.constants import P
-from lighthouse_tpu.ops import fp2, tower
+from lighthouse_tpu.ops import fieldb as fb, fp2, tower
 
 rng = random.Random(5)
 
@@ -25,14 +25,25 @@ def rand_fp12(n):
 
 
 def fp6_pack(vals):
-    return tuple(
-        fp2.to_mont(fp2.pack([v[i] for v in vals])) for i in range(3)
-    )
+    """ref fp6 tuples -> (N, 6, NB) Montgomery bundle."""
+    rows = []
+    for v in vals:
+        ints = []
+        for c in v:
+            ints.extend([c[0], c[1]])
+        rows.append(fb.pack_ints(ints))
+    return fb.to_mont(np.stack(rows))
 
 
 def fp6_unpack(a):
-    comps = [fp2.to_ints(fp2.from_mont(c)) for c in a]
-    return list(zip(*comps))
+    arr = np.asarray(fb.from_mont(a)).reshape(-1, 6, fb.NB)
+    out = []
+    for row in arr:
+        ints = fb.unpack_ints(row)
+        out.append(
+            ((ints[0], ints[1]), (ints[2], ints[3]), (ints[4], ints[5]))
+        )
+    return out
 
 
 def test_fp6_mul_inv():
