@@ -8,24 +8,52 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline is measured against the north-star target rate of 150k sigs/sec
 (30k signatures in <200 ms on one chip, BASELINE.json/BASELINE.md) — 1.0
 means the target is met.
+
+Robustness: if the tunneled TPU backend is unavailable (it was at the end of
+round 1 — BENCH_r01.json records the axon init error), fall back to the CPU
+backend so the driver still gets a JSON line (marked via the "platform" key).
 """
 
 import json
 import os
+import sys
 import time
+
+TARGET_SIGS_PER_SEC = 150_000.0  # north star: 30k sigs in 200 ms on one chip
+
+
+def _ensure_backend():
+    """Return an initialized jax with a usable backend, flipping to CPU if
+    the TPU tunnel is down. Must not query devices before a possible flip —
+    XLA_FLAGS is parsed once at first client creation."""
+    import jax
+
+    try:
+        jax.devices()
+        return jax, jax.default_backend()
+    except RuntimeError as e:
+        print(f"bench: TPU backend unavailable ({e}); using CPU", file=sys.stderr)
+    from lighthouse_tpu.backend import force_cpu_backend
+
+    force_cpu_backend(1)
+    return jax, "cpu"
 
 
 def main():
     import numpy as np
 
-    import jax
+    jax, platform = _ensure_backend()
 
     from lighthouse_tpu import testing as td
     from lighthouse_tpu.ops import batch_verify
 
     smoke = os.environ.get("BENCH_SMOKE") == "1"
-    n_sets = 32 if smoke else 1024
-    reps = 3 if smoke else 5
+    if platform == "cpu":
+        n_sets, reps = 16, 3  # fallback: just prove the path end to end
+    elif smoke:
+        n_sets, reps = 128, 3
+    else:
+        n_sets, reps = 1024, 5
 
     args = td.make_signature_set_batch(
         n_sets, max_keys=1, seed=0, fast_sequential=True
@@ -44,17 +72,15 @@ def main():
     p50 = sorted(times)[len(times) // 2]
 
     sigs_per_sec = n_sets / p50
-    target = 150_000.0  # sigs/sec north star (30k in 200 ms)
-    print(
-        json.dumps(
-            {
-                "metric": "verify_signature_sets_throughput",
-                "value": round(sigs_per_sec, 2),
-                "unit": "sigs/sec",
-                "vs_baseline": round(sigs_per_sec / target, 4),
-            }
-        )
-    )
+    out = {
+        "metric": "verify_signature_sets_throughput",
+        "value": round(sigs_per_sec, 2),
+        "unit": "sigs/sec",
+        "vs_baseline": round(sigs_per_sec / TARGET_SIGS_PER_SEC, 4),
+    }
+    if platform not in ("tpu", "axon"):
+        out["platform"] = platform
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,53 @@
+"""JAX backend selection helpers for the single-tunneled-TPU environment.
+
+This image's sitecustomize pre-imports jax and registers the `axon` TPU
+plugin with JAX_PLATFORMS=axon in every interpreter, so tests/dryruns that
+need a virtual multi-device CPU mesh cannot rely on env vars alone. The
+working in-process recipe (verified against jax 0.9.0 + the axon register
+hooks): update the `jax_platforms` config, set the forced-host-device-count
+XLA flag *before* the CPU client is instantiated, then `clear_backends()` so
+the next `jax.devices()` re-resolves onto the CPU devices.
+
+CAVEAT: XLA_FLAGS is parsed once, at first client creation — callers must
+invoke :func:`force_cpu_backend` before anything queries `jax.devices()` /
+`jax.default_backend()` or runs a computation.
+"""
+
+import os
+import re
+
+
+def force_cpu_backend(n_devices: int = 8) -> None:
+    """Flip this process onto `n_devices` virtual CPU devices.
+
+    Idempotent; raises RuntimeError if the device count cannot be
+    materialized (XLA_FLAGS already parsed by an existing CPU client).
+    """
+    import jax
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        existing = int(
+            re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+            .group(1)
+        )
+        if existing < n_devices:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
+            )
+            os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
+    from jax.extend.backend import clear_backends
+
+    jax.config.update("jax_platforms", "cpu")
+    clear_backends()
+    if jax.device_count() < n_devices or jax.devices()[0].platform != "cpu":
+        raise RuntimeError(
+            f"force_cpu_backend: wanted {n_devices} CPU devices, got "
+            f"{jax.devices()} (XLA_FLAGS was parsed before the override)"
+        )

@@ -1,28 +1,21 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+"""Test configuration: force an 8-device virtual CPU mesh before tests run.
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
-validated on a virtual CPU mesh exactly as the driver's dryrun does.
-
-NOTE (single-TPU environment): every Python interpreter in this image tries
-to claim the one tunneled TPU chip at startup (axon sitecustomize) when
-PALLAS_AXON_POOL_IPS is set. Tests must never touch the chip — run them as:
-
-    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python -m pytest tests/ -q
-
-Forcing JAX_PLATFORMS=cpu here is belt-and-braces for the case where the
-axon plugin already registered before pytest started.
+validated on a virtual CPU mesh exactly as the driver's dryrun does. Tests
+must never touch the one tunneled TPU chip — see lighthouse_tpu/backend.py
+for why env vars alone are not enough in this image.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-existing = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in existing:
-    os.environ["XLA_FLAGS"] = (
-        existing + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Persistent compilation cache: the pairing/batch-verify graphs are large;
 # compile once per machine, reuse across every test session.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lighthouse_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+from lighthouse_tpu.backend import force_cpu_backend  # noqa: E402
+
+force_cpu_backend(8)
