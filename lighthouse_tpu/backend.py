@@ -27,6 +27,13 @@ def force_cpu_backend(n_devices: int = 8) -> None:
 
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
+    flags0 = os.environ.get("XLA_FLAGS", "")
+    if "xla_backend_optimization_level" not in flags0:
+        # CPU runs are compile-time-dominated (tests/dryrun); trade optimized
+        # code for ~2x faster XLA CPU compiles.
+        os.environ["XLA_FLAGS"] = (
+            flags0 + " --xla_backend_optimization_level=0"
+        ).strip()
     flag = f"--xla_force_host_platform_device_count={n_devices}"
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" in flags:

@@ -26,6 +26,7 @@ from lighthouse_tpu.crypto.constants import (
     G2_Y,
     P,
 )
+from lighthouse_tpu.crypto.constants import R as R_SUBGROUP
 from lighthouse_tpu.ops import fieldb as fb
 from lighthouse_tpu.ops import fp2 as fp2m
 from lighthouse_tpu.ops.programs import FP2_MUL
@@ -197,6 +198,67 @@ class JacobianGroup:
         out = self.select(inf_p, q, out)
         return out
 
+    def add_nonexceptional(self, p, q):
+        """Lean Jacobian-Jacobian add: assumes p != +-q and that garbage
+        outputs are acceptable when either input is infinity or p == +-q
+        (callers select those lanes away). Used by the scalar ladder, where
+        acc = k*base and addend = 2^i*base with k < 2^i < r can never
+        collide. ~3x fewer equations than the unified `add`."""
+        F = self.F
+        x1, y1, z1 = p
+        x2, y2, z2 = q
+
+        def unpack(stack, n):
+            return [stack[..., i, :, :] for i in range(n)]
+
+        z1z1, z2z2, z1z2 = unpack(
+            F.mul(
+                jnp.stack([z1, z2, z1], axis=-3),
+                jnp.stack([z1, z2, z2], axis=-3),
+            ),
+            3,
+        )
+        u1, u2, z2c, z1c = unpack(
+            F.mul(
+                jnp.stack([x1, x2, z2z2, z1z1], axis=-3),
+                jnp.stack([z2z2, z1z1, z2, z1], axis=-3),
+            ),
+            4,
+        )
+        s1, s2 = unpack(
+            F.mul(
+                jnp.stack([y1, y2], axis=-3),
+                jnp.stack([z2c, z1c], axis=-3),
+            ),
+            2,
+        )
+        h = F.sub(u2, u1)
+        r = F.sub(s2, s1)
+        hh, z3 = unpack(
+            F.mul(
+                jnp.stack([h, z1z2], axis=-3),
+                jnp.stack([h, h], axis=-3),
+            ),
+            2,
+        )
+        hhh, v, rr = unpack(
+            F.mul(
+                jnp.stack([h, u1, r], axis=-3),
+                jnp.stack([hh, hh, r], axis=-3),
+            ),
+            3,
+        )
+        x3 = F.sub(F.sub(rr, hhh), F.scalar_small(v, 2))
+        t1, t2 = unpack(
+            F.mul(
+                jnp.stack([r, s1], axis=-3),
+                jnp.stack([F.sub(v, x3), hhh], axis=-3),
+            ),
+            2,
+        )
+        y3 = F.sub(t1, t2)
+        return (x3, y3, z3)
+
     def select(self, cond, a, b):
         F = self.F
         return tuple(F.select(cond, ca, cb) for ca, cb in zip(a, b))
@@ -238,23 +300,50 @@ class JacobianGroup:
     # -- scalar multiplication -------------------------------------------
 
     def mul_scalar_bits(self, pt, bits):
-        """bits: (..., nbits) int32 LSB-first; one lax.scan ladder."""
+        """bits: (..., nbits) int32 LSB-first; one lax.scan ladder.
+
+        Uses the lean `add_nonexceptional` (acc = k*base vs addend =
+        2^i*base with k < 2^i can never be equal/opposite/infinite for a
+        finite base); a started-flag handles the running-infinity lanes and
+        an infinite base is restored by the final select."""
+        # add_nonexceptional's no-collision argument needs 2^i < r for
+        # every ladder step; 2^254 < r (r is 255 bits, ~1.81*2^254).
+        assert bits.shape[-1] <= 254, (
+            "mul_scalar_bits: scalars must be < 2^254 (< subgroup order); "
+            "reduce mod r first"
+        )
         bits_seq = jnp.moveaxis(bits, -1, 0)
+        base_inf = self.is_infinity(pt)
+        batch = pt[0].shape[:-2]
 
         def step(carry, bit):
-            acc, addend = carry
-            added = self.add(acc, addend)
-            acc = self.select(bit == 1, added, acc)
+            acc, addend, started = carry
+            added = self.add_nonexceptional(acc, addend)
+            use = jnp.broadcast_to(bit == 1, batch)
+            acc = self.select(
+                use, self.select(started, added, addend), acc
+            )
+            started = started | use
             addend = self.double(addend)
-            return (acc, addend), None
+            return (acc, addend, started), None
 
-        init = (self.infinity_like(pt), pt)
-        (acc, _), _ = jax.lax.scan(step, init, bits_seq)
-        return acc
+        init = (
+            self.infinity_like(pt),
+            pt,
+            jnp.zeros(batch, dtype=bool),
+        )
+        (acc, _, started), _ = jax.lax.scan(step, init, bits_seq)
+        return self.select(started & ~base_inf, acc, self.infinity_like(pt))
 
     def mul_scalar_static(self, pt, k: int):
         if k < 0:
             return self.mul_scalar_static(self.neg(pt), -k)
+        k %= R_SUBGROUP  # points have order r
+        if k >= 1 << 254:
+            # ladder precondition is k < 2^254; r is 255 bits, so fold the
+            # top ~45% of residues to the negative side: k*P = -(r-k)*P
+            # with r - k < r - 2^254 < 2^254.
+            return self.mul_scalar_static(self.neg(pt), R_SUBGROUP - k)
         if k == 0:
             return self.infinity_like(pt)
         nbits = k.bit_length()

@@ -1,8 +1,8 @@
 """Array-native ("bundled") BLS12-381 field arithmetic.
 
-The scalar-composed tower in ops.fp/fp2/tower builds one jaxpr equation per
-limb-level operation, which made the Miller-loop graph ~30k equations —
-infeasible to trace/compile. This module is the TPU-native layout:
+The scalar-composed tower in ops.fp builds one jaxpr equation per limb-level
+operation, which made the Miller-loop graph ~30k equations — infeasible to
+trace/compile. This module is the TPU-native layout:
 
 - A value bundle is an int32 array `(..., S, NB)`: S field "slots"
   (Fp2 = 2, Fp6 = 6, Fp12 = 12, a G2 coordinate = 2, ...), NB = 33 limbs of
@@ -14,19 +14,48 @@ infeasible to trace/compile. This module is the TPU-native layout:
 - All the independent Montgomery products of a tower multiplication run as
   ONE stacked convolution (`mul_lazy`), e.g. an Fp12 product is a single
   18-slot multiply.
-- Values are kept *lazily reduced*: canonical limbs in [0, 2^12), value in
-  [0, ~2.2p). Exact canonicalization to [0, p) happens only in predicates
-  (`canon`, `eq`, `is_zero`) and at host boundaries. Bound bookkeeping:
-    mul_lazy inputs  < 2.2p  -> T < 4.84 p^2 < R p  (REDC valid)
-    mul_lazy output  < T/R + 1.0003p < 1.5p
-    apply_combo: |result before offset| < L1 * 2.2p; adding the 120p
-    spread offset keeps limbs non-negative for L1 <= 12, and
-    `reduce_small` (top-two-limb quotient estimate against 2p) returns
-    values < 2.2p.
+
+RELAXED-LIMB INVARIANT (the key to a small graph — no exact carry
+resolution anywhere on the hot path):
+
+  Every bundle flowing between ops has non-negative limbs <= LIMB_RELAX
+  (4097) and value < 2.2p. Exact canonical limbs/values exist only inside
+  `canon` (predicates / host boundaries), which runs the one Kogge-Stone
+  resolve in the module.
+
+  Why this is sound (numbers: p = 1.6256*2^380, R = 2^384, p/R = 0.1016,
+  2p = 832.009*2^372, so the reduce_small divisor error per quotient unit
+  is d = 833*2^372 - 2p = 0.991*2^372 = 0.00238p):
+  * conv products: limbs <= 4097 give per-term products <= 4097^2 and
+    column sums <= 66 * 4097^2 < 2^31 — no int32 overflow.
+  * `_relax(x, n_passes)`: each partial carry pass maps limb bound L to
+    4095 + (L >> 12); three passes take any L < 2^30 down to <= 4096.
+    Passes preserve value exactly (shift/mask arithmetic), including for
+    negative intermediates (arithmetic shift = floor division).
+  * Montgomery REDC carry across the R boundary: t + m*p = 0 mod R with
+    value(low 32 limbs) < 1.001*R, so value(low) is EXACTLY 0 or R.
+    Non-negative limbs mean value 0 <=> all limbs 0, hence the carry into
+    the high half is just `any(low != 0)` — no carry network needed.
+  * `reduce_small` quotient estimate: q = floor(top_two_limbs / 833)
+    satisfies q*2p <= x, and the remainder is
+    < 833*2^372 + q*d + value(relaxed low limbs)
+    = 2.004p + 0.00238p*q + 0.0012p.
+  * Bound closure at 2.2p:
+      mul_lazy: inputs < 2.2p -> T < 4.84 p^2, T/R < 4.84*(p/R)*p
+        = 0.492p, output < 0.492p + 1.001p < 1.5p.
+      add: x < 4.4p -> q <= 2 -> out < 2.01p.
+      sub: x < 2.2p + 32p + eps < 34.3p -> q <= 17 -> out < 2.05p.
+      scalar_small (k <= 12): x < 26.5p -> q <= 13 -> out < 2.04p.
+      apply_combo: x < (36*2.2 + 368)p = 448p -> q <= 224 -> first
+        reduce_small gives < 2.004p + 0.54p = 2.55p, so it reduces
+        TWICE; second pass input < 2.55p = 1038*2^372 -> q <= 1 ->
+        out < 2.01p.
+    Everything stays < 2.05p < 2.2p, with ~0.15p margin (verified
+    adversarially in tests/test_fieldb_bounds.py).
 
 The multiplication *programs* (which slot combinations feed which product,
 and how products recombine) are built symbolically at import time from the
-same tower formulas validated in crypto/ref_fields — see `_BilinearBuilder`.
+same tower formulas validated in crypto/ref_fields — see ops.programs.
 
 Parity note: this plane replaces blst's field/tower arithmetic behind the
 reference's BLS boundary (crypto/bls/src/impls/blst.rs), re-laid-out for
@@ -50,6 +79,7 @@ from lighthouse_tpu.crypto.constants import (
 
 NB = NLIMBS + 1  # bundle limb count (one headroom limb)
 _TOP = NB - 1
+LIMB_RELAX = LIMB_MASK + 2  # relaxed limb bound (4097)
 
 _NPRIME_INT = (-pow(P, -1, 1 << (LIMB_BITS * NLIMBS))) % (
     1 << (LIMB_BITS * NLIMBS)
@@ -74,25 +104,31 @@ COMP_2P = _limbs((1 << (LIMB_BITS * NB)) - 2 * P, NB)
 # 2^396 - p (for canonicalization cond-subtract)
 COMP_P = _limbs((1 << (LIMB_BITS * NB)) - P, NB)
 
-# Offset constant for signed combos: value 360p, limbs spread so every limb
-# except the top is >= 36*4096 - 36 (covers combos with L1 norm <= 36 — the
-# Fp12 recombination rows reach 36). Bound chain: combo result + offset
-# < (36*2.2 + 360)p = 439p < 2^391 << 2^396, and reduce_small's top-two-limb
-# quotient estimate stays exact for values < 2^24 * 2^372.
+# Offset constant for signed combos: value 368p (top limb 37 — enough to
+# absorb the 37-unit spread; 365p is the minimum for that), limbs spread so
+# every limb except the top is >= 37*4096 - 37 > 36*LIMB_RELAX — covers
+# combos with L1 norm <= 36 over relaxed-limb inputs (the Fp12
+# recombination rows reach 36). Bound chain: combo result + offset
+# < (36*2.2 + 368)p = 448p < 2^390 << 2^396, within reduce_small's
+# quotient-estimate domain.
 _OFF_K = 36
-OFF_CONST = _limbs(360 * P, NB)
+_OFF_SPREAD = 37
+OFF_CONST = _limbs(368 * P, NB)
 for _i in range(NB - 1):
-    OFF_CONST[_i] += _OFF_K << LIMB_BITS
-    OFF_CONST[_i + 1] -= _OFF_K
-assert OFF_CONST.min() >= 0 and OFF_CONST[:-1].min() >= _OFF_K * 4095
+    OFF_CONST[_i] += _OFF_SPREAD << LIMB_BITS
+    OFF_CONST[_i + 1] -= _OFF_SPREAD
+assert OFF_CONST.min() >= 0
+assert OFF_CONST[:-1].min() >= _OFF_K * LIMB_RELAX
 
-# Subtraction constant: value 16p, limbs spread by one unit (covers
-# subtracting any canonical-limbed value < 2.2p... limbs <= 4095).
-SPREAD_16P = _limbs(16 * P, NB)
+# Subtraction constant: value 32p (top limb 3 — enough to absorb the
+# 2-unit spread), limbs spread by two units (>= 2*4096 - 2 >= LIMB_RELAX,
+# so a - b + SPREAD_SUB has non-negative limbs for any relaxed-limb b).
+# Value headroom: a - b + 32p < 34.3p keeps reduce_small's q <= 17.
+SPREAD_SUB = _limbs(32 * P, NB)
 for _i in range(NB - 1):
-    SPREAD_16P[_i] += 1 << LIMB_BITS
-    SPREAD_16P[_i + 1] -= 1
-assert SPREAD_16P.min() >= 0 and SPREAD_16P[:-1].min() >= 4095
+    SPREAD_SUB[_i] += 2 << LIMB_BITS
+    SPREAD_SUB[_i + 1] -= 2
+assert SPREAD_SUB.min() >= 0 and SPREAD_SUB[:-1].min() >= LIMB_RELAX
 
 # Convolution masks (i + j == k), full and low-truncated.
 _CONV_FULL = np.zeros((NB, NB, 2 * NB - 1), dtype=np.int32)
@@ -123,9 +159,27 @@ def _partial_pass(x):
     return d + jnp.pad(c[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
 
 
+def _relax(x, out_len, passes=3):
+    """Value-preserving (mod 2^(12*out_len)) relaxation to limbs <= ~4096.
+
+    Carries beyond out_len are dropped — callers use this deliberately for
+    mod-R / mod-2^396 arithmetic. `passes` must satisfy the bound chain
+    L -> 4095 + (L >> 12) from the caller's input limb bound down to
+    <= LIMB_RELAX.
+    """
+    in_len = x.shape[-1]
+    if in_len < out_len:
+        x = _pad_last(x, out_len - in_len)
+    elif in_len > out_len:
+        x = x[..., :out_len]
+    for _ in range(passes):
+        x = _partial_pass(x)
+    return x
+
+
 def _ks_resolve(x):
-    """Kogge-Stone carry resolution; limbs must be in [0, 2*2^12 - 2] with
-    unit carries. Returns (canonical limbs, top carry-out)."""
+    """Kogge-Stone carry resolution; limbs must be < 2*4096 (unit carries).
+    Returns (canonical limbs, top carry-out). Used only by `canon`."""
     g = x > LIMB_MASK
     p = x == LIMB_MASK
     shift = 1
@@ -144,37 +198,22 @@ def _ks_resolve(x):
     return (x + carry_in) & LIMB_MASK, gg[..., -1]
 
 
-def _normalize(x, out_len):
-    """Non-negative limbs (< 2^30) -> canonical limbs. Value beyond
-    2^(12*out_len) is truncated (callers use this deliberately for mod-R /
-    mod-2^396 arithmetic)."""
-    in_len = x.shape[-1]
-    if in_len < out_len:
-        x = _pad_last(x, out_len - in_len)
-    elif in_len > out_len:
-        x = x[..., :out_len]
-        # carries out of the kept range are multiples of the modulus the
-        # caller reduces by; dropping them is intentional
-    x = _partial_pass(x)
-    x = _partial_pass(x)
-    x = _partial_pass(x)
-    out, _ = _ks_resolve(x)
-    return out
-
-
 def reduce_small(x):
-    """Canonical-limbed x (NB limbs, value < ~2^24 * 2^372) -> value < 2.2p.
+    """Relaxed-limbed x (NB limbs, value < ~2^24 * 2^372) -> value
+    < 2.004p + 0.00238p*q_max, limbs <= 4096 (q_max = value_bound/2p; the
+    callers in this module keep outputs < 2.05p — see module docstring;
+    inputs above ~80p need a second pass to get back under 2.2p).
 
     Quotient estimate from the top two limbs against 2p (2p < 833*2^372):
-    q = (x >> 372) // 833 satisfies q*2p <= x, and the remainder is
-    bounded < 2.2p (see module docstring analysis)."""
+    q = (x >> 372) // 833 satisfies q*2p <= x (see module docstring)."""
     t2 = x[..., _TOP] * (1 << LIMB_BITS) + x[..., _TOP - 1]
     q = t2 // 833
-    return _normalize(x + q[..., None] * jnp.asarray(COMP_2P), NB)
+    return _relax(x + q[..., None] * jnp.asarray(COMP_2P), NB)
 
 
 def _cond_sub(x, comp_const):
-    """Subtract the complement's value iff x >= value (exact compare)."""
+    """Subtract the complement's value iff x >= value (exact compare).
+    Input limbs must be canonical (callers resolve first)."""
     s = x + jnp.asarray(comp_const)
     c = s >> LIMB_BITS
     d = s & LIMB_MASK
@@ -186,7 +225,8 @@ def _cond_sub(x, comp_const):
 
 
 def canon(x):
-    """Lazy value (< 2.2p... < 3p) -> exact canonical [0, p)."""
+    """Lazy value (< 2.5p) -> exact canonical [0, p), canonical limbs."""
+    x, _ = _ks_resolve(x)  # relaxed limbs (<= 4097, unit carries) -> exact
     x = _cond_sub(x, COMP_2P)
     return _cond_sub(x, COMP_P)
 
@@ -196,8 +236,9 @@ def canon(x):
 
 def mul_lazy(a, b):
     """Stacked Montgomery product over the slot axis: (..., S, NB) x
-    (..., S, NB) -> (..., S, NB); inputs < 2.2p, output < 1.5p."""
-    t = _normalize(
+    (..., S, NB) -> (..., S, NB); inputs < 2.2p relaxed, output < 1.5p,
+    limbs <= LIMB_RELAX."""
+    t = _relax(
         jnp.einsum(
             "...ij,ijk->...k",
             a[..., :, None] * b[..., None, :],
@@ -206,21 +247,26 @@ def mul_lazy(a, b):
         2 * NB,
     )
     t_low = t[..., :NLIMBS]
-    m = _normalize(
+    m = _relax(
         jnp.einsum(
             "...ij,ijk->...k",
             t_low[..., :, None] * jnp.asarray(NPRIME_LIMBS)[None, :],
             jnp.asarray(_CONV_LOW32),
         ),
-        NLIMBS + 1,
-    )[..., :NLIMBS]
+        NLIMBS,
+    )
     mp = jnp.einsum(
         "...ij,ijk->...k",
         m[..., :, None] * jnp.asarray(P_LIMBS32)[None, :],
         jnp.asarray(_CONV_MP),
     )
-    full = _normalize(t + _pad_last(mp, 2 * NB - mp.shape[-1]), 2 * NB)
-    return full[..., NLIMBS : NLIMBS + NB]
+    full = _relax(t + _pad_last(mp, 2 * NB - mp.shape[-1]), 2 * NB)
+    # REDC carry across the R boundary: value(low 32 limbs) is exactly 0 or
+    # R (it is = 0 mod R and < 1.001R), and limbs are non-negative, so the
+    # carry is any(low != 0).
+    low_nonzero = jnp.any(full[..., :NLIMBS] != 0, axis=-1)
+    out = full[..., NLIMBS : NLIMBS + NB]
+    return out.at[..., 0].add(low_nonzero.astype(jnp.int32))
 
 
 def sqr_lazy(a):
@@ -232,24 +278,24 @@ def sqr_lazy(a):
 
 def apply_combo(x, matrix):
     """Static small-integer slot recombination: (..., S_in, NB) -> (...,
-    S_out, NB), each output < 2.2p. Matrix L1 row norms must be <= 12."""
+    S_out, NB), each output < 2.01p. Matrix L1 row norms must be <= 36.
+
+    Reduces twice: the offset pushes the value to ~448p, where one
+    quotient-estimate pass only reaches ~2.55p (see module docstring)."""
     m = np.asarray(matrix, dtype=np.int32)
     assert np.abs(m).sum(axis=1).max() <= _OFF_K, "combo L1 too large"
     y = jnp.einsum("os,...sn->...on", jnp.asarray(m), x)
-    y = _normalize(y + jnp.asarray(OFF_CONST), NB)
-    return reduce_small(y)
+    y = _relax(y + jnp.asarray(OFF_CONST), NB, passes=2)
+    return reduce_small(reduce_small(y))
 
 
 def add(a, b):
-    s = _partial_pass(a + b)
-    out, _ = _ks_resolve(s)
-    return reduce_small(out)
+    return reduce_small(_partial_pass(a + b))
 
 
 def sub(a, b):
-    s = _partial_pass(a - b + jnp.asarray(SPREAD_16P))
-    out, _ = _ks_resolve(s)
-    return reduce_small(out)
+    s = a - b + jnp.asarray(SPREAD_SUB)
+    return reduce_small(_relax(s, NB, passes=2))
 
 
 def neg(a):
@@ -259,9 +305,9 @@ def neg(a):
 def scalar_small(a, k: int):
     if k == 0:
         return jnp.zeros_like(a)
-    s = a * k  # limbs <= 12*4095 for k <= 12
-    assert k <= _OFF_K
-    return reduce_small(_normalize(s, NB))
+    assert k <= 12
+    s = a * k  # limbs <= 12*4097 < 2^16
+    return reduce_small(_relax(s, NB, passes=2))
 
 
 # ------------------------------------------------------------- predicates

@@ -36,70 +36,122 @@ def _batch_shape(f):
 
 
 # ---------------------------------------------------------------- the loop
+#
+# The doubling/addition steps fuse the line computation with the point
+# update — they share nearly all intermediates — and batch every stage's
+# independent Fp2 products into one stacked program call and every stage's
+# linear recombination into one apply_combo. This keeps the scan body a few
+# hundred equations instead of tens of thousands (the unified G2.add path).
+
+
+def _mul2(pairs):
+    """One stacked Fp2 multiply for a list of (a, b) bundle pairs."""
+    A = jnp.stack([a for a, _ in pairs], axis=-3)
+    B = jnp.stack([b for _, b in pairs], axis=-3)
+    out = curve.F2.mul(A, B)
+    return [out[..., i, :, :] for i in range(len(pairs))]
+
+
+def _combo2(vals, coeffs):
+    """One apply_combo over a list of Fp2 bundles; `coeffs` is an
+    (n_out, n_in) integer matrix acting Fp2-componentwise."""
+    x = jnp.concatenate(vals, axis=-2)
+    m = np.kron(np.asarray(coeffs, dtype=np.int64), np.eye(2, dtype=np.int64))
+    y = fb.apply_combo(x, m.astype(np.int32))
+    return [y[..., 2 * i : 2 * i + 2, :] for i in range(coeffs.shape[0])]
+
+
+def _line_scale(ca, cb, px, py):
+    """(ca*px, cb*py) as one 4-slot raw multiply (Fp scalar acting
+    componentwise on Fp2)."""
+    lhs = jnp.concatenate([ca, cb], axis=-2)
+    rhs = jnp.concatenate(
+        [jnp.broadcast_to(px, ca.shape), jnp.broadcast_to(py, cb.shape)],
+        axis=-2,
+    )
+    out = fb.mul_lazy(lhs, rhs)
+    return out[..., 0:2, :], out[..., 2:4, :]
 
 
 def _dbl_step(t, px, py):
-    """Tangent line at Jacobian twist point t evaluated at affine
-    P=(px, py) (Fp bundles), plus 2t. Line = 3X^3 - 2Y^2
-    - (3 X^2 Z^2 px) w^2 + (2 Y Z^3 py) w^3 (scaled by 2YZ^3 in Fp2)."""
+    """Fused tangent-line + doubling. Line = 3X^3 - 2Y^2
+    - (3 X^2 Z^2 px) w^2 + (2 Y Z^3 py) w^3 (scaled by 2YZ^3 in Fp2);
+    point update is dbl-2001-b (a = X^2, b = Y^2, c = b^2,
+    d = 2((X+b)^2 - a - c), e = 3a, f = e^2)."""
     X, Y, Z = t
-    F = curve.F2
-    l1 = F.mul(
-        jnp.stack([X, Y, Z], axis=-3), jnp.stack([X, Y, Z], axis=-3)
+    a, b, z2, yz = _mul2([(X, X), (Y, Y), (Z, Z), (Y, Z)])
+    xb, e = _combo2(
+        [X, a, b],
+        np.array([[1, 0, 1], [0, 3, 0]]),
     )
-    x2, y2, z2 = l1[..., 0, :, :], l1[..., 1, :, :], l1[..., 2, :, :]
-    l2 = F.mul(
-        jnp.stack([x2, z2, x2], axis=-3),
-        jnp.stack([X, Z, z2], axis=-3),
+    c, xb2, f, x3c, x2z2, yz3 = _mul2(
+        [(b, b), (xb, xb), (e, e), (X, a), (a, z2), (yz, z2)]
     )
-    x3c, z3c, x2z2 = (
-        l2[..., 0, :, :],
-        l2[..., 1, :, :],
-        l2[..., 2, :, :],
+    # rows over [xb2, a, c, f, x3c, b, x2z2, yz3, yz]:
+    #   d    = 2 xb2 - 2a - 2c
+    #   x3   = f - 2d = f - 4 xb2 + 4a + 4c
+    #   dmx  = d - x3 = 6 xb2 - 6a - 6c - f
+    #   c0   = 3 x3c - 2b
+    #   m3xz = -3 x2z2          (line w^2 coefficient, pre-px)
+    #   c3p  = 2 yz3            (line w^3 coefficient, pre-py)
+    #   z3   = 2 yz
+    x3, dmx, c0, m3xz, c3p, z3 = _combo2(
+        [xb2, a, c, f, x3c, b, x2z2, yz3, yz],
+        np.array(
+            [
+                [-4, 4, 4, 1, 0, 0, 0, 0, 0],
+                [6, -6, -6, -1, 0, 0, 0, 0, 0],
+                [0, 0, 0, 0, 3, -2, 0, 0, 0],
+                [0, 0, 0, 0, 0, 0, -3, 0, 0],
+                [0, 0, 0, 0, 0, 0, 0, 2, 0],
+                [0, 0, 0, 0, 0, 0, 0, 0, 2],
+            ]
+        ),
     )
-    yz3 = F.mul(Y, z3c)
-    c0 = F.sub(F.scalar_small(x3c, 3), F.scalar_small(y2, 2))
-    c2 = F.neg(
-        fb.mul_lazy(
-            F.scalar_small(x2z2, 3), jnp.broadcast_to(px, x2z2.shape)
-        )
-    )
-    c3 = fb.mul_lazy(
-        F.scalar_small(yz3, 2), jnp.broadcast_to(py, yz3.shape)
-    )
+    (edmx,) = _mul2([(e, dmx)])
+    c2, c3 = _line_scale(m3xz, c3p, px, py)
+    (y3,) = _combo2([edmx, c], np.array([[1, -8]]))
     line = jnp.concatenate([c0, c2, c3], axis=-2)
-    return curve.G2.double(t), line
+    return (x3, y3, z3), line
 
 
 def _add_step(t, q_affine, px, py):
-    """Chord line through t and affine twist q evaluated at P, plus t+q.
-    Valid when q != +-t (guaranteed: the running T is a proper multiple of
-    q below the group order)."""
+    """Fused chord-line + mixed addition (affine q, Z2 = 1). Valid when
+    q != +-t and t is finite (guaranteed: the running T is a proper
+    multiple of q below the group order). theta/gamma are the chord
+    slope numerator/denominator; the point update is the classic
+    X3 = theta^2 - gamma^3 - 2 X1 gamma^2 family with Z3 = Z1*gamma."""
     X1, Y1, Z1 = t
     qx, qy = q_affine
-    F = curve.F2
-    z1s = F.sqr(Z1)
-    l2 = F.mul(
-        jnp.stack([z1s, qx], axis=-3), jnp.stack([Z1, z1s], axis=-3)
+    (z1s,) = _mul2([(Z1, Z1)])
+    u2, z1c = _mul2([(qx, z1s), (z1s, Z1)])
+    (gamma,) = _combo2([u2, X1], np.array([[1, -1]]))
+    qyz, hh, z1gam = _mul2([(qy, z1c), (gamma, gamma), (Z1, gamma)])
+    (theta,) = _combo2([qyz, Y1], np.array([[1, -1]]))
+    tt, hhh, v, tqx, qyz3 = _mul2(
+        [(theta, theta), (gamma, hh), (X1, hh), (theta, qx), (qy, z1gam)]
     )
-    z1c, qxz = l2[..., 0, :, :], l2[..., 1, :, :]
-    qyz = F.mul(qy, z1c)
-    theta = F.sub(qyz, Y1)
-    gamma = F.sub(qxz, X1)
-    z1gam = F.mul(Z1, gamma)
-    l3 = F.mul(
-        jnp.stack([theta, qy], axis=-3),
-        jnp.stack([qx, z1gam], axis=-3),
+    # rows over [tt, hhh, v, tqx, qyz3, theta]:
+    #   x3     = tt - hhh - 2v
+    #   vmx    = v - x3 = -tt + hhh + 3v
+    #   c0     = tqx - qyz3
+    #   mtheta = -theta         (line w^2 coefficient, pre-px)
+    x3, vmx, c0, mtheta = _combo2(
+        [tt, hhh, v, tqx, qyz3, theta],
+        np.array(
+            [
+                [1, -1, -2, 0, 0, 0],
+                [-1, 1, 3, 0, 0, 0],
+                [0, 0, 0, 1, -1, 0],
+                [0, 0, 0, 0, 0, -1],
+            ]
+        ),
     )
-    c0 = F.sub(l3[..., 0, :, :], l3[..., 1, :, :])
-    c2 = F.neg(
-        fb.mul_lazy(theta, jnp.broadcast_to(px, theta.shape))
-    )
-    c3 = fb.mul_lazy(z1gam, jnp.broadcast_to(py, z1gam.shape))
+    tvmx, y1hhh = _mul2([(theta, vmx), (Y1, hhh)])
+    c2, c3 = _line_scale(mtheta, z1gam, px, py)
+    (y3,) = _combo2([tvmx, y1hhh], np.array([[1, -1]]))
     line = jnp.concatenate([c0, c2, c3], axis=-2)
-    one = jnp.broadcast_to(jnp.asarray(curve.F2.ONE), qx.shape)
-    t_next = curve.G2.add(t, (qx, qy, one))
-    return t_next, line
+    return (x3, y3, z1gam), line
 
 
 def miller_loop(p_g1_affine, q_g2_affine, valid_mask=None):
@@ -121,11 +173,16 @@ def miller_loop(p_g1_affine, q_g2_affine, valid_mask=None):
         f = tower.fp12_sqr(f)
         t, line = _dbl_step(t, px, py)
         f = _mul_by_line(f, line)
-        t_add, line_add = _add_step(t, (qx, qy), px, py)
-        f_add = _mul_by_line(f, line_add)
-        use = jnp.broadcast_to(bit == 1, _batch_shape(f))
-        t = curve.G2.select(use, t_add, t)
-        f = tower.fp12_select(use, f_add, f)
+
+        # `bit` is a SCALAR from the static exponent |x| (Hamming weight 6),
+        # so this cond is a real branch: the add-step only runs on the 5
+        # set bits after the leading one, not all 63 iterations.
+        def do_add(op):
+            f_, t_ = op
+            t_next, line_add = _add_step(t_, (qx, qy), px, py)
+            return _mul_by_line(f_, line_add), t_next
+
+        f, t = jax.lax.cond(bit == 1, do_add, lambda op: op, (f, t))
         return (f, t), None
 
     (f, _), _ = jax.lax.scan(step, (f0, t0), bits)
@@ -150,9 +207,13 @@ def _pow_x_abs(f):
 
     def step(carry, bit):
         result, base = carry
-        mult = tower.fp12_mul(result, base)
-        use = jnp.broadcast_to(bit == 1, _batch_shape(result))
-        result = tower.fp12_select(use, mult, result)
+        # scalar static-exponent bit -> real branch (|x| Hamming weight 6)
+        result = jax.lax.cond(
+            bit == 1,
+            lambda rb: tower.fp12_mul(rb[0], rb[1]),
+            lambda rb: rb[0],
+            (result, base),
+        )
         base = tower.fp12_sqr(base)
         return (result, base), None
 

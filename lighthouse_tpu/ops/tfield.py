@@ -1,0 +1,192 @@
+"""Transposed ("batch-last") BLS12-381 field arithmetic for TPU kernels.
+
+Layout: a bundle is an int32 array `(S_slots, NB, B)` — slots lead, the
+12-bit limb axis is second-to-last (sublanes), and the BATCH axis B is last
+(lanes). With B a multiple of 128 every elementwise op runs at full VPU
+lane utilization, unlike the batch-leading layout in ops.fieldb whose
+33-limb trailing axis wastes 3/4 of each vector register row.
+
+Functions here are pure jnp and run in two modes:
+  * directly under jit (XLA level), via ops.tpairing;
+  * inside a Pallas TPU kernel (ops.pallas_pairing), where the whole
+    Miller loop stays in VMEM.
+
+The arithmetic, bounds, and relaxed-limb invariant are IDENTICAL to
+ops.fieldb (see its module docstring for the full analysis): limbs stay in
+[0, LIMB_RELAX], values < 2.2p, no exact carry resolution on the hot path.
+Only the data movement differs:
+  * the data x data convolution unrolls over the 33 limbs of `a`
+    (static-slice accumulate) instead of an einsum against a one-hot
+    tensor;
+  * the two static convolutions of Montgomery REDC (by N' and by p)
+    unroll over STATIC scalar limbs — scalar * tensor fused multiply-adds;
+  * slot recombinations unroll per output row over the (sparse, small)
+    static coefficients instead of an einsum.
+
+Parity note: behind the reference's BLS boundary
+(crypto/bls/src/impls/blst.rs), alternate layout of the same plane.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import LIMB_BITS, LIMB_MASK, NLIMBS
+from lighthouse_tpu.ops import fieldb as fb
+
+NB = fb.NB
+LIMB_RELAX = fb.LIMB_RELAX
+
+_NPRIME = [int(v) for v in fb.NPRIME_LIMBS]
+_PLIMBS = [int(v) for v in fb.P_LIMBS32]
+_COMP_2P = [int(v) for v in fb.COMP_2P]
+_OFF = [int(v) for v in fb.OFF_CONST]
+_SPREAD_SUB = [int(v) for v in fb.SPREAD_SUB]
+
+
+# ----------------------------------------------------------- carry handling
+
+
+def _partial_pass(x):
+    """One value-preserving carry pass along the limb axis (-2)."""
+    c = x >> LIMB_BITS
+    d = x & LIMB_MASK
+    pad = [(0, 0)] * x.ndim
+    pad[-2] = (1, 0)
+    return d + jnp.pad(c[..., :-1, :], pad)
+
+
+def _relax(x, out_len, passes=3):
+    """Limbs -> <= ~4096; truncation beyond out_len is deliberate mod-R /
+    mod-2^396 arithmetic (same bound chains as fieldb._relax)."""
+    in_len = x.shape[-2]
+    if in_len < out_len:
+        pad = [(0, 0)] * x.ndim
+        pad[-2] = (0, out_len - in_len)
+        x = jnp.pad(x, pad)
+    elif in_len > out_len:
+        x = x[..., :out_len, :]
+    for _ in range(passes):
+        x = _partial_pass(x)
+    return x
+
+
+def _const_col(limbs):
+    """Static limb list -> (len, 1) column broadcastable over (..., L, B)."""
+    return jnp.asarray(np.array(limbs, dtype=np.int32)[:, None])
+
+
+def reduce_small(x):
+    """fieldb.reduce_small in transposed layout: quotient estimate from the
+    top two limbs, subtract q*2p via the 2^396-complement."""
+    t2 = x[..., NB - 1, :] * (1 << LIMB_BITS) + x[..., NB - 2, :]
+    q = t2 // 833
+    return _relax(x + q[..., None, :] * _const_col(_COMP_2P), NB)
+
+
+# ------------------------------------------------------------- multiplies
+
+
+def mul_lazy(a, b):
+    """Stacked Montgomery product: (..., S, NB, B) x (..., S, NB, B) ->
+    (..., S, NB, B); inputs < 2.2p relaxed, output < 1.5p (fieldb bound
+    chain). Data x data conv unrolls over a's limbs; REDC's two static
+    convs unroll over scalar limbs of N' and p."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    tshape = shape[:-2] + (2 * NB, shape[-1])
+    t = jnp.zeros(tshape, dtype=jnp.int32)
+    for i in range(NB):
+        t = t.at[..., i : i + NB, :].add(a[..., i : i + 1, :] * b)
+    t = _relax(t, 2 * NB)
+
+    t_low = t[..., :NLIMBS, :]
+    m = jnp.zeros(shape[:-2] + (NLIMBS, shape[-1]), dtype=jnp.int32)
+    for j in range(NLIMBS):
+        npj = _NPRIME[j]
+        if npj == 0:
+            continue
+        # shift t_low up by j limbs, truncated at NLIMBS (mod R)
+        m = m.at[..., j:, :].add(npj * t_low[..., : NLIMBS - j, :])
+    m = _relax(m, NLIMBS)
+
+    mp = jnp.zeros(shape[:-2] + (2 * NLIMBS - 1, shape[-1]), dtype=jnp.int32)
+    for j in range(NLIMBS):
+        pj = _PLIMBS[j]
+        if pj == 0:
+            continue
+        mp = mp.at[..., j : j + NLIMBS, :].add(pj * m)
+    pad = [(0, 0)] * len(tshape)
+    pad[-2] = (0, 2 * NB - (2 * NLIMBS - 1))
+    full = _relax(t + jnp.pad(mp, pad), 2 * NB)
+
+    low_nonzero = jnp.any(full[..., :NLIMBS, :] != 0, axis=-2)
+    out = full[..., NLIMBS : NLIMBS + NB, :]
+    return out.at[..., 0, :].add(low_nonzero.astype(jnp.int32))
+
+
+def sqr_lazy(a):
+    return mul_lazy(a, a)
+
+
+# --------------------------------------------------------------- combos
+
+
+def apply_combo(x, matrix):
+    """Slot recombination: (..., S_in, NB, B) -> (..., S_out, NB, B).
+    Unrolled per output row over static small coefficients (rows L1 <= 36);
+    double-reduced exactly like fieldb.apply_combo."""
+    m = np.asarray(matrix, dtype=np.int64)
+    assert np.abs(m).sum(axis=1).max() <= fb._OFF_K, "combo L1 too large"
+    off = _const_col(_OFF)
+    rows = []
+    for o in range(m.shape[0]):
+        acc = None
+        for s in range(m.shape[1]):
+            c = int(m[o, s])
+            if c == 0:
+                continue
+            term = x[..., s, :, :] if c == 1 else c * x[..., s, :, :]
+            acc = term if acc is None else acc + term
+        if acc is None:
+            acc = jnp.zeros_like(x[..., 0, :, :])
+        rows.append(acc + off)
+    y = jnp.stack(rows, axis=-3)
+    y = _relax(y, NB, passes=2)
+    return reduce_small(reduce_small(y))
+
+
+def add(a, b):
+    return reduce_small(_partial_pass(a + b))
+
+
+def sub(a, b):
+    s = a - b + _const_col(_SPREAD_SUB)
+    return reduce_small(_relax(s, NB, passes=2))
+
+
+def scalar_small(a, k: int):
+    if k == 0:
+        return jnp.zeros_like(a)
+    assert k <= 12
+    return reduce_small(_relax(a * k, NB, passes=2))
+
+
+def select(cond, a, b):
+    """cond: (..., B) broadcasting over (slots, limbs)."""
+    return jnp.where(cond[..., None, None, :], a, b)
+
+
+# --------------------------------------------------------- layout converts
+
+
+def from_batchlead(x):
+    """(..., S, NB) batch-leading (fieldb layout, batch axes in ...) ->
+    (S, NB, B) with the single leading batch axis moved last."""
+    return jnp.moveaxis(x, -3, -1)
+
+
+def to_batchlead(x):
+    """(S, NB, B) -> (B, S, NB)."""
+    return jnp.moveaxis(x, -1, -3)

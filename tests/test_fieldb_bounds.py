@@ -1,0 +1,137 @@
+"""Adversarial stress tests for fieldb's relaxed-limb invariant.
+
+Every op must (a) keep limbs in [0, LIMB_RELAX], (b) keep values < 2.2p
+(the module invariant; outputs are actually < 2.05p), (c) agree with
+Python big-int arithmetic. We drive long random op chains and adversarial
+near-bound inputs (noisy non-canonical limb patterns, values just under
+2.2p) through the public API.
+"""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.crypto.constants import LIMB_BITS, NLIMBS, P
+from lighthouse_tpu.ops import fieldb as fb
+
+R = 1 << (LIMB_BITS * NLIMBS)
+RINV = pow(R, -1, P)
+
+
+def bundle_value(arr) -> list:
+    """Exact value of each slot (no mod p) — checks the <2.5p invariant."""
+    arr = np.asarray(arr)
+    flat = arr.reshape(-1, arr.shape[-1])
+    out = []
+    for row in flat:
+        acc = 0
+        for i, limb in enumerate(row):
+            acc += int(limb) << (LIMB_BITS * i)
+        out.append(acc)
+    return out
+
+
+def check_invariant(arr, what=""):
+    a = np.asarray(arr)
+    assert a.min() >= 0, f"{what}: negative limb"
+    assert a.max() <= fb.LIMB_RELAX, f"{what}: limb {a.max()} > LIMB_RELAX"
+    for v in bundle_value(a):
+        assert v < 2.2 * P, f"{what}: value {v / P:.3f}p >= 2.2p"
+
+
+def relaxed_rep(v: int, rng: random.Random) -> np.ndarray:
+    """A random non-canonical relaxed representation of value v."""
+    limbs = [(v >> (LIMB_BITS * i)) & 4095 for i in range(fb.NB)]
+    # push borrow/carry noise: move 4096 from limb i+1 into limb i where
+    # possible, keeping limbs <= LIMB_RELAX and non-negative
+    for i in range(fb.NB - 1):
+        if limbs[i + 1] >= 1 and limbs[i] <= fb.LIMB_RELAX - 4096:
+            if rng.random() < 0.5:
+                limbs[i + 1] -= 1
+                limbs[i] += 4096
+    return np.array(limbs, dtype=np.int32)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(1234)
+
+
+def test_mul_chain_random_and_adversarial(rng):
+    vals = [rng.randrange(P) for _ in range(6)]
+    vals += [P - 1, P - 2, 1, int(2.19 * P) - 7]  # near-bound values
+    a_int = vals
+    a = jnp.asarray(np.stack([relaxed_rep(v, rng) for v in vals]))
+    check_invariant(a, "input")
+    acc, acc_int = a, list(a_int)
+    for step in range(8):
+        acc = fb.mul_lazy(acc, a)
+        acc_int = [(x * y * RINV) % P for x, y in zip(acc_int, a_int)]
+        check_invariant(acc, f"mul step {step}")
+    got = fb.unpack_ints(fb.canon(acc))
+    assert got == [v % P for v in acc_int]
+
+
+def test_addsub_chain(rng):
+    vals = [rng.randrange(P) for _ in range(8)] + [0, P - 1]
+    a = jnp.asarray(np.stack([relaxed_rep(v, rng) for v in vals]))
+    b = jnp.asarray(np.stack([relaxed_rep(v, rng) for v in reversed(vals)]))
+    b_int = list(reversed(vals))
+    acc, acc_int = a, list(vals)
+    for step in range(6):
+        acc = fb.add(acc, b) if step % 2 == 0 else fb.sub(acc, b)
+        acc_int = [
+            (x + y) % P if step % 2 == 0 else (x - y) % P
+            for x, y in zip(acc_int, b_int)
+        ]
+        check_invariant(acc, f"addsub step {step}")
+    assert fb.unpack_ints(fb.canon(acc)) == acc_int
+
+
+def test_combo_worst_case_l1(rng):
+    # single row with L1 norm exactly 36, alternating signs, on relaxed reps
+    vals = [rng.randrange(P) for _ in range(12)]
+    a = jnp.asarray(np.stack([relaxed_rep(v, rng) for v in vals]))[None]
+    row = np.array([3 if i % 2 == 0 else -3 for i in range(12)], np.int32)
+    out = fb.apply_combo(a, row[None, :])
+    check_invariant(out, "combo")
+    want = sum(int(c) * v for c, v in zip(row, vals)) % P
+    assert fb.unpack_ints(fb.canon(out))[0] == want
+
+
+def test_scalar_small_and_neg(rng):
+    vals = [rng.randrange(P) for _ in range(4)] + [0, P - 1]
+    a = jnp.asarray(np.stack([relaxed_rep(v, rng) for v in vals]))
+    for k in (1, 2, 3, 8, 12):
+        out = fb.scalar_small(a, k)
+        check_invariant(out, f"scalar_small k={k}")
+        assert fb.unpack_ints(fb.canon(out)) == [(v * k) % P for v in vals]
+    out = fb.neg(a)
+    check_invariant(out, "neg")
+    assert fb.unpack_ints(fb.canon(out)) == [(-v) % P for v in vals]
+
+
+def test_predicates_on_noncanonical_reps(rng):
+    # same value, two different relaxed representations -> eq must hold
+    vals = [rng.randrange(P) for _ in range(6)] + [0, 4096, P - 1]
+    a = jnp.asarray(np.stack([relaxed_rep(v, rng) for v in vals]))
+    b = jnp.asarray(np.stack([relaxed_rep(v, rng) for v in vals]))
+    assert bool(jnp.all(fb.eq(a[:, None], b[:, None])))
+    zero_rep = np.zeros((1, fb.NB), np.int32)
+    assert bool(fb.is_zero(jnp.asarray(zero_rep)[None]))
+    # a value-p representation must canonicalize to zero
+    p_rep = relaxed_rep(P, rng)
+    assert fb.unpack_ints(fb.canon(jnp.asarray(p_rep)[None, None]))[0] == 0
+
+
+def test_inv_and_pow(rng):
+    vals = [rng.randrange(1, P) for _ in range(4)]
+    a_mont = fb.to_mont(jnp.asarray(np.stack([fb._limbs(v, fb.NB) for v in vals])))
+    check_invariant(a_mont, "to_mont")
+    ainv = fb.inv(a_mont)
+    check_invariant(ainv, "inv")
+    prod = fb.mul_lazy(a_mont, ainv)
+    got = fb.unpack_ints(fb.from_mont(prod))
+    assert got == [1] * 4

@@ -1,0 +1,72 @@
+"""Parity tests: ops.tfield (transposed batch-last layout) vs ops.fieldb.
+
+tfield must compute identical relaxed-limb bundles (same values mod p and
+the same invariants) as fieldb for every op — it is the same arithmetic
+with different data movement, consumed by the Pallas pairing kernel.
+"""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import P
+from lighthouse_tpu.ops import fieldb as fb, tfield as tf
+
+rng = random.Random(77)
+
+
+def _rand_bundle(s_slots, batch):
+    vals = [
+        [rng.randrange(int(2.1 * P)) for _ in range(s_slots)]
+        for _ in range(batch)
+    ]
+    arr = np.stack(
+        [np.stack([fb._limbs(v, fb.NB) for v in row]) for row in vals]
+    )  # (B, S, NB) canonical-limbed
+    return jnp.asarray(arr)
+
+
+def _t(x):  # batch-lead (B, S, NB) -> batch-last (S, NB, B)
+    return jnp.moveaxis(x, 0, -1)
+
+
+def _check_same(name, got_t, want_b):
+    got = np.asarray(jnp.moveaxis(got_t, -1, 0))
+    want = np.asarray(want_b)
+    assert got.min() >= 0 and got.max() <= tf.LIMB_RELAX, name
+    gv = fb.unpack_ints(fb.canon(jnp.asarray(got)))
+    wv = fb.unpack_ints(fb.canon(jnp.asarray(want)))
+    assert gv == wv, name
+
+
+def test_mul_add_sub_scalar_parity():
+    a = _rand_bundle(6, 4)
+    b = _rand_bundle(6, 4)
+    _check_same("mul", tf.mul_lazy(_t(a), _t(b)), fb.mul_lazy(a, b))
+    _check_same("add", tf.add(_t(a), _t(b)), fb.add(a, b))
+    _check_same("sub", tf.sub(_t(a), _t(b)), fb.sub(a, b))
+    _check_same("k8", tf.scalar_small(_t(a), 8), fb.scalar_small(a, 8))
+
+
+def test_combo_and_reduce_parity():
+    a = _rand_bundle(6, 3)
+    m = np.array(
+        [
+            [3, -3, 6, -6, 9, -9],
+            [1, 0, 0, 0, 0, -1],
+            [0, 2, 0, -2, 0, 0],
+        ],
+        dtype=np.int32,
+    )
+    _check_same("combo", tf.apply_combo(_t(a), m), fb.apply_combo(a, m))
+    _check_same("reduce", tf.reduce_small(_t(a)), fb.reduce_small(a))
+
+
+def test_mul_chain_parity():
+    a = _rand_bundle(12, 2)
+    bt, bb = _t(a), a
+    for _ in range(4):
+        bt = tf.mul_lazy(bt, _t(a))
+        bb = fb.mul_lazy(bb, a)
+    _check_same("chain", bt, bb)
