@@ -19,6 +19,12 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lighthouse_tpu.backend import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 TARGET_SIGS_PER_SEC = 150_000.0  # north star: 30k sigs in 200 ms on one chip
 
 

@@ -41,36 +41,22 @@ NEG_G1_AFFINE = (_mont1(G1_X), _mont1((P - G1_Y) % P))
 RAND_BITS = 64  # >= 64-bit RLC scalars, matching the reference
 
 
-def _lift_g1(aff, valid):
-    x, y = aff
-    one = jnp.broadcast_to(jnp.asarray(curve.F1.ONE), x.shape)
-    z = jnp.where(valid[..., None, None], one, jnp.zeros_like(x))
-    return (x, y, z)
-
-
-def _lift_g2(aff, valid):
-    x, y = aff
-    one = jnp.broadcast_to(jnp.asarray(curve.F2.ONE), x.shape)
-    z = jnp.where(valid[..., None, None], one, jnp.zeros_like(x))
-    return (x, y, z)
-
-
 def _expand0(pt):
     return tuple(c[None] for c in pt)
 
 
 def aggregate_pubkeys(pubkeys_g1_aff, key_mask):
-    """(S, K) affine G1 + mask -> (S,) Jacobian aggregate per set (masked
-    log-depth tree fold over the key axis)."""
-    pts = _lift_g1(pubkeys_g1_aff, key_mask)
-    return curve.G1.masked_sum_axis(pts, key_mask, axis=1)
+    """(S, K) affine G1 + mask -> (S,) projective aggregate per set (masked
+    log-depth tree fold over the key axis, complete-formula plane)."""
+    pts = curve.PG1.from_affine(pubkeys_g1_aff, key_mask)
+    return curve.PG1.masked_sum_axis(pts, key_mask, axis=1)
 
 
 def rlc_combined_signature(sigs_g2_aff, rand_bits, set_mask):
-    """sum_i r_i * sig_i -> single Jacobian G2 point."""
-    sig_jac = _lift_g2(sigs_g2_aff, set_mask)
-    sig_r = curve.G2.mul_scalar_bits(sig_jac, rand_bits)
-    return curve.G2.masked_sum_axis(sig_r, set_mask, axis=0)
+    """sum_i r_i * sig_i -> single projective G2 point."""
+    sig_proj = curve.PG2.from_affine(sigs_g2_aff, set_mask)
+    sig_r = curve.PG2.mul_scalar_bits(sig_proj, rand_bits)
+    return curve.PG2.masked_sum_axis(sig_r, set_mask, axis=0)
 
 
 def miller_inputs(
@@ -79,11 +65,11 @@ def miller_inputs(
     """Build the (S+1)-pair multi-pairing inputs; shared with the sharded
     path."""
     agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)
-    agg_pk_r = curve.G1.mul_scalar_bits(agg_pk, rand_bits)
-    pk_x, pk_y, pk_inf = curve.G1.to_affine(agg_pk_r)
+    agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
+    pk_x, pk_y, pk_inf = curve.PG1.to_affine(agg_pk_r)
 
     sig_acc = rlc_combined_signature(sigs_g2_aff, rand_bits, set_mask)
-    s_x, s_y, s_inf = curve.G2.to_affine(_expand0(sig_acc))
+    s_x, s_y, s_inf = curve.PG2.to_affine(_expand0(sig_acc))
 
     neg_g1 = (
         jnp.asarray(NEG_G1_AFFINE[0])[None],

@@ -78,6 +78,33 @@ F1 = FieldW(1)
 F2 = FieldW(2)
 
 
+def _tree_fold_sum(group, pts, axis: int):
+    """Log-depth tree fold of points along a batch axis; odd tails are
+    carried to the next level. Shared by both group planes."""
+    n = pts[0].shape[axis]
+    while n > 1:
+        half = n // 2
+        a = tuple(
+            jax.lax.slice_in_dim(c, 0, half, axis=axis) for c in pts
+        )
+        b = tuple(
+            jax.lax.slice_in_dim(c, half, 2 * half, axis=axis)
+            for c in pts
+        )
+        s = group.add(a, b)
+        if n % 2:
+            tail = tuple(
+                jax.lax.slice_in_dim(c, n - 1, n, axis=axis) for c in pts
+            )
+            s = tuple(
+                jnp.concatenate([x, t], axis=axis)
+                for x, t in zip(s, tail)
+            )
+        pts = s
+        n = half + (n % 2)
+    return tuple(jnp.squeeze(c, axis=axis) for c in pts)
+
+
 class JacobianGroup:
     def __init__(self, F: FieldW, b_mont, gen_affine_mont, name):
         self.F = F
@@ -360,33 +387,276 @@ class JacobianGroup:
 
     def sum_axis(self, pts, axis: int = 0):
         """Log-depth tree fold of points along a batch axis."""
-        n = pts[0].shape[axis]
-        while n > 1:
-            half = n // 2
-            a = tuple(
-                jax.lax.slice_in_dim(c, 0, half, axis=axis) for c in pts
-            )
-            b = tuple(
-                jax.lax.slice_in_dim(c, half, 2 * half, axis=axis)
-                for c in pts
-            )
-            s = self.add(a, b)
-            if n % 2:
-                tail = tuple(
-                    jax.lax.slice_in_dim(c, n - 1, n, axis=axis)
-                    for c in pts
-                )
-                s = tuple(
-                    jnp.concatenate([x, t], axis=axis)
-                    for x, t in zip(s, tail)
-                )
-            pts = s
-            n = half + (n % 2)
-        return tuple(jnp.squeeze(c, axis=axis) for c in pts)
+        return _tree_fold_sum(self, pts, axis)
 
     def masked_sum_axis(self, pts, mask, axis: int = 0):
         inf = self.infinity_like(pts)
         masked = self.select(mask, pts, inf)
+        return self.sum_axis(masked, axis=axis)
+
+
+class ProjectiveGroup:
+    """Branchless-complete homogeneous-projective point arithmetic for
+    y^2 = x^3 + b (a = 0) — the Renes–Costello–Batina complete formulas
+    (EUROCRYPT 2016, Algorithms 7 & 9).
+
+    This is the TPU-native group plane for everything outside the Miller
+    loop (MSM folds, RLC scalar ladders): ONE uniform formula covers
+    doubling, identity inputs, and inverse inputs, so there are no
+    exceptional-case selects, no started-flags, and the add/ladder graphs
+    are ~5x smaller than the unified Jacobian path — which is what the
+    XLA compile time of the whole verify program scales with.
+
+    A point is (X, Y, Z) bundles with x = X/Z, y = Y/Z; the identity is
+    (0 : 1 : 0). Completeness holds on the odd-order r-torsion — all
+    callers feed subgroup-checked points or the identity. Each formula
+    stage runs its independent field multiplies as ONE stacked multiply
+    and all linear recombination as ONE combo.
+    """
+
+    def __init__(self, F: FieldW, b3_block, gen_affine_mont, name):
+        self.F = F
+        # component-space action of multiplication by 3b (integer matrix)
+        self.b3_block = np.asarray(b3_block, dtype=np.int64)
+        self.name = name
+        self.gen = (gen_affine_mont[0], gen_affine_mont[1], F.ONE)
+        w = F.w
+        self._identity = np.stack(
+            [
+                np.zeros((w, NB), np.int32),
+                np.asarray(F.ONE, np.int32),
+                np.zeros((w, NB), np.int32),
+            ]
+        )
+
+        def kron(m):
+            return np.kron(
+                np.asarray(m, np.int64), np.eye(w, dtype=np.int64)
+            ).astype(np.int32)
+
+        # add stage-1 operand rows over [X, Y, Z]:
+        #   X; Y; Z; X+Y; Y+Z; X+Z
+        self._ADD_OPS = kron(
+            np.array(
+                [
+                    [1, 0, 0],
+                    [0, 1, 0],
+                    [0, 0, 1],
+                    [1, 1, 0],
+                    [0, 1, 1],
+                    [1, 0, 1],
+                ]
+            )
+        )
+        # add stage-1 recombination over [m0..m5] =
+        # [X1X2, Y1Y2, Z1Z2, (X1+Y1)(X2+Y2), (Y1+Z1)(Y2+Z2),
+        #  (X1+Z1)(X2+Z2)]:
+        #   t3  = m3 - m0 - m1          (X1Y2 + X2Y1)
+        #   t4  = m4 - m1 - m2          (Y1Z2 + Y2Z1)
+        #   t5  = m5 - m0 - m2          (X1Z2 + X2Z1)
+        #   T0  = 3 m0
+        #   Z3s = m1 + b3 m2
+        #   t1m = m1 - b3 m2
+        b3 = self.b3_block
+        Iw = np.eye(w, dtype=np.int64)
+
+        def rows(spec):
+            m = np.zeros((len(spec) * w, 6 * w), np.int64)
+            for r, row in enumerate(spec):
+                for idx, coeff, use_b3 in row:
+                    blk = coeff * (b3 if use_b3 else Iw)
+                    m[r * w : (r + 1) * w, idx * w : (idx + 1) * w] += blk
+            return m.astype(np.int32)
+
+        self._ADD_C1 = rows(
+            [
+                [(3, 1, False), (0, -1, False), (1, -1, False)],
+                [(4, 1, False), (1, -1, False), (2, -1, False)],
+                [(5, 1, False), (0, -1, False), (2, -1, False)],
+                [(0, 3, False)],
+                [(1, 1, False), (2, 1, True)],
+                [(1, 1, False), (2, -1, True)],
+            ]
+        )
+        # Y3c = b3 * t5 (own combo: folding b3 into t5's row would exceed
+        # the combo L1 budget)
+        self._B3_ROW = rows([[(0, 1, True)]])[:, : w]
+        # add final combo over [X3a, t2x, Y3a, t1z, t0t, Z3a]:
+        #   X3 = t2x - X3a;  Y3 = t1z + Y3a;  Z3 = Z3a + t0t
+        self._ADD_C3 = rows(
+            [
+                [(1, 1, False), (0, -1, False)],
+                [(3, 1, False), (2, 1, False)],
+                [(5, 1, False), (4, 1, False)],
+            ]
+        )
+        # dbl stage-1 recombination over [m0..m3] = [YY, YZ, ZZ, XY]:
+        #   Z8  = 8 m0;  t2v = b3 m2;  Y3s = m0 + b3 m2
+        m4 = np.zeros((3 * w, 4 * w), np.int64)
+        for r, row in enumerate(
+            [
+                [(0, 8, False)],
+                [(2, 1, True)],
+                [(0, 1, False), (2, 1, True)],
+            ]
+        ):
+            for idx, coeff, use_b3 in row:
+                blk = coeff * (b3 if use_b3 else Iw)
+                m4[r * w : (r + 1) * w, idx * w : (idx + 1) * w] += blk
+        self._DBL_C1 = m4.astype(np.int32)
+        # t0f = m0 - 3 t2v  over [m0, t2v]
+        self._DBL_C2 = kron(np.array([[1, -3]]))
+        # dbl final over [X3m, Z3f, Y3f, X3h]:
+        #   X3 = 2 X3h;  Y3 = X3m + Y3f;  Z3 = Z3f
+        self._DBL_C3 = kron(
+            np.array([[0, 0, 0, 2], [1, 0, 1, 0], [0, 1, 0, 0]])
+        )
+
+    # -- representation helpers ------------------------------------------
+
+    def identity_like(self, pt):
+        x = pt[0]
+        ident = jnp.asarray(self._identity)
+        return tuple(
+            jnp.broadcast_to(ident[i], x.shape) for i in range(3)
+        )
+
+    def generator_like(self, batch_shape):
+        def bc(c):
+            c = jnp.asarray(c)
+            return jnp.broadcast_to(c, tuple(batch_shape) + c.shape)
+
+        return tuple(bc(c) for c in self.gen)
+
+    def is_infinity(self, pt):
+        return self.F.is_zero(pt[2])
+
+    def from_affine(self, aff, valid):
+        """(x, y) affine bundles + validity mask -> projective points;
+        invalid lanes become the identity (0 : 1 : 0)."""
+        x, y = aff
+        F = self.F
+        one = jnp.broadcast_to(jnp.asarray(F.ONE), x.shape)
+        zero = jnp.zeros_like(x)
+        v = valid
+        return (
+            F.select(v, x, zero),
+            F.select(v, y, one),
+            F.select(v, one, zero),
+        )
+
+    def neg(self, pt):
+        return (pt[0], self.F.neg(pt[1]), pt[2])
+
+    def select(self, cond, a, b):
+        F = self.F
+        return tuple(F.select(cond, ca, cb) for ca, cb in zip(a, b))
+
+    def _combo(self, vals, matrix, n_out):
+        w = self.F.w
+        x = jnp.concatenate(vals, axis=-2)
+        y = fb.apply_combo(x, matrix)
+        return [y[..., w * i : w * (i + 1), :] for i in range(n_out)]
+
+    def _stack_mul(self, avals, bvals):
+        A = jnp.stack(avals, axis=-3)
+        B = jnp.stack(bvals, axis=-3)
+        out = self.F.mul(A, B)
+        return [out[..., i, :, :] for i in range(len(avals))]
+
+    # -- group ops -------------------------------------------------------
+
+    def add(self, p, q):
+        """RCB Algorithm 7 (a = 0): complete for all subgroup inputs —
+        p == q, p == -q, and identities all flow through the same code."""
+        w = self.F.w
+        a_ops = self._combo(list(p), self._ADD_OPS, 6)
+        b_ops = self._combo(list(q), self._ADD_OPS, 6)
+        m = self._stack_mul(a_ops, b_ops)
+        t3, t4, t5, T0, Z3s, t1m = self._combo(m, self._ADD_C1, 6)
+        (y3c,) = self._combo([t5], self._B3_ROW, 1)
+        prods = self._stack_mul(
+            [t4, t3, y3c, t1m, T0, Z3s],
+            [y3c, t1m, T0, Z3s, t3, t4],
+        )
+        x3, y3, z3 = self._combo(prods, self._ADD_C3, 3)
+        return (x3, y3, z3)
+
+    def double(self, pt):
+        """RCB Algorithm 9 (a = 0): complete doubling (identity -> identity)."""
+        X, Y, Z = pt
+        m0, m1, m2, m3 = self._stack_mul([Y, Y, Z, X], [Y, Z, Z, Y])
+        z8, t2v, y3s = self._combo([m0, m1, m2, m3], self._DBL_C1, 3)
+        (t0f,) = self._combo([m0, t2v], self._DBL_C2, 1)
+        prods = self._stack_mul([t2v, m1, t0f, t0f], [z8, z8, y3s, m3])
+        x3, y3, z3 = self._combo(prods, self._DBL_C3, 3)
+        return (x3, y3, z3)
+
+    def to_affine(self, pt):
+        """(x_affine, y_affine, is_infinity); the identity maps to (0, 0)."""
+        F = self.F
+        X, Y, Z = pt
+        zinv = F.inv(Z)
+        prods = self._stack_mul([X, Y], [zinv, zinv])
+        return (prods[0], prods[1], self.is_infinity(pt))
+
+    def eq(self, p, q):
+        """Cross-multiplied projective equality (identity == identity)."""
+        F = self.F
+        prods = self._stack_mul(
+            [p[0], q[0], p[1], q[1]], [q[2], p[2], q[2], p[2]]
+        )
+        ex = F.eq(prods[0], prods[1])
+        ey = F.eq(prods[2], prods[3])
+        inf_p, inf_q = self.is_infinity(p), self.is_infinity(q)
+        return (inf_p & inf_q) | ((~inf_p) & (~inf_q) & ex & ey)
+
+    # -- scalar multiplication -------------------------------------------
+
+    def mul_scalar_bits(self, pt, bits):
+        """bits: (..., nbits) int32 LSB-first; one lax.scan double-add
+        ladder. Complete formulas: no started-flag, no collision
+        precondition — any scalar width up to the subgroup order works."""
+        bits_seq = jnp.moveaxis(bits, -1, 0)
+        batch = pt[0].shape[:-2]
+
+        def step(carry, bit):
+            acc, addend = carry
+            added = self.add(acc, addend)
+            use = jnp.broadcast_to(bit == 1, batch)
+            acc = self.select(use, added, acc)
+            addend = self.double(addend)
+            return (acc, addend), None
+
+        init = (self.identity_like(pt), pt)
+        (acc, _), _ = jax.lax.scan(step, init, bits_seq)
+        return acc
+
+    def mul_scalar_static(self, pt, k: int):
+        if k < 0:
+            return self.mul_scalar_static(self.neg(pt), -k)
+        k %= R_SUBGROUP
+        if k == 0:
+            return self.identity_like(pt)
+        nbits = k.bit_length()
+        batch = pt[0].shape[:-2]
+        bits = jnp.broadcast_to(
+            jnp.asarray(
+                np.array([(k >> i) & 1 for i in range(nbits)], np.int32)
+            ),
+            batch + (nbits,),
+        )
+        return self.mul_scalar_bits(pt, bits)
+
+    # -- reductions ------------------------------------------------------
+
+    def sum_axis(self, pts, axis: int = 0):
+        """Log-depth tree fold of points along a batch axis."""
+        return _tree_fold_sum(self, pts, axis)
+
+    def masked_sum_axis(self, pts, mask, axis: int = 0):
+        ident = self.identity_like(pts)
+        masked = self.select(mask, pts, ident)
         return self.sum_axis(masked, axis=axis)
 
 
@@ -457,4 +727,24 @@ G2 = JacobianGroup(
         fp2m.const_mont(G2_Y[0], G2_Y[1]),
     ),
     "G2",
+)
+
+# Complete-formula projective groups (the MSM/ladder plane). b3 = 3*b as a
+# component-space matrix: G1 b = 4 -> 12; G2 b = 4 + 4u -> 12 + 12u, whose
+# action on (a + b u) is (12a - 12b) + (12a + 12b) u.
+PG1 = ProjectiveGroup(
+    F1,
+    [[12]],
+    (_mont1(G1_X), _mont1(G1_Y)),
+    "PG1",
+)
+
+PG2 = ProjectiveGroup(
+    F2,
+    [[12, -12], [12, 12]],
+    (
+        fp2m.const_mont(G2_X[0], G2_X[1]),
+        fp2m.const_mont(G2_Y[0], G2_Y[1]),
+    ),
+    "PG2",
 )

@@ -17,7 +17,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from lighthouse_tpu.crypto.constants import BLS_X, BLS_X_ABS
+from lighthouse_tpu.crypto.constants import BLS_X, BLS_X_ABS, P
+from lighthouse_tpu.crypto.constants import R as R_SUBGROUP
 from lighthouse_tpu.ops import curve, fieldb as fb, fp2, tower
 from lighthouse_tpu.ops.programs import LINE_MUL
 
@@ -227,21 +228,55 @@ def _pow_neg_x(f):
 
 
 def final_exponentiation(f):
-    """f^(3 (p^12-1)/r) — addition chain validated in ref_pairing."""
+    """f^(3 (p^12-1)/r) — addition chain validated in ref_pairing.
+    Double-frobenius sites use the cheap any-element p^2-Frobenius."""
     f = tower.fp12_mul(tower.fp12_conj(f), tower.fp12_inv(f))
-    f = tower.fp12_mul(tower.fp12_frobenius(tower.fp12_frobenius(f)), f)
+    f = tower.fp12_mul(tower.fp12_frobenius2(f), f)
     t0 = tower.fp12_mul(_pow_neg_x(f), tower.fp12_conj(f))
     t1 = tower.fp12_mul(_pow_neg_x(t0), tower.fp12_conj(t0))
     t2 = tower.fp12_mul(_pow_neg_x(t1), tower.fp12_frobenius(t1))
     t3 = tower.fp12_mul(
         _pow_neg_x(_pow_neg_x(t2)),
         tower.fp12_mul(
-            tower.fp12_frobenius(tower.fp12_frobenius(t2)),
+            tower.fp12_frobenius2(t2),
             tower.fp12_conj(t2),
         ),
     )
     f3 = tower.fp12_mul(tower.fp12_mul(f, f), f)
     return tower.fp12_mul(t3, f3)
+
+
+# ------------------------------------------------ final-exp equality test
+
+# Definitional oracle: f^((p^12-1)/r) == 1 by one square-and-multiply scan
+# over the full exponent. ~4300 sequential Fp12 ops, so it is far slower at
+# RUNTIME than the addition chain (which exploits |x|-sparsity and
+# unitarity) — but its graph is a single (sqr + cond mul) scan body. Used
+# by tests to validate the chain against the spec exponent.
+_FE_EXP = (P**12 - 1) // R_SUBGROUP
+assert (P**12 - 1) % R_SUBGROUP == 0
+_FE_BITS = np.array([int(b) for b in bin(_FE_EXP)[2:]], dtype=np.int32)
+
+
+def final_exp_is_one_scan(f):
+    """final_exponentiation(f) == 1, computed as f^((p^12-1)/r) == 1 by a
+    bit scan (MSB-first, leading bit consumed by acc0 = f)."""
+    bits = jnp.asarray(_FE_BITS[1:])
+
+    def step(acc, bit):
+        acc = tower.fp12_sqr(acc)
+        acc = jax.lax.cond(
+            bit == 1, lambda a: tower.fp12_mul(a, f), lambda a: a, acc
+        )
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, f, bits)
+    return tower.fp12_is_one(acc)
+
+
+def final_exp_is_one(f):
+    """final_exponentiation(f) == 1 via the addition chain (fast path)."""
+    return tower.fp12_is_one(final_exponentiation(f))
 
 
 # ------------------------------------------------------------- entry points
@@ -256,4 +291,4 @@ def multi_pairing_is_one(p_g1_affine, q_g2_affine, valid_mask=None):
     final exponentiation."""
     f = miller_loop(p_g1_affine, q_g2_affine, valid_mask=valid_mask)
     prod = tower.fp12_product_axis(f, axis=0)
-    return tower.fp12_is_one(final_exponentiation(prod))
+    return final_exp_is_one(prod)

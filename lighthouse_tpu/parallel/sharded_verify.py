@@ -72,18 +72,18 @@ def sharded_verify_signature_sets(mesh):
     def step(msgs, sigs, pubkeys, key_mask, rand_bits, set_mask):
         # ---- keys-axis: partial pubkey aggregation + reduction
         partial_pk = batch_verify.aggregate_pubkeys(pubkeys, key_mask)
-        agg_pk = _gather_fold_points(curve.G1, partial_pk, "keys")
+        agg_pk = _gather_fold_points(curve.PG1, partial_pk, "keys")
 
         # ---- per-set RLC scale + affinize
-        agg_pk_r = curve.G1.mul_scalar_bits(agg_pk, rand_bits)
-        pk_x, pk_y, pk_inf = curve.G1.to_affine(agg_pk_r)
+        agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
+        pk_x, pk_y, pk_inf = curve.PG1.to_affine(agg_pk_r)
 
         # ---- sets-axis: global RLC-combined signature
         local_sig = batch_verify.rlc_combined_signature(
             sigs, rand_bits, set_mask
         )
-        sig_acc = _gather_fold_points(curve.G2, local_sig, "sets")
-        s_x, s_y, s_inf = curve.G2.to_affine(
+        sig_acc = _gather_fold_points(curve.PG2, local_sig, "sets")
+        s_x, s_y, s_inf = curve.PG2.to_affine(
             jax.tree_util.tree_map(lambda t: t[None], sig_acc)
         )
 
@@ -109,7 +109,7 @@ def sharded_verify_signature_sets(mesh):
         f_sig = pairing.miller_loop(neg_g1, (s_x, s_y), valid_mask=~s_inf)
         prod = tower.fp12_mul(prod, tower.fp12_product_axis(f_sig, axis=0))
 
-        ok = tower.fp12_is_one(pairing.final_exponentiation(prod))
+        ok = pairing.final_exp_is_one(prod)
         return ok
 
     return jax.jit(_shard_map(step, mesh, in_specs, out_specs))

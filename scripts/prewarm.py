@@ -1,0 +1,27 @@
+"""Pre-warm the repo-local JAX compilation cache (.jax_cache) for the
+driver's multi-chip dryrun check (8-device virtual CPU mesh). The
+single-chip entry() check compiles for whatever backend the driver uses
+(usually the tunneled TPU) and is warmed separately by running bench.py.
+
+Run: python scripts/prewarm.py [n_devices ...]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__  # noqa: E402  (enables the repo-local compile cache)
+
+
+def main():
+    counts = [int(a) for a in sys.argv[1:]] or [8]
+    for n in counts:
+        t0 = time.time()
+        __graft_entry__.dryrun_multichip(n)
+        print(f"dryrun_multichip({n}) ok in {time.time() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,9 +13,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Persistent compilation cache: the pairing/batch-verify graphs are large;
 # compile once per machine, reuse across every test session.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lighthouse_tpu_jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+from lighthouse_tpu.backend import (  # noqa: E402
+    enable_compile_cache,
+    force_cpu_backend,
+)
 
-from lighthouse_tpu.backend import force_cpu_backend  # noqa: E402
-
+enable_compile_cache()
 force_cpu_backend(8)

@@ -94,3 +94,27 @@ def test_multi_pairing_mask_skips_invalid_pairs():
         mask,
     )
     assert bool(np.asarray(ok))
+
+
+def test_final_exp_chain_matches_spec_exponent_scan():
+    """Validate the addition-chain final-exp predicate against the
+    definitional oracle f^((p^12-1)/r) == 1 (one square-multiply scan) on
+    both a true pairing identity and a random non-identity element."""
+    sk = rng.randrange(2, C.R)
+    h = RG2.mul_scalar(RG2.generator, 4242)
+    pk = RG1.to_affine(RG1.mul_scalar(RG1.generator, sk))
+    sig = RG2.to_affine(RG2.mul_scalar(h, sk))
+    neg_g1 = RG1.to_affine(RG1.neg(RG1.generator))
+    f = pairing.miller_loop(
+        pack_g1_affine([pk, neg_g1]),
+        pack_g2_affine([RG2.to_affine(h), sig]),
+    )
+    prod = tower.fp12_product_axis(f, axis=0)
+    assert bool(np.asarray(jax.jit(pairing.final_exp_is_one)(prod)))
+    assert bool(np.asarray(jax.jit(pairing.final_exp_is_one_scan)(prod)))
+
+    # a random element (a Miller value before the product collapses it)
+    lone = f[0]
+    chain = bool(np.asarray(jax.jit(pairing.final_exp_is_one)(lone)))
+    scan = bool(np.asarray(jax.jit(pairing.final_exp_is_one_scan)(lone)))
+    assert chain == scan == False  # noqa: E712
