@@ -28,12 +28,38 @@ enable_compile_cache()
 TARGET_SIGS_PER_SEC = 150_000.0  # north star: 30k sigs in 200 ms on one chip
 
 
+def _tpu_probe_ok(timeout_s: float = 90.0) -> bool:
+    """Probe the tunneled TPU backend in a SUBPROCESS with a hard timeout.
+
+    The axon tunnel has two failure modes observed across rounds: fast
+    init errors (RuntimeError) and outright hangs where jax.devices()
+    never returns. Probing in-process would hang the bench with it, so a
+    throwaway subprocess takes the risk instead."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def _ensure_backend():
     """Return an initialized jax with a usable backend, flipping to CPU if
-    the TPU tunnel is down. Must not query devices before a possible flip —
-    XLA_FLAGS is parsed once at first client creation."""
+    the TPU tunnel is down or hung. Must not query devices before a
+    possible flip — XLA_FLAGS is parsed once at first client creation."""
     import jax
 
+    if not _tpu_probe_ok():
+        print("bench: TPU backend unavailable or hung; using CPU", file=sys.stderr)
+        from lighthouse_tpu.backend import force_cpu_backend
+
+        force_cpu_backend(1)
+        return jax, "cpu"
     try:
         jax.devices()
         return jax, jax.default_backend()

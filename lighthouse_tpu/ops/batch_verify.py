@@ -46,17 +46,19 @@ def _expand0(pt):
 
 
 def aggregate_pubkeys(pubkeys_g1_aff, key_mask):
-    """(S, K) affine G1 + mask -> (S,) projective aggregate per set (masked
-    log-depth tree fold over the key axis, complete-formula plane)."""
+    """(S, K) affine G1 + mask -> (S,) projective aggregate per set
+    (log-depth tree fold over the key axis, complete-formula plane).
+    from_affine already maps masked-out lanes to the identity."""
     pts = curve.PG1.from_affine(pubkeys_g1_aff, key_mask)
-    return curve.PG1.masked_sum_axis(pts, key_mask, axis=1)
+    return curve.PG1.sum_axis(pts, axis=1)
 
 
 def rlc_combined_signature(sigs_g2_aff, rand_bits, set_mask):
-    """sum_i r_i * sig_i -> single projective G2 point."""
+    """sum_i r_i * sig_i -> single projective G2 point. Masked-out lanes
+    enter as the identity and stay the identity through the ladder."""
     sig_proj = curve.PG2.from_affine(sigs_g2_aff, set_mask)
     sig_r = curve.PG2.mul_scalar_bits(sig_proj, rand_bits)
-    return curve.PG2.masked_sum_axis(sig_r, set_mask, axis=0)
+    return curve.PG2.sum_axis(sig_r, axis=0)
 
 
 def miller_inputs(

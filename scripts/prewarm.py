@@ -17,10 +17,19 @@ import __graft_entry__  # noqa: E402  (enables the repo-local compile cache)
 
 def main():
     counts = [int(a) for a in sys.argv[1:]] or [8]
-    for n in counts:
+    if len(counts) == 1:
         t0 = time.time()
-        __graft_entry__.dryrun_multichip(n)
-        print(f"dryrun_multichip({n}) ok in {time.time() - t0:.1f}s")
+        __graft_entry__.dryrun_multichip(counts[0])
+        print(f"dryrun_multichip({counts[0]}) ok in {time.time() - t0:.1f}s")
+        return
+    # XLA_FLAGS (device count) is parsed once per process — run each
+    # count in its own subprocess.
+    import subprocess
+
+    for n in counts:
+        subprocess.run(
+            [sys.executable, os.path.abspath(__file__), str(n)], check=True
+        )
 
 
 if __name__ == "__main__":
