@@ -49,6 +49,36 @@ class BlockError(Exception):
     pass
 
 
+class _EngineAdapter:
+    """Bridges per_block_processing's execution-engine hook to an
+    ExecutionLayer, recording the verdict so the import path can mark the
+    fork-choice node VALID vs OPTIMISTIC (block_verification.rs payload
+    verification handle + execution_payload.rs notify_new_payload)."""
+
+    def __init__(self, execution_layer):
+        self.el = execution_layer
+        self.last_status = None
+
+    def notify_new_payload(self, payload) -> bool:
+        if self.el is None:
+            # no execution layer attached: trusted/always-valid mode
+            self.last_status = "VALID"
+            return True
+        from lighthouse_tpu.execution_layer import EngineApiError
+
+        try:
+            status = self.el.notify_new_payload(payload)
+        except EngineApiError:
+            # unreachable engine == no verdict: import optimistically
+            # (the reference treats an EL outage as SYNCING)
+            self.last_status = "SYNCING"
+            return True
+        self.last_status = status.status
+        # optimistic verdicts (SYNCING/ACCEPTED) still import the block;
+        # only hard INVALID rejects it here
+        return not self.el.is_invalid(status)
+
+
 class BeaconChain:
     def __init__(
         self,
@@ -57,8 +87,10 @@ class BeaconChain:
         kv=None,
         backend: str = "ref",
         slot_clock=None,
+        execution_layer=None,
     ):
         self.spec = spec
+        self.execution_layer = execution_layer
         self.t = types_for(spec)
         self.backend = backend
         self.store = HotColdDB(kv or MemoryStore(), spec)
@@ -213,6 +245,7 @@ class BeaconChain:
         state = parent_state.copy()
         t0 = time.perf_counter()
         state = process_slots(state, block.slot, spec)
+        engine = _EngineAdapter(self.execution_layer)
         try:
             per_block_processing(
                 state,
@@ -221,6 +254,7 @@ class BeaconChain:
                 BlockSignatureStrategy.VERIFY_BULK,
                 self.pubkey_cache,
                 backend=self.backend,
+                execution_engine=engine,
             )
         except BlockProcessingError as e:
             raise BlockError(str(e)) from e
@@ -247,8 +281,15 @@ class BeaconChain:
             justified = (0, self.genesis_root)
         if finalized[0] == 0:
             finalized = (0, self.genesis_root)
+        exec_status, exec_hash = self._execution_verdict(block, engine)
         self.fork_choice.on_block(
-            block.slot, block_root, parent_root, justified, finalized
+            block.slot,
+            block_root,
+            parent_root,
+            justified,
+            finalized,
+            execution_status=exec_status,
+            execution_block_hash=exec_hash,
         )
 
         # register the block's attestations with fork choice + monitor
@@ -372,12 +413,14 @@ class BeaconChain:
         if parent_state is None:
             raise BlockError("unknown parent")
         state = process_slots(parent_state.copy(), block.slot, spec)
+        engine = _EngineAdapter(self.execution_layer)
         per_block_processing(
             state,
             signed_block,
             spec,
             BlockSignatureStrategy.NO_VERIFICATION,
             self.pubkey_cache,
+            execution_engine=engine,
         )
         if bytes(block.state_root) != type(state).hash_tree_root(state):
             raise BlockError("state root mismatch")
@@ -386,6 +429,7 @@ class BeaconChain:
         self.store.set_canonical_block_root(block.slot, block_root)
         if self.fork_choice.current_slot < block.slot:
             self.fork_choice.set_slot(block.slot)
+        exec_status, exec_hash = self._execution_verdict(block, engine)
         self.fork_choice.on_block(
             block.slot,
             block_root,
@@ -402,10 +446,43 @@ class BeaconChain:
                 if state.finalized_checkpoint.epoch
                 else self.genesis_root,
             ),
+            execution_status=exec_status,
+            execution_block_hash=exec_hash,
         )
         self._cache_snapshot(block_root, state)
         self.metrics["blocks_imported"] += 1
         self.recompute_head()
+
+    def _execution_verdict(self, block, engine):
+        """Map the engine verdict recorded during block processing onto a
+        proto-array execution status (+ payload hash). Blocks without a
+        payload are IRRELEVANT."""
+        from lighthouse_tpu.fork_choice.proto_array import ExecutionStatus
+
+        body = block.body
+        payload = getattr(body, "execution_payload", None)
+        if payload is None or engine.last_status is None:
+            return ExecutionStatus.IRRELEVANT, None
+        exec_hash = bytes(payload.block_hash)
+        if engine.last_status == "VALID":
+            return ExecutionStatus.VALID, exec_hash
+        return ExecutionStatus.OPTIMISTIC, exec_hash
+
+    def is_optimistic_head(self) -> bool:
+        """True if the current head's payload chain is engine-unverified
+        (the optimistic-sync `execution_optimistic` flag of the REST API)."""
+        return self.fork_choice.is_optimistic(self.head_root)
+
+    def on_payload_verdict(self, block_root: bytes, status):
+        """Late engine verdict for an optimistically imported block
+        (beacon_chain.rs process_invalid_execution_payload analog)."""
+        if status.status == "VALID":
+            self.fork_choice.on_valid_execution_payload(block_root)
+        elif status.status in ("INVALID", "INVALID_BLOCK_HASH"):
+            self.fork_choice.on_invalid_execution_payload(
+                block_root, status.latest_valid_hash
+            )
+            self.recompute_head()
 
     def _cache_snapshot(self, root: bytes, state):
         self._snapshots[root] = state

@@ -1,0 +1,35 @@
+"""Execution layer: engine-API bridge to the execution client.
+
+Role of beacon_node/execution_layer (src/lib.rs, engine_api/, engines.rs):
+the beacon node's JSON-RPC channel to an external execution client for
+optimistic-sync payload verification (`notify_new_payload`), fork-choice
+updates (`notify_forkchoice_updated`), and payload production
+(`get_payload`), plus the multi-engine fallback/retry state machine and
+the in-process mock used by the test harness.
+"""
+
+from lighthouse_tpu.execution_layer.engine_api import (
+    EngineApiError,
+    EngineHttpClient,
+    ForkchoiceState,
+    PayloadAttributes,
+    PayloadStatus,
+    PayloadStatusV1,
+    jwt_encode,
+)
+from lighthouse_tpu.execution_layer.engines import Engine, EngineState, Engines
+from lighthouse_tpu.execution_layer.execution_layer import ExecutionLayer
+
+__all__ = [
+    "EngineApiError",
+    "EngineHttpClient",
+    "ForkchoiceState",
+    "PayloadAttributes",
+    "PayloadStatus",
+    "PayloadStatusV1",
+    "jwt_encode",
+    "Engine",
+    "EngineState",
+    "Engines",
+    "ExecutionLayer",
+]

@@ -1,0 +1,317 @@
+"""Engine JSON-RPC API: typed requests/responses + JWT-authed HTTP client.
+
+Role of beacon_node/execution_layer/src/engine_api/{mod.rs,http.rs,auth.rs,
+json_structures.rs}: engine_newPayloadV1 / engine_forkchoiceUpdatedV1 /
+engine_getPayloadV1 plus the eth_* block queries the beacon node needs,
+over HTTP JSON-RPC with an HS256 JWT per request (EIP-3675 engine auth).
+stdlib-only: http.client + hmac.
+"""
+
+import base64
+import hashlib
+import hmac
+import http.client
+import json
+import time
+from dataclasses import dataclass, field
+from urllib.parse import urlparse
+
+ENGINE_NEW_PAYLOAD_V1 = "engine_newPayloadV1"
+ENGINE_FORKCHOICE_UPDATED_V1 = "engine_forkchoiceUpdatedV1"
+ENGINE_GET_PAYLOAD_V1 = "engine_getPayloadV1"
+ENGINE_EXCHANGE_TRANSITION_CONFIGURATION_V1 = (
+    "engine_exchangeTransitionConfigurationV1"
+)
+ETH_GET_BLOCK_BY_HASH = "eth_getBlockByHash"
+ETH_GET_BLOCK_BY_NUMBER = "eth_getBlockByNumber"
+ETH_SYNCING = "eth_syncing"
+
+JWT_EXP_SLACK_SECS = 60  # reference: auth.rs iat tolerance
+
+
+class EngineApiError(Exception):
+    """JSON-RPC error, transport failure, or malformed response."""
+
+    def __init__(self, message, code=None):
+        super().__init__(message)
+        self.code = code
+
+
+class PayloadStatus:
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+    ACCEPTED = "ACCEPTED"
+    INVALID_BLOCK_HASH = "INVALID_BLOCK_HASH"
+
+
+@dataclass
+class PayloadStatusV1:
+    status: str
+    latest_valid_hash: bytes | None = None
+    validation_error: str | None = None
+
+    @classmethod
+    def from_json(cls, obj):
+        lvh = obj.get("latestValidHash")
+        return cls(
+            status=obj["status"],
+            latest_valid_hash=bytes.fromhex(lvh[2:]) if lvh else None,
+            validation_error=obj.get("validationError"),
+        )
+
+    def to_json(self):
+        return {
+            "status": self.status,
+            "latestValidHash": (
+                "0x" + self.latest_valid_hash.hex()
+                if self.latest_valid_hash is not None
+                else None
+            ),
+            "validationError": self.validation_error,
+        }
+
+
+@dataclass
+class ForkchoiceState:
+    head_block_hash: bytes
+    safe_block_hash: bytes
+    finalized_block_hash: bytes
+
+    def to_json(self):
+        return {
+            "headBlockHash": "0x" + self.head_block_hash.hex(),
+            "safeBlockHash": "0x" + self.safe_block_hash.hex(),
+            "finalizedBlockHash": "0x" + self.finalized_block_hash.hex(),
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            bytes.fromhex(obj["headBlockHash"][2:]),
+            bytes.fromhex(obj["safeBlockHash"][2:]),
+            bytes.fromhex(obj["finalizedBlockHash"][2:]),
+        )
+
+
+@dataclass
+class PayloadAttributes:
+    timestamp: int
+    prev_randao: bytes
+    suggested_fee_recipient: bytes  # 20 bytes
+
+    def to_json(self):
+        return {
+            "timestamp": hex(self.timestamp),
+            "prevRandao": "0x" + self.prev_randao.hex(),
+            "suggestedFeeRecipient": "0x" + self.suggested_fee_recipient.hex(),
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            int(obj["timestamp"], 16),
+            bytes.fromhex(obj["prevRandao"][2:]),
+            bytes.fromhex(obj["suggestedFeeRecipient"][2:]),
+        )
+
+
+def payload_to_json(p):
+    """ExecutionPayload container -> engine-API JSON (camelCase, 0x-hex)."""
+    return {
+        "parentHash": "0x" + p.parent_hash.hex(),
+        "feeRecipient": "0x" + p.fee_recipient.hex(),
+        "stateRoot": "0x" + p.state_root.hex(),
+        "receiptsRoot": "0x" + p.receipts_root.hex(),
+        "logsBloom": "0x" + p.logs_bloom.hex(),
+        "prevRandao": "0x" + p.prev_randao.hex(),
+        "blockNumber": hex(p.block_number),
+        "gasLimit": hex(p.gas_limit),
+        "gasUsed": hex(p.gas_used),
+        "timestamp": hex(p.timestamp),
+        "extraData": "0x" + p.extra_data.hex(),
+        "baseFeePerGas": hex(p.base_fee_per_gas),
+        "blockHash": "0x" + p.block_hash.hex(),
+        "transactions": ["0x" + t.hex() for t in p.transactions],
+    }
+
+
+@dataclass
+class JsonExecutionPayload:
+    """Engine-API-side payload representation (consensus containers live in
+    lighthouse_tpu.types; this is the wire shape)."""
+
+    parent_hash: bytes = b"\x00" * 32
+    fee_recipient: bytes = b"\x00" * 20
+    state_root: bytes = b"\x00" * 32
+    receipts_root: bytes = b"\x00" * 32
+    logs_bloom: bytes = b"\x00" * 256
+    prev_randao: bytes = b"\x00" * 32
+    block_number: int = 0
+    gas_limit: int = 0
+    gas_used: int = 0
+    timestamp: int = 0
+    extra_data: bytes = b""
+    base_fee_per_gas: int = 0
+    block_hash: bytes = b"\x00" * 32
+    transactions: list = field(default_factory=list)
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            parent_hash=bytes.fromhex(obj["parentHash"][2:]),
+            fee_recipient=bytes.fromhex(obj["feeRecipient"][2:]),
+            state_root=bytes.fromhex(obj["stateRoot"][2:]),
+            receipts_root=bytes.fromhex(obj["receiptsRoot"][2:]),
+            logs_bloom=bytes.fromhex(obj["logsBloom"][2:]),
+            prev_randao=bytes.fromhex(obj["prevRandao"][2:]),
+            block_number=int(obj["blockNumber"], 16),
+            gas_limit=int(obj["gasLimit"], 16),
+            gas_used=int(obj["gasUsed"], 16),
+            timestamp=int(obj["timestamp"], 16),
+            extra_data=bytes.fromhex(obj["extraData"][2:]),
+            base_fee_per_gas=int(obj["baseFeePerGas"], 16),
+            block_hash=bytes.fromhex(obj["blockHash"][2:]),
+            transactions=[
+                bytes.fromhex(t[2:]) for t in obj.get("transactions", [])
+            ],
+        )
+
+    def to_json(self):
+        return payload_to_json(self)
+
+
+# ------------------------------------------------------------------- JWT
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def jwt_encode(secret: bytes, iat: int | None = None) -> str:
+    """HS256 JWT with an `iat` claim — the engine-API auth token
+    (engine_api/auth.rs; secret is the 32-byte hex jwtsecret file)."""
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    claims = _b64url(
+        json.dumps({"iat": int(iat if iat is not None else time.time())}).encode()
+    )
+    signing_input = f"{header}.{claims}".encode()
+    sig = hmac.new(secret, signing_input, hashlib.sha256).digest()
+    return f"{header}.{claims}.{_b64url(sig)}"
+
+
+def jwt_verify(secret: bytes, token: str, now: int | None = None) -> bool:
+    try:
+        header, claims, sig = token.split(".")
+        signing_input = f"{header}.{claims}".encode()
+        expect = _b64url(
+            hmac.new(secret, signing_input, hashlib.sha256).digest()
+        )
+        if not hmac.compare_digest(expect, sig):
+            return False
+        pad = "=" * (-len(claims) % 4)
+        body = json.loads(base64.urlsafe_b64decode(claims + pad))
+        iat = int(body["iat"])
+        now = int(now if now is not None else time.time())
+        return abs(now - iat) <= JWT_EXP_SLACK_SECS
+    except Exception:
+        return False
+
+
+# ------------------------------------------------------------------ client
+
+
+class EngineHttpClient:
+    """Minimal JSON-RPC-over-HTTP engine client with per-request JWT."""
+
+    def __init__(self, url: str, jwt_secret: bytes, timeout: float = 8.0):
+        self.url = url
+        self.jwt_secret = jwt_secret
+        self.timeout = timeout
+        self._id = 0
+
+    def _rpc(self, method: str, params):
+        self._id += 1
+        body = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": self._id,
+                "method": method,
+                "params": params,
+            }
+        ).encode()
+        u = urlparse(self.url)
+        conn = http.client.HTTPConnection(
+            u.hostname, u.port or 8551, timeout=self.timeout
+        )
+        try:
+            conn.request(
+                "POST",
+                u.path or "/",
+                body,
+                {
+                    "Content-Type": "application/json",
+                    "Authorization": "Bearer " + jwt_encode(self.jwt_secret),
+                },
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise EngineApiError(
+                    f"http {resp.status}: {data[:200]!r}", code=resp.status
+                )
+        except (OSError, http.client.HTTPException) as e:
+            raise EngineApiError(f"transport: {e}") from e
+        finally:
+            conn.close()
+        try:
+            obj = json.loads(data)
+        except ValueError as e:
+            raise EngineApiError(f"bad json: {e}") from e
+        if obj.get("error"):
+            err = obj["error"]
+            raise EngineApiError(
+                err.get("message", "rpc error"), code=err.get("code")
+            )
+        return obj.get("result")
+
+    # -- engine methods --------------------------------------------------
+
+    def new_payload(self, payload) -> PayloadStatusV1:
+        res = self._rpc(ENGINE_NEW_PAYLOAD_V1, [payload_to_json(payload)])
+        return PayloadStatusV1.from_json(res)
+
+    def forkchoice_updated(
+        self,
+        forkchoice_state: ForkchoiceState,
+        payload_attributes: PayloadAttributes | None = None,
+    ):
+        res = self._rpc(
+            ENGINE_FORKCHOICE_UPDATED_V1,
+            [
+                forkchoice_state.to_json(),
+                payload_attributes.to_json() if payload_attributes else None,
+            ],
+        )
+        status = PayloadStatusV1.from_json(res["payloadStatus"])
+        payload_id = res.get("payloadId")
+        return status, (
+            bytes.fromhex(payload_id[2:]) if payload_id else None
+        )
+
+    def get_payload(self, payload_id: bytes) -> JsonExecutionPayload:
+        res = self._rpc(
+            ENGINE_GET_PAYLOAD_V1, ["0x" + payload_id.hex()]
+        )
+        return JsonExecutionPayload.from_json(res)
+
+    def get_block_by_hash(self, block_hash: bytes):
+        return self._rpc(
+            ETH_GET_BLOCK_BY_HASH, ["0x" + block_hash.hex(), False]
+        )
+
+    def get_block_by_number(self, tag="latest"):
+        return self._rpc(ETH_GET_BLOCK_BY_NUMBER, [tag, False])
+
+    def syncing(self):
+        return self._rpc(ETH_SYNCING, [])

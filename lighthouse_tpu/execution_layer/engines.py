@@ -1,0 +1,97 @@
+"""Engine fallback/retry state machine.
+
+Role of beacon_node/execution_layer/src/engines.rs: track each configured
+engine's health (Synced / Offline / Syncing / AuthFailed), try the primary
+first and fall back in order, re-probing offline engines on demand, and
+replay the latest fork-choice state to an engine that just came back.
+"""
+
+import logging
+from dataclasses import dataclass
+from enum import Enum
+
+from lighthouse_tpu.execution_layer.engine_api import EngineApiError
+
+log = logging.getLogger("execution_layer")
+
+
+class EngineState(Enum):
+    SYNCED = "synced"
+    OFFLINE = "offline"
+    SYNCING = "syncing"
+    AUTH_FAILED = "auth_failed"
+
+
+@dataclass
+class Engine:
+    client: object  # EngineHttpClient-compatible
+    state: EngineState = EngineState.OFFLINE
+
+    def upcheck(self):
+        """Probe the engine; classify its state (engines.rs upcheck)."""
+        try:
+            syncing = self.client.syncing()
+            self.state = (
+                EngineState.SYNCING if syncing else EngineState.SYNCED
+            )
+        except EngineApiError as e:
+            if e.code == 401:
+                self.state = EngineState.AUTH_FAILED
+            else:
+                self.state = EngineState.OFFLINE
+        return self.state
+
+
+class Engines:
+    """Ordered engine set with first-success fallback semantics."""
+
+    def __init__(self, engines):
+        self.engines = list(engines)
+        self.latest_forkchoice_state = None
+
+    def set_latest_forkchoice_state(self, state):
+        self.latest_forkchoice_state = state
+
+    def _usable(self):
+        for e in self.engines:
+            if e.state in (EngineState.SYNCED, EngineState.SYNCING):
+                yield e
+
+    def upcheck_not_synced(self):
+        for e in self.engines:
+            if e.state != EngineState.SYNCED:
+                was = e.state
+                now = e.upcheck()
+                # an engine that just came back must learn our head before
+                # serving forkchoice-dependent calls (engines.rs reestablishes
+                # the fork-choice state on transition to Synced)
+                if (
+                    was != EngineState.SYNCED
+                    and now == EngineState.SYNCED
+                    and self.latest_forkchoice_state is not None
+                ):
+                    try:
+                        e.client.forkchoice_updated(
+                            self.latest_forkchoice_state, None
+                        )
+                    except EngineApiError:
+                        e.state = EngineState.OFFLINE
+
+    def first_success(self, op):
+        """Run `op(client)` on the first healthy engine; on TRANSPORT
+        failure mark it offline and fall through to the next. Application
+        JSON-RPC errors (negative codes in a 200 response) propagate
+        without demoting the engine — the request is bad, not the engine.
+        Raises the last error if all fail."""
+        self.upcheck_not_synced()
+        last_err = None
+        for e in self._usable():
+            try:
+                return op(e.client)
+            except EngineApiError as err:
+                if isinstance(err.code, int) and err.code < 0:
+                    raise
+                log.warning("engine call failed, trying next: %s", err)
+                e.state = EngineState.OFFLINE
+                last_err = err
+        raise last_err if last_err else EngineApiError("no usable engine")

@@ -1,0 +1,131 @@
+"""ExecutionLayer service: the beacon node's payload-verification and
+payload-production entry points.
+
+Role of beacon_node/execution_layer/src/lib.rs: `notify_new_payload` (the
+optimistic-sync verdict for imported blocks), `notify_forkchoice_updated`
+(head/finalized propagation + payload-build kickoff), `get_payload`
+(block production), payload-id caching so a proposal can reuse the build
+started by the preceding fork-choice update.
+"""
+
+from dataclasses import dataclass
+
+from lighthouse_tpu.execution_layer.engine_api import (
+    EngineApiError,
+    ForkchoiceState,
+    PayloadAttributes,
+    PayloadStatus,
+)
+from lighthouse_tpu.execution_layer.engines import Engine, Engines
+
+
+@dataclass(frozen=True)
+class _PayloadIdCacheKey:
+    head_block_hash: bytes
+    timestamp: int
+    prev_randao: bytes
+    suggested_fee_recipient: bytes
+
+
+class ExecutionLayer:
+    def __init__(self, clients, default_fee_recipient: bytes = b"\x00" * 20):
+        self.engines = Engines([Engine(c) for c in clients])
+        self.default_fee_recipient = default_fee_recipient
+        self._payload_id_cache = {}
+
+    # -- payload verification (import path) ------------------------------
+
+    def notify_new_payload(self, payload):
+        """Submit an execution payload for verification; returns a
+        PayloadStatusV1. SYNCING/ACCEPTED are the optimistic verdicts —
+        the caller imports the block optimistically and the fork choice
+        tracks it as unverified (proto_array execution-status tracking)."""
+        return self.engines.first_success(
+            lambda c: c.new_payload(payload)
+        )
+
+    # -- fork choice propagation -----------------------------------------
+
+    def notify_forkchoice_updated(
+        self,
+        head_block_hash: bytes,
+        finalized_block_hash: bytes,
+        payload_attributes: PayloadAttributes | None = None,
+        safe_block_hash: bytes | None = None,
+    ):
+        fcs = ForkchoiceState(
+            head_block_hash=head_block_hash,
+            safe_block_hash=(
+                safe_block_hash
+                if safe_block_hash is not None
+                else finalized_block_hash
+            ),
+            finalized_block_hash=finalized_block_hash,
+        )
+        self.engines.set_latest_forkchoice_state(fcs)
+        status, payload_id = self.engines.first_success(
+            lambda c: c.forkchoice_updated(fcs, payload_attributes)
+        )
+        if payload_id is not None and payload_attributes is not None:
+            key = _PayloadIdCacheKey(
+                head_block_hash,
+                payload_attributes.timestamp,
+                payload_attributes.prev_randao,
+                payload_attributes.suggested_fee_recipient,
+            )
+            self._payload_id_cache[key] = payload_id
+        return status, payload_id
+
+    # -- payload production ----------------------------------------------
+
+    def get_payload(
+        self,
+        parent_hash: bytes,
+        timestamp: int,
+        prev_randao: bytes,
+        finalized_block_hash: bytes = b"\x00" * 32,
+        suggested_fee_recipient: bytes | None = None,
+    ):
+        """Produce an execution payload for a proposal on `parent_hash`.
+        Reuses a cached payload build from the preceding forkchoice_updated
+        when the attributes match (lib.rs payload-id cache); otherwise
+        issues a fresh forkchoice_updated with attributes."""
+        fee = suggested_fee_recipient or self.default_fee_recipient
+        key = _PayloadIdCacheKey(parent_hash, timestamp, prev_randao, fee)
+        payload_id = self._payload_id_cache.pop(key, None)
+        if payload_id is None:
+            attrs = PayloadAttributes(
+                timestamp=timestamp,
+                prev_randao=prev_randao,
+                suggested_fee_recipient=fee,
+            )
+            status, payload_id = self.notify_forkchoice_updated(
+                parent_hash, finalized_block_hash, attrs
+            )
+            if payload_id is None:
+                raise EngineApiError(
+                    f"no payload id (engine status {status.status})"
+                )
+        return self.engines.first_success(
+            lambda c: c.get_payload(payload_id)
+        )
+
+    # -- status helpers ---------------------------------------------------
+
+    @staticmethod
+    def is_valid(status) -> bool:
+        return status.status == PayloadStatus.VALID
+
+    @staticmethod
+    def is_optimistic(status) -> bool:
+        return status.status in (
+            PayloadStatus.SYNCING,
+            PayloadStatus.ACCEPTED,
+        )
+
+    @staticmethod
+    def is_invalid(status) -> bool:
+        return status.status in (
+            PayloadStatus.INVALID,
+            PayloadStatus.INVALID_BLOCK_HASH,
+        )

@@ -1,0 +1,236 @@
+"""Mock execution layer for in-process integration tests.
+
+Role of beacon_node/execution_layer/src/test_utils/{mod.rs,
+execution_block_generator.rs,handle_rpc.rs}: an in-process HTTP server
+speaking the engine API (with JWT verification) over a deterministic fake
+execution chain, so the whole beacon node can run without a real
+execution client.
+"""
+
+import hashlib
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from lighthouse_tpu.execution_layer.engine_api import (
+    EngineHttpClient,
+    ForkchoiceState,
+    JsonExecutionPayload,
+    PayloadAttributes,
+    PayloadStatus,
+    PayloadStatusV1,
+    jwt_verify,
+)
+
+DEFAULT_TERMINAL_BLOCK = 0
+
+
+def _block_hash(parent_hash: bytes, number: int, extra: bytes = b"") -> bytes:
+    return hashlib.sha256(
+        b"exec-block" + parent_hash + number.to_bytes(8, "little") + extra
+    ).digest()
+
+
+class ExecutionBlockGenerator:
+    """Deterministic fake execution chain (execution_block_generator.rs):
+    tracks blocks by hash, builds payloads on request, applies fork-choice
+    updates, and can be told to serve SYNCING or INVALID verdicts to
+    exercise the optimistic-sync paths."""
+
+    def __init__(self):
+        genesis_hash = _block_hash(b"\x00" * 32, 0)
+        self.genesis_hash = genesis_hash
+        self.blocks = {
+            genesis_hash: JsonExecutionPayload(
+                block_number=0, block_hash=genesis_hash
+            )
+        }
+        self.head_hash = genesis_hash
+        self.finalized_hash = genesis_hash
+        self.pending_payloads = {}
+        self._next_payload_id = 1
+        # test knobs
+        self.static_new_payload_response = None  # PayloadStatusV1 | None
+        self.invalid_hashes = set()
+
+    # -- chain -----------------------------------------------------------
+
+    def block_by_hash(self, h: bytes):
+        return self.blocks.get(h)
+
+    def latest_block(self):
+        return self.blocks[self.head_hash]
+
+    def new_payload(self, payload: JsonExecutionPayload) -> PayloadStatusV1:
+        if self.static_new_payload_response is not None:
+            return self.static_new_payload_response
+        if payload.block_hash in self.invalid_hashes:
+            return PayloadStatusV1(
+                PayloadStatus.INVALID,
+                latest_valid_hash=self.head_hash,
+                validation_error="block marked invalid by test",
+            )
+        parent = self.blocks.get(payload.parent_hash)
+        if parent is None:
+            return PayloadStatusV1(PayloadStatus.SYNCING)
+        expect = _block_hash(
+            payload.parent_hash, payload.block_number, payload.prev_randao
+        )
+        if expect != payload.block_hash:
+            return PayloadStatusV1(
+                PayloadStatus.INVALID_BLOCK_HASH,
+                validation_error="hash mismatch",
+            )
+        self.blocks[payload.block_hash] = payload
+        return PayloadStatusV1(
+            PayloadStatus.VALID, latest_valid_hash=payload.block_hash
+        )
+
+    def forkchoice_updated(
+        self, fcs: ForkchoiceState, attrs: PayloadAttributes | None
+    ):
+        if fcs.head_block_hash not in self.blocks:
+            return PayloadStatusV1(PayloadStatus.SYNCING), None
+        self.head_hash = fcs.head_block_hash
+        if fcs.finalized_block_hash != b"\x00" * 32:
+            self.finalized_hash = fcs.finalized_block_hash
+        payload_id = None
+        if attrs is not None:
+            parent = self.blocks[fcs.head_block_hash]
+            number = parent.block_number + 1
+            payload = JsonExecutionPayload(
+                parent_hash=fcs.head_block_hash,
+                prev_randao=attrs.prev_randao,
+                block_number=number,
+                gas_limit=30_000_000,
+                timestamp=attrs.timestamp,
+                fee_recipient=attrs.suggested_fee_recipient,
+                base_fee_per_gas=7,
+                block_hash=_block_hash(
+                    fcs.head_block_hash, number, attrs.prev_randao
+                ),
+            )
+            payload_id = self._next_payload_id.to_bytes(8, "big")
+            self._next_payload_id += 1
+            self.pending_payloads[payload_id] = payload
+        return (
+            PayloadStatusV1(
+                PayloadStatus.VALID, latest_valid_hash=self.head_hash
+            ),
+            payload_id,
+        )
+
+    def get_payload(self, payload_id: bytes):
+        return self.pending_payloads.pop(payload_id, None)
+
+
+class MockExecutionLayer:
+    """In-process engine-API HTTP server over an ExecutionBlockGenerator,
+    with JWT auth checking (test_utils/mod.rs MockServer)."""
+
+    def __init__(self, jwt_secret: bytes | None = None):
+        self.jwt_secret = jwt_secret or os.urandom(32)
+        self.generator = ExecutionBlockGenerator()
+        gen = self.generator
+        secret = self.jwt_secret
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                auth = self.headers.get("Authorization", "")
+                if not (
+                    auth.startswith("Bearer ")
+                    and jwt_verify(secret, auth[7:])
+                ):
+                    self.send_response(401)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length))
+                result, error = None, None
+                try:
+                    method, params = req["method"], req.get("params", [])
+                    if method == "engine_newPayloadV1":
+                        result = gen.new_payload(
+                            JsonExecutionPayload.from_json(params[0])
+                        ).to_json()
+                    elif method == "engine_forkchoiceUpdatedV1":
+                        fcs = ForkchoiceState.from_json(params[0])
+                        attrs = (
+                            PayloadAttributes.from_json(params[1])
+                            if params[1]
+                            else None
+                        )
+                        status, pid = gen.forkchoice_updated(fcs, attrs)
+                        result = {
+                            "payloadStatus": status.to_json(),
+                            "payloadId": (
+                                "0x" + pid.hex() if pid else None
+                            ),
+                        }
+                    elif method == "engine_getPayloadV1":
+                        payload = gen.get_payload(
+                            bytes.fromhex(params[0][2:])
+                        )
+                        if payload is None:
+                            error = {
+                                "code": -38001,
+                                "message": "Unknown payload",
+                            }
+                        else:
+                            result = payload.to_json()
+                    elif method == "eth_getBlockByHash":
+                        blk = gen.block_by_hash(
+                            bytes.fromhex(params[0][2:])
+                        )
+                        result = (
+                            {
+                                "hash": "0x" + blk.block_hash.hex(),
+                                "parentHash": "0x" + blk.parent_hash.hex(),
+                                "number": hex(blk.block_number),
+                                "timestamp": hex(blk.timestamp),
+                            }
+                            if blk
+                            else None
+                        )
+                    elif method == "eth_syncing":
+                        result = False
+                    else:
+                        error = {
+                            "code": -32601,
+                            "message": f"unknown method {method}",
+                        }
+                except Exception as e:  # malformed params and the like
+                    error = {"code": -32602, "message": str(e)}
+                body = {"jsonrpc": "2.0", "id": req.get("id")}
+                if error is not None:
+                    body["error"] = error
+                else:
+                    body["result"] = result
+                data = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def client(self) -> EngineHttpClient:
+        return EngineHttpClient(self.url, self.jwt_secret)
+
+    def shutdown(self):
+        self.server.shutdown()
+        self.server.server_close()

@@ -72,7 +72,11 @@ class ForkChoice:
         justified_checkpoint,
         finalized_checkpoint,
         is_timely: bool = False,
+        execution_status: str = None,
+        execution_block_hash: bytes | None = None,
     ):
+        from lighthouse_tpu.fork_choice.proto_array import ExecutionStatus
+
         if slot > self.current_slot:
             raise ForkChoiceError("block from the future")
         if parent_root not in self.proto.indices:
@@ -87,9 +91,24 @@ class ForkChoice:
             parent_root,
             justified_checkpoint[0],
             finalized_checkpoint[0],
+            execution_status=execution_status or ExecutionStatus.IRRELEVANT,
+            execution_block_hash=execution_block_hash,
         )
         if is_timely and slot == self.current_slot:
             self.proposer_boost_root = root
+
+    # ------------------------------------------- optimistic-sync verdicts
+
+    def is_optimistic(self, root: bytes) -> bool:
+        return self.proto.is_optimistic(root)
+
+    def on_valid_execution_payload(self, root: bytes):
+        self.proto.on_valid_execution_payload(root)
+
+    def on_invalid_execution_payload(
+        self, root: bytes, latest_valid_hash: bytes | None = None
+    ):
+        self.proto.on_invalid_execution_payload(root, latest_valid_hash)
 
     # -------------------------------------------------------- attestations
 

@@ -11,6 +11,16 @@ head-finding is O(depth) pointer chasing, not tree search.
 from dataclasses import dataclass, field
 
 
+class ExecutionStatus:
+    """Optimistic-sync payload verdict per node (reference
+    proto_array.rs ExecutionStatus: Valid/Invalid/Optimistic/Irrelevant)."""
+
+    IRRELEVANT = "irrelevant"  # pre-merge block, no payload
+    OPTIMISTIC = "optimistic"  # payload imported without a verdict yet
+    VALID = "valid"
+    INVALID = "invalid"
+
+
 @dataclass
 class ProtoNode:
     slot: int
@@ -21,6 +31,8 @@ class ProtoNode:
     weight: int = 0
     best_child: int | None = None
     best_descendant: int | None = None
+    execution_status: str = ExecutionStatus.IRRELEVANT
+    execution_block_hash: bytes | None = None
 
 
 class ProtoArrayError(Exception):
@@ -41,6 +53,8 @@ class ProtoArray:
         parent_root: bytes | None,
         justified_epoch: int,
         finalized_epoch: int,
+        execution_status: str = ExecutionStatus.IRRELEVANT,
+        execution_block_hash: bytes | None = None,
     ):
         if root in self.indices:
             return
@@ -55,6 +69,8 @@ class ProtoArray:
             parent=parent,
             justified_epoch=justified_epoch,
             finalized_epoch=finalized_epoch,
+            execution_status=execution_status,
+            execution_block_hash=execution_block_hash,
         )
         idx = len(self.nodes)
         self.indices[root] = idx
@@ -86,6 +102,8 @@ class ProtoArray:
                 self._maybe_update_best_child(node.parent, i)
 
     def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
+        if node.execution_status == ExecutionStatus.INVALID:
+            return False
         return (
             node.justified_epoch == self.justified_epoch
             or self.justified_epoch == 0
@@ -93,6 +111,85 @@ class ProtoArray:
             node.finalized_epoch == self.finalized_epoch
             or self.finalized_epoch == 0
         )
+
+    # ------------------------------------------- optimistic-sync verdicts
+
+    def is_optimistic(self, root: bytes) -> bool:
+        """True if the block's payload (or any ancestor's) is unverified."""
+        idx = self.indices.get(root)
+        if idx is None:
+            raise ProtoArrayError("unknown root")
+        while idx is not None:
+            node = self.nodes[idx]
+            if node.execution_status == ExecutionStatus.OPTIMISTIC:
+                return True
+            if node.execution_status == ExecutionStatus.VALID:
+                return False
+            idx = node.parent
+        return False
+
+    def on_valid_execution_payload(self, root: bytes):
+        """An engine VALID verdict for `root` proves every optimistic
+        ancestor valid too (proto_array.rs propagate_execution_payload_
+        validation)."""
+        idx = self.indices.get(root)
+        if idx is None:
+            raise ProtoArrayError("unknown root")
+        while idx is not None:
+            node = self.nodes[idx]
+            if node.execution_status == ExecutionStatus.INVALID:
+                raise ProtoArrayError(
+                    "valid verdict for a block marked invalid"
+                )
+            if node.execution_status in (
+                ExecutionStatus.VALID,
+                ExecutionStatus.IRRELEVANT,
+            ):
+                break
+            node.execution_status = ExecutionStatus.VALID
+            idx = node.parent
+
+    def on_invalid_execution_payload(
+        self, root: bytes, latest_valid_hash: bytes | None = None
+    ):
+        """An engine INVALID verdict: mark `root`, its descendants, and
+        its ancestors back to (exclusive) latest_valid_hash invalid, then
+        refresh best-child links so the head routes around them
+        (proto_array.rs process_execution_status_invalidation)."""
+        idx = self.indices.get(root)
+        if idx is None:
+            raise ProtoArrayError("unknown root")
+        bad = {idx}
+        if latest_valid_hash is not None:
+            # ancestors up to (exclusive) the last valid payload. With no
+            # latest_valid_hash the engine only proved THIS payload
+            # invalid — do not over-invalidate the optimistic chain.
+            walk = idx
+            while walk is not None:
+                node = self.nodes[walk]
+                if (
+                    node.execution_block_hash == latest_valid_hash
+                    or node.execution_status
+                    in (ExecutionStatus.VALID, ExecutionStatus.IRRELEVANT)
+                ):
+                    break
+                bad.add(walk)
+                walk = node.parent
+        # all descendants of any invalidated node (parents precede
+        # children in the array, so one forward pass from the earliest
+        # invalidated index covers every descendant)
+        for i in range(min(bad) + 1, len(self.nodes)):
+            if self.nodes[i].parent in bad:
+                bad.add(i)
+        for i in bad:
+            self.nodes[i].execution_status = ExecutionStatus.INVALID
+            self.nodes[i].best_child = None
+            self.nodes[i].best_descendant = None
+        # refresh best links bottom-up so invalid branches are demoted
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            if node.parent is not None:
+                self._maybe_update_best_child(node.parent, i)
 
     def _node_leads_to_viable_head(self, node: ProtoNode) -> bool:
         if node.best_descendant is not None:

@@ -51,6 +51,9 @@ class Harness:
         self.fork_name = spec.fork_name_at_epoch(0)
         # attestations produced at the previous slot, pending inclusion
         self.pending_attestations = []
+        # optional bellatrix payload source: callable(state) -> ExecutionPayload
+        # (None = pre-merge default-empty payloads)
+        self.payload_builder = None
 
     # ------------------------------------------------------------ helpers
 
@@ -178,6 +181,8 @@ class Harness:
                 else self.head_block_root(state)
             )
             body.sync_aggregate = self.make_sync_aggregate(state, prev_root)
+        if fork_name == "bellatrix" and self.payload_builder is not None:
+            body.execution_payload = self.payload_builder(state)
 
         block_cls = t.block_classes[fork_name]
         block = block_cls(

@@ -25,6 +25,7 @@ from lighthouse_tpu.ssz.codec import (  # noqa: F401
     boolean,
     byte,
     bytes4,
+    bytes20,
     bytes32,
     bytes48,
     bytes96,

@@ -193,6 +193,7 @@ class ByteList(SSZType):
 
 
 bytes4 = ByteVector(4)
+bytes20 = ByteVector(20)
 bytes32 = ByteVector(32)
 bytes48 = ByteVector(48)
 bytes96 = ByteVector(96)

@@ -295,11 +295,7 @@ def slash_validator(
     state.slashings[epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] += (
         v.effective_balance
     )
-    min_quot = (
-        spec.MIN_SLASHING_PENALTY_QUOTIENT
-        if fork == "phase0"
-        else spec.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
-    )
+    min_quot = spec.min_slashing_penalty_quotient_for(fork)
     decrease_balance(state, slashed_index, v.effective_balance // min_quot)
 
     proposer_index = get_beacon_proposer_index(state, spec)

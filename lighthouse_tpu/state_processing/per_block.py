@@ -121,6 +121,7 @@ def per_block_processing(
     committee_cache: CommitteeCache | None = None,
     backend: str | None = None,
     seed: int | None = None,
+    execution_engine=None,
 ):
     """Apply `signed_block` to `state` (which must already be advanced to
     the block's slot via process_slots). Mutates state in place."""
@@ -143,6 +144,10 @@ def per_block_processing(
         )
 
     process_block_header(state, block, spec)
+    if fork == "bellatrix" and is_execution_enabled(state, block.body):
+        process_execution_payload(
+            state, block.body.execution_payload, execution_engine, spec
+        )
     process_randao(state, block, pk, spec, collector)
     process_eth1_data(state, block.body, spec)
     process_operations(
@@ -156,6 +161,103 @@ def per_block_processing(
 
     collector.finish()
     return state
+
+
+# --------------------------------------------------- execution (bellatrix)
+
+
+_EMPTY_HEADER_ENC: dict[type, bytes] = {}
+
+
+def is_merge_transition_complete(state) -> bool:
+    """True once the state has seen a real execution payload (spec:
+    latest_execution_payload_header != ExecutionPayloadHeader())."""
+    cls = type(state.latest_execution_payload_header)
+    empty = _EMPTY_HEADER_ENC.get(cls)
+    if empty is None:
+        empty = _EMPTY_HEADER_ENC[cls] = cls.encode(cls())
+    return cls.encode(state.latest_execution_payload_header) != empty
+
+
+def is_merge_transition_block(state, body) -> bool:
+    return (
+        not is_merge_transition_complete(state)
+        and body.execution_payload.block_hash != b"\x00" * 32
+    )
+
+
+def is_execution_enabled(state, body) -> bool:
+    if is_merge_transition_complete(state):
+        return True
+    return body.execution_payload.block_hash != b"\x00" * 32
+
+
+def compute_timestamp_at_slot(state, slot: int, spec: Spec) -> int:
+    return state.genesis_time + (slot) * spec.SECONDS_PER_SLOT
+
+
+class AlwaysValidExecutionEngine:
+    """Spec-test stand-in: accepts every payload (the reference's
+    fake-execution path in the harness)."""
+
+    def notify_new_payload(self, payload) -> bool:
+        return True
+
+
+def process_execution_payload(state, payload, execution_engine, spec: Spec):
+    """Spec process_execution_payload (bellatrix/block_processing.rs
+    analog): consistency checks against the state, then the engine
+    verdict, then roll the header forward."""
+    from lighthouse_tpu.state_processing.helpers import get_randao_mix
+    from lighthouse_tpu.types.containers import types_for
+
+    if is_merge_transition_complete(state):
+        if (
+            payload.parent_hash
+            != state.latest_execution_payload_header.block_hash
+        ):
+            raise BlockProcessingError("payload parent mismatch")
+    if payload.prev_randao != get_randao_mix(
+        state, get_current_epoch(state, spec), spec
+    ):
+        raise BlockProcessingError("payload prev_randao mismatch")
+    if payload.timestamp != compute_timestamp_at_slot(
+        state, state.slot, spec
+    ):
+        raise BlockProcessingError("payload timestamp mismatch")
+    engine = execution_engine or AlwaysValidExecutionEngine()
+    if not engine.notify_new_payload(payload):
+        raise BlockProcessingError("execution engine rejected payload")
+
+    t = types_for(spec)
+    tx_list_type = _tx_list_type(t, spec)
+    state.latest_execution_payload_header = t.ExecutionPayloadHeader(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=tx_list_type.hash_tree_root(
+            list(payload.transactions)
+        ),
+    )
+
+
+def _tx_list_type(t, spec):
+    from lighthouse_tpu import ssz
+
+    return ssz.List(
+        ssz.ByteList(spec.MAX_BYTES_PER_TRANSACTION),
+        spec.MAX_TRANSACTIONS_PER_PAYLOAD,
+    )
 
 
 # ----------------------------------------------------------------- header

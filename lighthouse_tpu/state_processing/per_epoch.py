@@ -413,7 +413,7 @@ def process_rewards_and_penalties_altair(state, spec, ctx):
             )
             denominator = (
                 spec.INACTIVITY_SCORE_BIAS
-                * spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+                * spec.inactivity_penalty_quotient_for(fork_of(state, spec))
             )
             penalties[i] += numerator // denominator
 
@@ -464,11 +464,7 @@ def process_registry_updates(state, spec):
 def process_slashings(state, spec, fork):
     epoch = get_current_epoch(state, spec)
     total = get_total_active_balance(state, spec)
-    mult = (
-        spec.PROPORTIONAL_SLASHING_MULTIPLIER
-        if fork == "phase0"
-        else spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
-    )
+    mult = spec.proportional_slashing_multiplier_for(fork)
     adjusted = min(sum(state.slashings) * mult, total)
     increment = spec.EFFECTIVE_BALANCE_INCREMENT
     for i, v in enumerate(state.validators):

@@ -45,11 +45,12 @@ def process_slots(state, slot: int, spec: Spec):
             process_epoch(state, spec)
         state.slot = next_slot
         # fork upgrade on the first slot of the fork epoch
-        if (
-            next_slot % spec.SLOTS_PER_EPOCH == 0
-            and spec.slot_to_epoch(next_slot) == spec.ALTAIR_FORK_EPOCH
-        ):
-            state = upgrade_to_altair(state, spec)
+        if next_slot % spec.SLOTS_PER_EPOCH == 0:
+            epoch = spec.slot_to_epoch(next_slot)
+            if epoch == spec.ALTAIR_FORK_EPOCH:
+                state = upgrade_to_altair(state, spec)
+            if epoch == spec.BELLATRIX_FORK_EPOCH:
+                state = upgrade_to_bellatrix(state, spec)
     return state
 
 
@@ -100,4 +101,49 @@ def upgrade_to_altair(state, spec: Spec):
     sync_committee = get_next_sync_committee(new_state, spec)
     new_state.current_sync_committee = sync_committee
     new_state.next_sync_committee = get_next_sync_committee(new_state, spec)
+    return new_state
+
+
+def upgrade_to_bellatrix(state, spec: Spec):
+    """Translate an altair state into the bellatrix representation at the
+    fork boundary (spec upgrade_to_bellatrix; reference
+    consensus/state_processing/src/upgrade/merge.rs): same fields plus an
+    empty latest_execution_payload_header (pre-merge — filled by the first
+    post-transition block)."""
+    t = types_for(spec)
+    from lighthouse_tpu.state_processing.helpers import get_current_epoch
+
+    new_state = t.BeaconStateBellatrix(
+        genesis_time=state.genesis_time,
+        genesis_validators_root=state.genesis_validators_root,
+        slot=state.slot,
+        fork=t.Fork(
+            previous_version=state.fork.current_version,
+            current_version=spec.BELLATRIX_FORK_VERSION,
+            epoch=get_current_epoch(state, spec),
+        ),
+        latest_block_header=state.latest_block_header,
+        block_roots=list(state.block_roots),
+        state_roots=list(state.state_roots),
+        historical_roots=list(state.historical_roots),
+        eth1_data=state.eth1_data,
+        eth1_data_votes=list(state.eth1_data_votes),
+        eth1_deposit_index=state.eth1_deposit_index,
+        validators=list(state.validators),
+        balances=list(state.balances),
+        randao_mixes=list(state.randao_mixes),
+        slashings=list(state.slashings),
+        previous_epoch_participation=list(
+            state.previous_epoch_participation
+        ),
+        current_epoch_participation=list(state.current_epoch_participation),
+        justification_bits=list(state.justification_bits),
+        previous_justified_checkpoint=state.previous_justified_checkpoint,
+        current_justified_checkpoint=state.current_justified_checkpoint,
+        finalized_checkpoint=state.finalized_checkpoint,
+        inactivity_scores=list(state.inactivity_scores),
+        current_sync_committee=state.current_sync_committee,
+        next_sync_committee=state.next_sync_committee,
+        latest_execution_payload_header=t.ExecutionPayloadHeader(),
+    )
     return new_state

@@ -152,6 +152,44 @@ def types_for(spec: Spec) -> SimpleNamespace:
         sync_committee_bits: ssz.Bitvector(spec.SYNC_COMMITTEE_SIZE)
         sync_committee_signature: BLSSignature
 
+    # ------------------------------------------------- bellatrix payloads
+
+    Transaction = ssz.ByteList(spec.MAX_BYTES_PER_TRANSACTION)
+
+    class ExecutionPayload(ssz.Container):
+        parent_hash: Hash32
+        fee_recipient: ssz.bytes20
+        state_root: ssz.bytes32
+        receipts_root: ssz.bytes32
+        logs_bloom: ssz.ByteVector(spec.BYTES_PER_LOGS_BLOOM)
+        prev_randao: ssz.bytes32
+        block_number: ssz.uint64
+        gas_limit: ssz.uint64
+        gas_used: ssz.uint64
+        timestamp: ssz.uint64
+        extra_data: ssz.ByteList(spec.MAX_EXTRA_DATA_BYTES)
+        base_fee_per_gas: ssz.uint256
+        block_hash: Hash32
+        transactions: ssz.List(
+            Transaction, spec.MAX_TRANSACTIONS_PER_PAYLOAD
+        )
+
+    class ExecutionPayloadHeader(ssz.Container):
+        parent_hash: Hash32
+        fee_recipient: ssz.bytes20
+        state_root: ssz.bytes32
+        receipts_root: ssz.bytes32
+        logs_bloom: ssz.ByteVector(spec.BYTES_PER_LOGS_BLOOM)
+        prev_randao: ssz.bytes32
+        block_number: ssz.uint64
+        gas_limit: ssz.uint64
+        gas_used: ssz.uint64
+        timestamp: ssz.uint64
+        extra_data: ssz.ByteList(spec.MAX_EXTRA_DATA_BYTES)
+        base_fee_per_gas: ssz.uint256
+        block_hash: Hash32
+        transactions_root: Root
+
     # -------------------------------------------------------------- bodies
 
     class BeaconBlockBodyPhase0(ssz.Container):
@@ -187,6 +225,24 @@ def types_for(spec: Spec) -> SimpleNamespace:
         )
         sync_aggregate: SyncAggregate
 
+    class BeaconBlockBodyBellatrix(ssz.Container):
+        randao_reveal: BLSSignature
+        eth1_data: Eth1Data
+        graffiti: ssz.bytes32
+        proposer_slashings: ssz.List(
+            ProposerSlashing, spec.MAX_PROPOSER_SLASHINGS
+        )
+        attester_slashings: ssz.List(
+            AttesterSlashing, spec.MAX_ATTESTER_SLASHINGS
+        )
+        attestations: ssz.List(Attestation, spec.MAX_ATTESTATIONS)
+        deposits: ssz.List(Deposit, spec.MAX_DEPOSITS)
+        voluntary_exits: ssz.List(
+            SignedVoluntaryExit, spec.MAX_VOLUNTARY_EXITS
+        )
+        sync_aggregate: SyncAggregate
+        execution_payload: ExecutionPayload
+
     def _make_block(body_cls, name):
         cls = type(
             name,
@@ -205,6 +261,9 @@ def types_for(spec: Spec) -> SimpleNamespace:
 
     BeaconBlockPhase0 = _make_block(BeaconBlockBodyPhase0, "BeaconBlockPhase0")
     BeaconBlockAltair = _make_block(BeaconBlockBodyAltair, "BeaconBlockAltair")
+    BeaconBlockBellatrix = _make_block(
+        BeaconBlockBodyBellatrix, "BeaconBlockBellatrix"
+    )
 
     def _make_signed(block_cls, name):
         return type(
@@ -223,6 +282,9 @@ def types_for(spec: Spec) -> SimpleNamespace:
     )
     SignedBeaconBlockAltair = _make_signed(
         BeaconBlockAltair, "SignedBeaconBlockAltair"
+    )
+    SignedBeaconBlockBellatrix = _make_signed(
+        BeaconBlockBellatrix, "SignedBeaconBlockBellatrix"
     )
 
     # --------------------------------------------------------------- state
@@ -275,24 +337,35 @@ def types_for(spec: Spec) -> SimpleNamespace:
         },
     )
 
+    _altair_fields = {
+        **_state_prefix,
+        "previous_epoch_participation": ssz.List(
+            ParticipationFlags, spec.VALIDATOR_REGISTRY_LIMIT
+        ),
+        "current_epoch_participation": ssz.List(
+            ParticipationFlags, spec.VALIDATOR_REGISTRY_LIMIT
+        ),
+        **_state_suffix,
+        "inactivity_scores": ssz.List(
+            ssz.uint64, spec.VALIDATOR_REGISTRY_LIMIT
+        ),
+        "current_sync_committee": SyncCommittee,
+        "next_sync_committee": SyncCommittee,
+    }
+
     BeaconStateAltair = type(
         "BeaconStateAltair",
         (ssz.Container,),
+        {"__annotations__": dict(_altair_fields)},
+    )
+
+    BeaconStateBellatrix = type(
+        "BeaconStateBellatrix",
+        (ssz.Container,),
         {
             "__annotations__": {
-                **_state_prefix,
-                "previous_epoch_participation": ssz.List(
-                    ParticipationFlags, spec.VALIDATOR_REGISTRY_LIMIT
-                ),
-                "current_epoch_participation": ssz.List(
-                    ParticipationFlags, spec.VALIDATOR_REGISTRY_LIMIT
-                ),
-                **_state_suffix,
-                "inactivity_scores": ssz.List(
-                    ssz.uint64, spec.VALIDATOR_REGISTRY_LIMIT
-                ),
-                "current_sync_committee": SyncCommittee,
-                "next_sync_committee": SyncCommittee,
+                **_altair_fields,
+                "latest_execution_payload_header": ExecutionPayloadHeader,
             }
         },
     )
@@ -349,18 +422,22 @@ def types_for(spec: Spec) -> SimpleNamespace:
     ns.block_body_classes = {
         "phase0": BeaconBlockBodyPhase0,
         "altair": BeaconBlockBodyAltair,
+        "bellatrix": BeaconBlockBodyBellatrix,
     }
     ns.block_classes = {
         "phase0": BeaconBlockPhase0,
         "altair": BeaconBlockAltair,
+        "bellatrix": BeaconBlockBellatrix,
     }
     ns.signed_block_classes = {
         "phase0": SignedBeaconBlockPhase0,
         "altair": SignedBeaconBlockAltair,
+        "bellatrix": SignedBeaconBlockBellatrix,
     }
     ns.state_classes = {
         "phase0": BeaconStatePhase0,
         "altair": BeaconStateAltair,
+        "bellatrix": BeaconStateBellatrix,
     }
 
     _CACHE[spec.name] = ns

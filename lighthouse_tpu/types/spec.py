@@ -115,6 +115,20 @@ class Spec:
     PROPOSER_SCORE_BOOST: int
     TARGET_AGGREGATORS_PER_COMMITTEE: int
 
+    # bellatrix (merge) — execution payload sizes + penalty variants
+    # (consensus/types/src/eth_spec.rs MaxBytesPerTransaction etc.,
+    # chain_spec.rs *_bellatrix fields)
+    MAX_BYTES_PER_TRANSACTION: int = 2**30
+    MAX_TRANSACTIONS_PER_PAYLOAD: int = 2**20
+    BYTES_PER_LOGS_BLOOM: int = 256
+    MAX_EXTRA_DATA_BYTES: int = 32
+    INACTIVITY_PENALTY_QUOTIENT_BELLATRIX: int = 2**24
+    MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX: int = 32
+    PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX: int = 3
+    TERMINAL_TOTAL_DIFFICULTY: int = 2**256 - 2**10
+    TERMINAL_BLOCK_HASH: bytes = b"\x00" * 32
+    TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH: int = FAR_FUTURE_EPOCH
+
     # domains (4-byte little-endian type tags)
     DOMAIN_BEACON_PROPOSER: bytes = b"\x00\x00\x00\x00"
     DOMAIN_BEACON_ATTESTER: bytes = b"\x01\x00\x00\x00"
@@ -148,6 +162,29 @@ class Spec:
             "altair": self.ALTAIR_FORK_VERSION,
             "bellatrix": self.BELLATRIX_FORK_VERSION,
         }[self.fork_name_at_epoch(epoch)]
+
+    # fork-keyed penalty parameters (chain_spec.rs *_altair/*_bellatrix)
+
+    def inactivity_penalty_quotient_for(self, fork: str) -> int:
+        return {
+            "phase0": self.INACTIVITY_PENALTY_QUOTIENT,
+            "altair": self.INACTIVITY_PENALTY_QUOTIENT_ALTAIR,
+            "bellatrix": self.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX,
+        }[fork]
+
+    def min_slashing_penalty_quotient_for(self, fork: str) -> int:
+        return {
+            "phase0": self.MIN_SLASHING_PENALTY_QUOTIENT,
+            "altair": self.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR,
+            "bellatrix": self.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX,
+        }[fork]
+
+    def proportional_slashing_multiplier_for(self, fork: str) -> int:
+        return {
+            "phase0": self.PROPORTIONAL_SLASHING_MULTIPLIER,
+            "altair": self.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR,
+            "bellatrix": self.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
+        }[fork]
 
 
 def mainnet_spec(**overrides) -> Spec:

