@@ -1,0 +1,116 @@
+"""EIP-2386 hierarchical deterministic wallet.
+
+Role of crypto/eth2_wallet (wallet.rs, 1,029 LoC): a JSON wallet document
+holding an encrypted seed (reusing the EIP-2335 crypto module), a type
+("hierarchical deterministic"), and a `nextaccount` counter; validator
+voting/withdrawal keys derive from the seed at the EIP-2334 paths
+m/12381/3600/{i}/0/0 and m/12381/3600/{i}/0.
+"""
+
+import json
+import uuid
+
+from lighthouse_tpu.accounts.key_derivation import (
+    derive_path,
+    mnemonic_to_seed,
+)
+from lighthouse_tpu.accounts.keystore import Keystore
+
+
+class WalletError(ValueError):
+    pass
+
+
+def voting_key_path(index: int) -> str:
+    return f"m/12381/3600/{index}/0/0"
+
+
+def withdrawal_key_path(index: int) -> str:
+    return f"m/12381/3600/{index}/0"
+
+
+class Wallet:
+    """EIP-2386 wallet: encrypted seed + account counter."""
+
+    def __init__(self, doc: dict):
+        self.doc = doc
+
+    # ------------------------------------------------------------ create
+
+    @classmethod
+    def create(
+        cls,
+        name: str,
+        password: str,
+        seed: bytes | None = None,
+        mnemonic: str | None = None,
+        kdf: str = "pbkdf2",
+    ) -> "Wallet":
+        if seed is None:
+            if mnemonic is None:
+                raise WalletError("need a seed or a mnemonic")
+            seed = mnemonic_to_seed(mnemonic)
+        # reuse the EIP-2335 crypto envelope for the seed ciphertext
+        ks = Keystore.encrypt(seed, password, kdf=kdf, pubkey=b"")
+        doc = {
+            "crypto": ks.doc["crypto"],
+            "name": name,
+            "nextaccount": 0,
+            "type": "hierarchical deterministic",
+            "uuid": str(uuid.uuid4()),
+            "version": 1,
+        }
+        return cls(doc)
+
+    # ----------------------------------------------------------- accounts
+
+    def decrypt_seed(self, password: str) -> bytes:
+        ks = Keystore({"crypto": self.doc["crypto"], "pubkey": ""})
+        return ks.decrypt(password)
+
+    @property
+    def name(self) -> str:
+        return self.doc["name"]
+
+    @property
+    def nextaccount(self) -> int:
+        return self.doc["nextaccount"]
+
+    def next_validator(
+        self,
+        wallet_password: str,
+        voting_keystore_password: str,
+    ):
+        """Derive the next validator's voting + withdrawal keys and bump
+        `nextaccount` (wallet.rs next_validator). Returns
+        (index, voting_keystore, withdrawal_sk_int)."""
+        seed = self.decrypt_seed(wallet_password)
+        index = self.doc["nextaccount"]
+        voting_sk = derive_path(seed, voting_key_path(index))
+        withdrawal_sk = derive_path(seed, withdrawal_key_path(index))
+        from lighthouse_tpu import bls
+
+        sk = bls.SecretKey.from_bytes(voting_sk.to_bytes(32, "big"))
+        voting_ks = Keystore.encrypt(
+            voting_sk.to_bytes(32, "big"),
+            voting_keystore_password,
+            path=voting_key_path(index),
+            kdf="pbkdf2",
+            pubkey=sk.public_key().to_bytes(),
+        )
+        self.doc["nextaccount"] = index + 1
+        return index, voting_ks, withdrawal_sk
+
+    # --------------------------------------------------------------- json
+
+    def to_json(self) -> str:
+        return json.dumps(self.doc)
+
+    @classmethod
+    def from_json(cls, payload: str) -> "Wallet":
+        doc = json.loads(payload)
+        if doc.get("type") != "hierarchical deterministic":
+            raise WalletError("unsupported wallet type")
+        if doc.get("version") != 1:
+            raise WalletError("unsupported wallet version")
+        return cls(doc)

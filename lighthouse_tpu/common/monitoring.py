@@ -1,0 +1,105 @@
+"""Remote monitoring telemetry.
+
+Role of common/monitoring_api (lib.rs:19 — ship process/system/beacon-node
+metrics to a remote endpoint every 60 s): collects a metrics snapshot in
+the monitoring-service JSON shape and POSTs it on a timer thread.
+"""
+
+import json
+import threading
+import time
+import http.client
+from urllib.parse import urlparse
+
+DEFAULT_UPDATE_PERIOD_SECS = 60
+PROCESS_NAME_BEACON = "beaconnode"
+PROCESS_NAME_VALIDATOR = "validator"
+
+
+def collect_process_metrics() -> dict:
+    """Process-level stats (monitoring_api/src/types.rs ProcessMetrics)."""
+    import os
+    import resource
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return {
+        "cpu_process_seconds_total": ru.ru_utime + ru.ru_stime,
+        "memory_process_bytes": ru.ru_maxrss * 1024,
+        "client_name": "lighthouse-tpu",
+        "client_version": "0.1.0",
+        "sync_eth2_fallback_configured": False,
+        "pid": os.getpid(),
+    }
+
+
+class MonitoringService:
+    def __init__(
+        self,
+        endpoint: str,
+        chain=None,
+        process_name: str = PROCESS_NAME_BEACON,
+        update_period: float = DEFAULT_UPDATE_PERIOD_SECS,
+        timeout: float = 5.0,
+    ):
+        self.endpoint = endpoint
+        self.chain = chain
+        self.process_name = process_name
+        self.update_period = update_period
+        self.timeout = timeout
+        self._stop = threading.Event()
+        self._thread = None
+        self.sends = 0
+        self.errors = 0
+
+    def snapshot(self) -> list[dict]:
+        """One telemetry payload (list of per-process metric sets)."""
+        base = {
+            "version": 1,
+            "timestamp": int(time.time() * 1000),
+            "process": self.process_name,
+        }
+        base.update(collect_process_metrics())
+        if self.chain is not None:
+            base["sync_beacon_head_slot"] = getattr(
+                self.chain.head_state, "slot", 0
+            )
+            base["slasher_attestations"] = self.chain.metrics.get(
+                "attestations_processed", 0
+            )
+        return [base]
+
+    def send_once(self) -> bool:
+        payload = json.dumps(self.snapshot()).encode()
+        u = urlparse(self.endpoint)
+        try:
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port or 80, timeout=self.timeout
+            )
+            conn.request(
+                "POST",
+                u.path or "/",
+                payload,
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            ok = 200 <= resp.status < 300
+        except OSError:
+            ok = False
+        if ok:
+            self.sends += 1
+        else:
+            self.errors += 1
+        return ok
+
+    def start(self):
+        def loop():
+            while not self._stop.wait(self.update_period):
+                self.send_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,97 @@
+"""Multi-beacon-node redundancy with health ranking.
+
+Role of validator_client/src/beacon_node_fallback.rs (491 LoC) +
+common/fallback: the VC holds an ordered list of candidate beacon nodes,
+health-checks them (syncing distance + reachability), ranks healthy
+candidates first, and retries each request down the ranking until one
+succeeds.
+"""
+
+import logging
+from dataclasses import dataclass, field
+from enum import Enum
+
+log = logging.getLogger("beacon_node_fallback")
+
+
+class CandidateHealth(Enum):
+    HEALTHY = 0       # synced and reachable
+    SYNCING = 1       # reachable but behind
+    OFFLINE = 2       # unreachable
+
+
+@dataclass
+class CandidateBeaconNode:
+    client: object  # BeaconNodeHttpClient-compatible (has .syncing())
+    health: CandidateHealth = CandidateHealth.OFFLINE
+    # consecutive failures feed the ordering within a health tier
+    failures: int = 0
+
+
+class AllNodesFailed(Exception):
+    def __init__(self, errors):
+        super().__init__(f"all beacon nodes failed: {errors}")
+        self.errors = errors
+
+
+@dataclass
+class BeaconNodeFallback:
+    candidates: list = field(default_factory=list)
+    sync_tolerance_slots: int = 8
+
+    @classmethod
+    def from_clients(cls, clients, sync_tolerance_slots: int = 8):
+        return cls(
+            candidates=[CandidateBeaconNode(c) for c in clients],
+            sync_tolerance_slots=sync_tolerance_slots,
+        )
+
+    def update_health(self):
+        """Probe every candidate (beacon_node_fallback.rs update_all_
+        candidates): classify by reachability + sync distance."""
+        for cand in self.candidates:
+            try:
+                syncing = cand.client.syncing()
+                distance = int(syncing.get("sync_distance", 0))
+                is_syncing = bool(syncing.get("is_syncing", False))
+                if is_syncing and distance > self.sync_tolerance_slots:
+                    cand.health = CandidateHealth.SYNCING
+                else:
+                    cand.health = CandidateHealth.HEALTHY
+            except Exception:
+                cand.health = CandidateHealth.OFFLINE
+
+    def _ranked(self):
+        return sorted(
+            self.candidates,
+            key=lambda c: (c.health.value, c.failures),
+        )
+
+    def first_success(self, op):
+        """Run `op(client)` against candidates in health order; fall
+        through on failure (the per-request failover of the reference)."""
+        errors = []
+        for cand in self._ranked():
+            if cand.health == CandidateHealth.OFFLINE:
+                continue
+            try:
+                result = op(cand.client)
+                cand.failures = 0
+                return result
+            except Exception as e:  # noqa: BLE001 — any API failure
+                cand.failures += 1
+                errors.append(e)
+                log.warning("beacon node failed, trying next: %s", e)
+        # last resort: try offline candidates too (they may have recovered)
+        for cand in self._ranked():
+            if cand.health != CandidateHealth.OFFLINE:
+                continue
+            try:
+                result = op(cand.client)
+                cand.failures = 0
+                cand.health = CandidateHealth.HEALTHY
+                return result
+            except Exception as e:  # noqa: BLE001
+                cand.failures += 1
+                errors.append(e)
+        raise AllNodesFailed(errors)

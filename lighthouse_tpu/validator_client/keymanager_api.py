@@ -1,0 +1,164 @@
+"""Keymanager HTTP API: remote validator-key management with token auth.
+
+Role of validator_client/src/http_api (the standard keymanager API):
+GET/POST/DELETE /eth/v1/keystores (EIP-2335 import/export with slashing
+protection), GET/POST/DELETE /eth/v1/remotekeys (Web3Signer-backed keys),
+all behind a bearer api-token.
+"""
+
+import json
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.accounts.keystore import Keystore
+from lighthouse_tpu.validator_client.signing_method import Web3SignerClient
+
+
+class KeymanagerServer:
+    def __init__(
+        self,
+        validator_store,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        api_token: str | None = None,
+    ):
+        self.store = validator_store
+        self.api_token = api_token or secrets.token_hex(16)
+        km = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _auth_ok(self) -> bool:
+                auth = self.headers.get("Authorization", "")
+                return auth == "Bearer " + km.api_token
+
+            def _send(self, code, obj):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            def do_GET(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                if self.path == "/eth/v1/keystores":
+                    data = [
+                        {
+                            "validating_pubkey": "0x" + pk.hex(),
+                            "derivation_path": "",
+                            "readonly": False,
+                        }
+                        for pk in km.store.validators
+                        if not isinstance(
+                            km.store.validators[pk].signer,
+                            Web3SignerClient,
+                        )
+                    ]
+                    return self._send(200, {"data": data})
+                if self.path == "/eth/v1/remotekeys":
+                    data = [
+                        {
+                            "pubkey": "0x" + pk.hex(),
+                            "url": km.store.validators[pk].signer.url,
+                            "readonly": False,
+                        }
+                        for pk in km.store.validators
+                        if isinstance(
+                            km.store.validators[pk].signer,
+                            Web3SignerClient,
+                        )
+                    ]
+                    return self._send(200, {"data": data})
+                return self._send(404, {"message": "not found"})
+
+            def do_POST(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                body = self._body()
+                if self.path == "/eth/v1/keystores":
+                    statuses = []
+                    for ks_json, password in zip(
+                        body.get("keystores", []),
+                        body.get("passwords", []),
+                    ):
+                        try:
+                            ks = Keystore.from_json(
+                                ks_json
+                                if isinstance(ks_json, str)
+                                else json.dumps(ks_json)
+                            )
+                            sk_bytes = ks.decrypt(password)
+                            sk = bls.SecretKey.from_bytes(sk_bytes)
+                            km.store.add_local_validator(sk)
+                            statuses.append({"status": "imported"})
+                        except Exception as e:  # bad keystore/password
+                            statuses.append(
+                                {"status": "error", "message": str(e)}
+                            )
+                    return self._send(200, {"data": statuses})
+                if self.path == "/eth/v1/remotekeys":
+                    statuses = []
+                    for rk in body.get("remote_keys", []):
+                        try:
+                            pk = bytes.fromhex(rk["pubkey"][2:])
+                            km.store.add_remote_validator(
+                                Web3SignerClient(rk["url"], pk)
+                            )
+                            statuses.append({"status": "imported"})
+                        except Exception as e:
+                            statuses.append(
+                                {"status": "error", "message": str(e)}
+                            )
+                    return self._send(200, {"data": statuses})
+                return self._send(404, {"message": "not found"})
+
+            def do_DELETE(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                body = self._body()
+                path_ok = self.path in (
+                    "/eth/v1/keystores",
+                    "/eth/v1/remotekeys",
+                )
+                if not path_ok:
+                    return self._send(404, {"message": "not found"})
+                statuses = []
+                for pk_hex in body.get("pubkeys", []):
+                    pk = bytes.fromhex(pk_hex[2:])
+                    if pk in km.store.validators:
+                        km.store.remove_validator(pk)
+                        statuses.append({"status": "deleted"})
+                    else:
+                        statuses.append({"status": "not_found"})
+                resp = {"data": statuses}
+                if self.path == "/eth/v1/keystores":
+                    # deletion exports the slashing-protection history for
+                    # the removed keys (keymanager spec)
+                    resp["slashing_protection"] = (
+                        km.store.slashing_db.export_interchange(b"\x00" * 32)
+                    )
+                return self._send(200, resp)
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        ).start()
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def shutdown(self):
+        self.server.shutdown()
+        self.server.server_close()

@@ -1,0 +1,127 @@
+"""Signing methods: local keystore vs remote Web3Signer.
+
+Role of validator_client/src/signing_method.rs: every signature the VC
+produces goes through a SigningMethod — either a locally-held secret key
+(decrypted EIP-2335 keystore) or an HTTP request to a Web3Signer-style
+remote signer. A mock Web3Signer server (testing/web3signer_tests analog)
+lives here for in-process tests.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+import http.client
+
+from lighthouse_tpu import bls
+
+
+class SigningError(Exception):
+    pass
+
+
+class LocalKeystoreSigner:
+    """Sign with an in-memory secret key (Lighthouse SigningMethod::
+    LocalKeystore after decryption)."""
+
+    def __init__(self, sk):
+        self.sk = sk
+        self.pubkey = sk.public_key().to_bytes()
+
+    def sign(self, signing_root: bytes) -> bytes:
+        return self.sk.sign(signing_root).to_bytes()
+
+
+class Web3SignerClient:
+    """Remote signer speaking the Web3Signer REST API
+    (SigningMethod::Web3Signer; POST /api/v1/eth2/sign/{pubkey})."""
+
+    def __init__(self, url: str, pubkey: bytes, timeout: float = 5.0):
+        self.url = url
+        self.pubkey = pubkey
+        self.timeout = timeout
+
+    def sign(self, signing_root: bytes) -> bytes:
+        u = urlparse(self.url)
+        conn = http.client.HTTPConnection(
+            u.hostname, u.port, timeout=self.timeout
+        )
+        body = json.dumps(
+            {"signingRoot": "0x" + signing_root.hex()}
+        ).encode()
+        try:
+            conn.request(
+                "POST",
+                f"/api/v1/eth2/sign/0x{self.pubkey.hex()}",
+                body,
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise SigningError(
+                    f"web3signer {resp.status}: {data[:200]!r}"
+                )
+        except OSError as e:
+            raise SigningError(f"web3signer transport: {e}") from e
+        finally:
+            conn.close()
+        sig = json.loads(data)["signature"]
+        return bytes.fromhex(sig[2:])
+
+
+class MockWeb3Signer:
+    """In-process Web3Signer: holds secret keys, signs over HTTP
+    (testing/web3signer_tests boots the real Java signer; this is the
+    deterministic in-process equivalent)."""
+
+    def __init__(self, secret_keys):
+        """secret_keys: iterable of bls secret keys."""
+        self.keys = {
+            sk.public_key().to_bytes(): sk for sk in secret_keys
+        }
+        keys = self.keys
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                parts = self.path.rstrip("/").split("/")
+                if len(parts) < 2 or parts[-2] != "sign":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                pubkey = bytes.fromhex(parts[-1][2:])
+                sk = keys.get(pubkey)
+                if sk is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length))
+                root = bytes.fromhex(req["signingRoot"][2:])
+                sig = sk.sign(root).to_bytes()
+                data = json.dumps({"signature": "0x" + sig.hex()}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        ).start()
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def client_for(self, pubkey: bytes) -> Web3SignerClient:
+        return Web3SignerClient(self.url, pubkey)
+
+    def shutdown(self):
+        self.server.shutdown()
+        self.server.server_close()

@@ -1,0 +1,120 @@
+"""ValidatorStore: signing-method registry + slashing-protection gating.
+
+Role of validator_client/src/validator_store.rs (858 LoC) +
+initialized_validators.rs: the one place every signature is produced —
+look up the validator's signing method (local key or Web3Signer), run the
+slashing-protection check for blocks/attestations, respect doppelganger
+gating, then sign.
+"""
+
+from dataclasses import dataclass
+
+from lighthouse_tpu.validator_client.signing_method import (
+    LocalKeystoreSigner,
+    SigningError,
+)
+from lighthouse_tpu.validator_client.slashing_protection import (
+    SlashingError,
+    SlashingProtectionDB,
+)
+
+
+@dataclass
+class InitializedValidator:
+    pubkey: bytes
+    signer: object  # LocalKeystoreSigner | Web3SignerClient
+    enabled: bool = True
+    index: int | None = None
+
+
+class ValidatorStore:
+    def __init__(
+        self,
+        slashing_db: SlashingProtectionDB | None = None,
+        doppelganger_epochs: int = 0,
+    ):
+        self.validators: dict[bytes, InitializedValidator] = {}
+        self.slashing_db = slashing_db or SlashingProtectionDB()
+        self.doppelganger_epochs = doppelganger_epochs
+        self._started_epoch: int | None = None
+        self.metrics = {"signed": 0, "blocked": 0}
+
+    # ---------------------------------------------------------- registry
+
+    def add_local_validator(self, sk, index: int | None = None):
+        signer = LocalKeystoreSigner(sk)
+        v = InitializedValidator(
+            pubkey=signer.pubkey, signer=signer, index=index
+        )
+        self.validators[signer.pubkey] = v
+        return v
+
+    def add_remote_validator(self, client, index: int | None = None):
+        v = InitializedValidator(
+            pubkey=client.pubkey, signer=client, index=index
+        )
+        self.validators[client.pubkey] = v
+        return v
+
+    def remove_validator(self, pubkey: bytes):
+        self.validators.pop(pubkey, None)
+
+    def voting_pubkeys(self):
+        return [v.pubkey for v in self.validators.values() if v.enabled]
+
+    # ----------------------------------------------------- doppelganger
+
+    def signing_enabled(self, epoch: int) -> bool:
+        if self._started_epoch is None:
+            self._started_epoch = epoch
+        return epoch >= self._started_epoch + self.doppelganger_epochs
+
+    # ------------------------------------------------------------- signing
+
+    def _signer_for(self, pubkey: bytes):
+        v = self.validators.get(pubkey)
+        if v is None or not v.enabled:
+            raise SigningError("unknown or disabled validator")
+        return v.signer
+
+    def sign_block(
+        self, pubkey: bytes, slot: int, block_root: bytes,
+        signing_root: bytes,
+    ) -> bytes:
+        """Slashing-protection-checked proposal signature."""
+        try:
+            self.slashing_db.check_and_insert_block(
+                pubkey, slot, block_root
+            )
+        except SlashingError:
+            self.metrics["blocked"] += 1
+            raise
+        sig = self._signer_for(pubkey).sign(signing_root)
+        self.metrics["signed"] += 1
+        return sig
+
+    def sign_attestation(
+        self,
+        pubkey: bytes,
+        source_epoch: int,
+        target_epoch: int,
+        att_root: bytes,
+        signing_root: bytes,
+    ) -> bytes:
+        try:
+            self.slashing_db.check_and_insert_attestation(
+                pubkey, source_epoch, target_epoch, att_root
+            )
+        except SlashingError:
+            self.metrics["blocked"] += 1
+            raise
+        sig = self._signer_for(pubkey).sign(signing_root)
+        self.metrics["signed"] += 1
+        return sig
+
+    def sign_unprotected(self, pubkey: bytes, signing_root: bytes) -> bytes:
+        """Randao reveals, selection proofs, sync messages, exits —
+        signatures outside the slashing-protection domains."""
+        sig = self._signer_for(pubkey).sign(signing_root)
+        self.metrics["signed"] += 1
+        return sig

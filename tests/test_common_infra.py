@@ -144,3 +144,46 @@ def test_deposit_tree_proofs():
     # root changes as deposits append
     tree.push(b"\x09" * 32)
     assert tree.root() != root
+
+
+def test_monitoring_service_ships_snapshots():
+    """Remote telemetry POSTs the monitoring-service JSON shape
+    (common/monitoring_api lib.rs)."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from lighthouse_tpu.common.monitoring import MonitoringService
+
+    received = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        host, port = srv.server_address[:2]
+        mon = MonitoringService(f"http://{host}:{port}/api")
+        assert mon.send_once()
+        assert mon.sends == 1
+        body = received[0][0]
+        assert body["process"] == "beaconnode"
+        assert body["client_name"] == "lighthouse-tpu"
+        assert "memory_process_bytes" in body
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    # unreachable endpoint counts an error, does not raise
+    mon2 = MonitoringService("http://127.0.0.1:1/api", timeout=0.3)
+    assert not mon2.send_once()
+    assert mon2.errors == 1

@@ -1,0 +1,232 @@
+"""Validator-client subsystems: signing methods (local + Web3Signer),
+validator store gating, multi-BN fallback, keymanager API, EIP-2386
+wallet.
+
+Mirrors validator_client/src/{signing_method,validator_store,
+beacon_node_fallback,http_api}.rs and crypto/eth2_wallet coverage."""
+
+import json
+import http.client
+from urllib.parse import urlparse
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.accounts.wallet import Wallet
+from lighthouse_tpu.validator_client.beacon_node_fallback import (
+    AllNodesFailed,
+    BeaconNodeFallback,
+    CandidateHealth,
+)
+from lighthouse_tpu.validator_client.keymanager_api import KeymanagerServer
+from lighthouse_tpu.validator_client.signing_method import (
+    LocalKeystoreSigner,
+    MockWeb3Signer,
+    SigningError,
+)
+from lighthouse_tpu.validator_client.slashing_protection import SlashingError
+from lighthouse_tpu.validator_client.validator_store import ValidatorStore
+
+
+def _sk(i: int):
+    return bls.interop_keypairs(i + 1)[i].sk
+
+
+def test_local_and_web3signer_agree():
+    sk = _sk(0)
+    root = b"\x11" * 32
+    local = LocalKeystoreSigner(sk).sign(root)
+    signer = MockWeb3Signer([sk])
+    try:
+        remote = signer.client_for(sk.public_key().to_bytes()).sign(root)
+    finally:
+        signer.shutdown()
+    assert local == remote
+
+
+def test_web3signer_unknown_key_errors():
+    signer = MockWeb3Signer([_sk(0)])
+    try:
+        other = _sk(1).public_key().to_bytes()
+        with pytest.raises(SigningError):
+            signer.client_for(other).sign(b"\x22" * 32)
+    finally:
+        signer.shutdown()
+
+
+def test_validator_store_slashing_gate():
+    store = ValidatorStore()
+    sk = _sk(0)
+    v = store.add_local_validator(sk)
+    sig1 = store.sign_block(v.pubkey, 5, b"\xaa" * 32, b"\x01" * 32)
+    assert len(sig1) == 96
+    # same slot, different root -> double proposal blocked
+    with pytest.raises(SlashingError):
+        store.sign_block(v.pubkey, 5, b"\xbb" * 32, b"\x02" * 32)
+    # surround-vote attestation blocked
+    store.sign_attestation(v.pubkey, 2, 5, b"\xcc" * 32, b"\x03" * 32)
+    with pytest.raises(SlashingError):
+        store.sign_attestation(v.pubkey, 1, 6, b"\xdd" * 32, b"\x04" * 32)
+    assert store.metrics["blocked"] == 2
+
+
+def test_validator_store_doppelganger_gate():
+    store = ValidatorStore(doppelganger_epochs=2)
+    assert not store.signing_enabled(10)
+    assert not store.signing_enabled(11)
+    assert store.signing_enabled(12)
+
+
+class _FakeBN:
+    def __init__(self, distance=0, fail=False):
+        self.distance = distance
+        self.fail = fail
+        self.calls = 0
+
+    def syncing(self):
+        if self.fail:
+            raise ConnectionError("down")
+        return {
+            "is_syncing": self.distance > 0,
+            "sync_distance": self.distance,
+        }
+
+    def do_thing(self):
+        self.calls += 1
+        if self.fail:
+            raise ConnectionError("down")
+        return self.distance
+
+
+def test_beacon_node_fallback_prefers_healthy():
+    synced, behind, dead = _FakeBN(0), _FakeBN(100), _FakeBN(fail=True)
+    fb = BeaconNodeFallback.from_clients([dead, behind, synced])
+    fb.update_health()
+    assert fb.candidates[0].health == CandidateHealth.OFFLINE
+    assert fb.candidates[1].health == CandidateHealth.SYNCING
+    assert fb.candidates[2].health == CandidateHealth.HEALTHY
+    # healthy node is asked first despite being listed last
+    assert fb.first_success(lambda c: c.do_thing()) == 0
+    assert synced.calls == 1 and behind.calls == 0
+
+
+def test_beacon_node_fallback_all_fail():
+    fb = BeaconNodeFallback.from_clients([_FakeBN(fail=True)])
+    fb.update_health()
+    with pytest.raises(AllNodesFailed):
+        fb.first_success(lambda c: c.do_thing())
+
+
+def _km_request(server, method, path, body=None, token=None):
+    u = urlparse(server.url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=5)
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = "Bearer " + token
+    conn.request(
+        method, path, json.dumps(body or {}).encode(), headers
+    )
+    resp = conn.getresponse()
+    data = json.loads(resp.read() or b"{}")
+    conn.close()
+    return resp.status, data
+
+
+def test_keymanager_api_auth_and_remotekeys():
+    store = ValidatorStore()
+    km = KeymanagerServer(store)
+    signer = MockWeb3Signer([_sk(0)])
+    try:
+        # no token -> 401
+        status, _ = _km_request(km, "GET", "/eth/v1/keystores")
+        assert status == 401
+        # import a remote key
+        pk = _sk(0).public_key().to_bytes()
+        status, data = _km_request(
+            km,
+            "POST",
+            "/eth/v1/remotekeys",
+            {
+                "remote_keys": [
+                    {"pubkey": "0x" + pk.hex(), "url": signer.url}
+                ]
+            },
+            token=km.api_token,
+        )
+        assert status == 200
+        assert data["data"][0]["status"] == "imported"
+        status, data = _km_request(
+            km, "GET", "/eth/v1/remotekeys", token=km.api_token
+        )
+        assert data["data"][0]["pubkey"] == "0x" + pk.hex()
+        # the imported remote key can sign through the store
+        sig = store.sign_unprotected(pk, b"\x07" * 32)
+        assert len(sig) == 96
+        # delete it
+        status, data = _km_request(
+            km,
+            "DELETE",
+            "/eth/v1/remotekeys",
+            {"pubkeys": ["0x" + pk.hex()]},
+            token=km.api_token,
+        )
+        assert data["data"][0]["status"] == "deleted"
+        assert not store.validators
+    finally:
+        signer.shutdown()
+        km.shutdown()
+
+
+def test_keymanager_keystore_import_roundtrip():
+    from lighthouse_tpu.accounts.keystore import Keystore
+
+    store = ValidatorStore()
+    km = KeymanagerServer(store)
+    try:
+        sk = _sk(3)
+        ks = Keystore.encrypt(
+            sk.to_bytes(), "pass123", kdf="pbkdf2",
+            pubkey=sk.public_key().to_bytes(),
+        )
+        status, data = _km_request(
+            km,
+            "POST",
+            "/eth/v1/keystores",
+            {"keystores": [ks.to_json()], "passwords": ["pass123"]},
+            token=km.api_token,
+        )
+        assert status == 200
+        assert data["data"][0]["status"] == "imported"
+        pk = sk.public_key().to_bytes()
+        assert pk in store.validators
+        # wrong password reports error, does not import
+        status, data = _km_request(
+            km,
+            "POST",
+            "/eth/v1/keystores",
+            {"keystores": [ks.to_json()], "passwords": ["wrong"]},
+            token=km.api_token,
+        )
+        assert data["data"][0]["status"] == "error"
+    finally:
+        km.shutdown()
+
+
+def test_wallet_derives_distinct_validators():
+    w = Wallet.create("w1", "wpass", seed=b"\x05" * 32)
+    i0, ks0, wd0 = w.next_validator("wpass", "vpass")
+    i1, ks1, wd1 = w.next_validator("wpass", "vpass")
+    assert (i0, i1) == (0, 1)
+    assert w.nextaccount == 2
+    assert ks0.pubkey_hex != ks1.pubkey_hex
+    assert wd0 != wd1
+    # voting keystore decrypts back to a signing key at the right path
+    sk_bytes = ks0.decrypt("vpass")
+    sk = bls.SecretKey.from_bytes(sk_bytes)
+    assert sk.public_key().to_bytes().hex() == ks0.pubkey_hex
+    assert ks0.path == "m/12381/3600/0/0/0"
+    # wallet JSON roundtrip preserves the counter
+    w2 = Wallet.from_json(w.to_json())
+    assert w2.nextaccount == 2
+    i2, _, _ = w2.next_validator("wpass", "vpass")
+    assert i2 == 2
