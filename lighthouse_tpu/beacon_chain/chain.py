@@ -484,6 +484,48 @@ class BeaconChain:
             )
             self.recompute_head()
 
+    def revert_to_fork_boundary(self, fork_epoch: int) -> bytes:
+        """Recover a node that followed the wrong side of a hard fork:
+        reset the head to the latest canonical block BEFORE the fork
+        boundary and rebuild fork choice anchored there
+        (fork_revert.rs:24 revert_to_fork_boundary — the reference also
+        re-initializes fork choice from the revert point). Returns the
+        revert-point root; post-boundary blocks must be re-synced."""
+        spec = self.spec
+        boundary_slot = spec.epoch_start_slot(fork_epoch)
+        for slot in range(boundary_slot - 1, -1, -1):
+            root = self.store.get_canonical_block_root(slot)
+            if root is None:
+                continue
+            state = self.store.state_at_slot(slot)
+            if state is None:
+                continue
+            # wrong-fork blocks: purge store index + import caches so the
+            # correct chain can re-import from the boundary
+            for s in range(boundary_slot, self.fork_choice.current_slot + 1):
+                stale = self.store.get_canonical_block_root(s)
+                if stale is not None:
+                    self._snapshots.pop(stale, None)
+                self.store.clear_canonical_block_root(s)
+            # fork choice anchored at the revert point (reference rebuilds
+            # from store; wrong-fork nodes must not win the next get_head)
+            justified = (spec.slot_to_epoch(slot), root)
+            finalized = (spec.slot_to_epoch(slot), root)
+            self.fork_choice = type(self.fork_choice)(
+                root, slot, justified, finalized, spec
+            )
+            # observation caches saw the wrong-fork blocks; a reverted
+            # node restarts its gossip view (the reference reverts via
+            # process restart, which clears them implicitly)
+            self.observed_block_producers = type(
+                self.observed_block_producers
+            )()
+            self.head_root = root
+            self.head_state = state
+            self._cache_snapshot(root, state)
+            return root
+        raise BlockError("no pre-fork block available to revert to")
+
     def _cache_snapshot(self, root: bytes, state):
         self._snapshots[root] = state
         self._snapshot_order.append(root)

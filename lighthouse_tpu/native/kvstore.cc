@@ -1,0 +1,257 @@
+// Native durable column-family KV store.
+//
+// Role of the reference's LevelDB backend (beacon_node/store/src/
+// leveldb_store.rs over leveldb-sys C++): a byte-keyed, column-family
+// store with batched atomic writes and crash recovery. Design: one
+// append-only log file + an in-memory hash index rebuilt on open;
+// explicit compaction rewrites the live set (the reference triggers
+// LevelDB compaction after finalization migrations — migrate.rs:21-26).
+//
+// Record framing (little-endian u32 lengths, 1-byte op):
+//   [op][col_len][key_len][val_len][col][key][val]   op: 1=put 2=del
+// A record is only honored on replay if fully present (torn tail
+// records from a crash are ignored).
+
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct ColumnKey {
+  std::string col, key;
+  bool operator==(const ColumnKey& o) const {
+    return col == o.col && key == o.key;
+  }
+};
+
+struct ColumnKeyHash {
+  size_t operator()(const ColumnKey& ck) const {
+    std::hash<std::string> h;
+    return h(ck.col) * 1000003u ^ h(ck.key);
+  }
+};
+
+struct Store {
+  std::string path;
+  FILE* log = nullptr;
+  std::unordered_map<ColumnKey, std::string, ColumnKeyHash> data;
+  uint64_t log_records = 0;
+};
+
+void append_u32(std::string& out, uint32_t v) {
+  out.append(reinterpret_cast<const char*>(&v), 4);
+}
+
+bool read_exact(FILE* f, void* buf, size_t n) {
+  return fread(buf, 1, n, f) == n;
+}
+
+std::string frame(uint8_t op, const std::string& col, const std::string& key,
+                  const std::string& val) {
+  std::string rec;
+  rec.push_back(static_cast<char>(op));
+  append_u32(rec, static_cast<uint32_t>(col.size()));
+  append_u32(rec, static_cast<uint32_t>(key.size()));
+  append_u32(rec, static_cast<uint32_t>(val.size()));
+  rec += col;
+  rec += key;
+  rec += val;
+  return rec;
+}
+
+bool replay(Store* s) {
+  FILE* f = fopen(s->path.c_str(), "rb");
+  if (!f) return true;  // fresh store
+  long valid_end = 0;
+  for (;;) {
+    uint8_t op;
+    uint32_t cl, kl, vl;
+    if (!read_exact(f, &op, 1)) break;
+    if (!read_exact(f, &cl, 4) || !read_exact(f, &kl, 4) ||
+        !read_exact(f, &vl, 4))
+      break;  // torn header
+    std::string col(cl, '\0'), key(kl, '\0'), val(vl, '\0');
+    if ((cl && !read_exact(f, col.data(), cl)) ||
+        (kl && !read_exact(f, key.data(), kl)) ||
+        (vl && !read_exact(f, val.data(), vl)))
+      break;  // torn body
+    if (op == 1) {
+      s->data[ColumnKey{col, key}] = val;
+    } else if (op == 2) {
+      s->data.erase(ColumnKey{col, key});
+    } else {
+      break;  // corrupt stream
+    }
+    s->log_records++;
+    valid_end = ftell(f);
+  }
+  fclose(f);
+  // drop any torn tail so future appends land after the valid prefix
+  if (truncate(s->path.c_str(), valid_end) != 0) return false;
+  return true;
+}
+
+bool write_all(Store* s, const std::string& bytes) {
+  if (fwrite(bytes.data(), 1, bytes.size(), s->log) != bytes.size())
+    return false;
+  return fflush(s->log) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* path) {
+  Store* s = new Store();
+  s->path = path;
+  if (!replay(s)) {
+    delete s;
+    return nullptr;
+  }
+  s->log = fopen(path, "ab");
+  if (!s->log) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int kv_put(void* h, const char* col, uint32_t cl, const char* key,
+           uint32_t kl, const char* val, uint32_t vl) {
+  Store* s = static_cast<Store*>(h);
+  std::string c(col, cl), k(key, kl), v(val, vl);
+  if (!write_all(s, frame(1, c, k, v))) return -1;
+  s->data[ColumnKey{c, k}] = v;
+  s->log_records++;
+  return 0;
+}
+
+// batch: ops/cols/keys/vals flattened; one buffered write = atomic-enough
+// (a torn tail drops only trailing records on replay, preserving prefix
+// semantics like a LevelDB WriteBatch under crash).
+int kv_put_batch(void* h, uint32_t n, const uint8_t* ops,
+                 const char* const* cols, const uint32_t* cls,
+                 const char* const* keys, const uint32_t* kls,
+                 const char* const* vals, const uint32_t* vls) {
+  Store* s = static_cast<Store*>(h);
+  std::string buf;
+  for (uint32_t i = 0; i < n; i++) {
+    buf += frame(ops[i], std::string(cols[i], cls[i]),
+                 std::string(keys[i], kls[i]),
+                 std::string(vals[i] ? vals[i] : "", vls[i]));
+  }
+  if (!write_all(s, buf)) return -1;
+  for (uint32_t i = 0; i < n; i++) {
+    ColumnKey ck{std::string(cols[i], cls[i]), std::string(keys[i], kls[i])};
+    if (ops[i] == 1) {
+      s->data[ck] = std::string(vals[i] ? vals[i] : "", vls[i]);
+    } else {
+      s->data.erase(ck);
+    }
+    s->log_records++;
+  }
+  return 0;
+}
+
+// returns 1 + fills *out/*out_len (malloc'd) when present, 0 when absent
+int kv_get(void* h, const char* col, uint32_t cl, const char* key,
+           uint32_t kl, char** out, uint32_t* out_len) {
+  Store* s = static_cast<Store*>(h);
+  auto it = s->data.find(ColumnKey{std::string(col, cl), std::string(key, kl)});
+  if (it == s->data.end()) return 0;
+  *out_len = static_cast<uint32_t>(it->second.size());
+  *out = static_cast<char*>(malloc(it->second.size() ? it->second.size() : 1));
+  memcpy(*out, it->second.data(), it->second.size());
+  return 1;
+}
+
+int kv_delete(void* h, const char* col, uint32_t cl, const char* key,
+              uint32_t kl) {
+  Store* s = static_cast<Store*>(h);
+  std::string c(col, cl), k(key, kl);
+  if (!write_all(s, frame(2, c, k, ""))) return -1;
+  s->data.erase(ColumnKey{c, k});
+  s->log_records++;
+  return 0;
+}
+
+// serialize all keys of a column as [u32 len][key]... into a malloc'd buffer
+int kv_keys(void* h, const char* col, uint32_t cl, char** out,
+            uint32_t* out_len, uint32_t* count) {
+  Store* s = static_cast<Store*>(h);
+  std::string c(col, cl);
+  std::string buf;
+  uint32_t n = 0;
+  for (auto& kv : s->data) {
+    if (kv.first.col != c) continue;
+    append_u32(buf, static_cast<uint32_t>(kv.first.key.size()));
+    buf += kv.first.key;
+    n++;
+  }
+  *out_len = static_cast<uint32_t>(buf.size());
+  *out = static_cast<char*>(malloc(buf.size() ? buf.size() : 1));
+  memcpy(*out, buf.data(), buf.size());
+  *count = n;
+  return 0;
+}
+
+uint64_t kv_record_count(void* h) {
+  return static_cast<Store*>(h)->log_records;
+}
+
+uint64_t kv_live_count(void* h) {
+  return static_cast<Store*>(h)->data.size();
+}
+
+// rewrite the log with only live records (LevelDB compaction analog)
+int kv_compact(void* h) {
+  Store* s = static_cast<Store*>(h);
+  std::string tmp_path = s->path + ".compact";
+  FILE* tmp = fopen(tmp_path.c_str(), "wb");
+  if (!tmp) return -1;
+  std::string buf;
+  for (auto& kv : s->data) {
+    buf += frame(1, kv.first.col, kv.first.key, kv.second);
+    if (buf.size() > (1u << 20)) {
+      if (fwrite(buf.data(), 1, buf.size(), tmp) != buf.size()) {
+        fclose(tmp);
+        return -1;
+      }
+      buf.clear();
+    }
+  }
+  if (!buf.empty() && fwrite(buf.data(), 1, buf.size(), tmp) != buf.size()) {
+    fclose(tmp);
+    return -1;
+  }
+  if (fflush(tmp) != 0) {
+    fclose(tmp);
+    return -1;
+  }
+  fclose(tmp);
+  // rename BEFORE touching the live log: on failure the store keeps
+  // appending to the old (still-open) log and stays fully usable.
+  if (rename(tmp_path.c_str(), s->path.c_str()) != 0) return -1;
+  fclose(s->log);
+  s->log = fopen(s->path.c_str(), "ab");
+  s->log_records = s->data.size();
+  return s->log ? 0 : -1;
+}
+
+void kv_free(char* p) { free(p); }
+
+void kv_close(void* h) {
+  Store* s = static_cast<Store*>(h);
+  if (s->log) fclose(s->log);
+  delete s;
+}
+
+}  // extern "C"

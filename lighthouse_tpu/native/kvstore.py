@@ -1,0 +1,203 @@
+"""ctypes bindings for the native C++ KV store (kvstore.cc) + a
+KVStore-compatible wrapper.
+
+The native backend fills the role of the reference's LevelDB (C++)
+store; `NativeKVStore` plugs into `HotColdDB` exactly like MemoryStore /
+SqliteStore. Build is on-demand with the system toolchain (no pip).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(__file__)
+_SO = os.path.join(_HERE, "_kvstore.so")
+_SRC = os.path.join(_HERE, "kvstore.cc")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def build(force: bool = False) -> bool:
+    """Compile the shared library with g++; returns True on success."""
+    if os.path.exists(_SO) and not force:
+        return True
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        return True
+    except (subprocess.CalledProcessError, OSError):
+        return False
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not build():
+            raise RuntimeError("native kvstore build failed")
+        lib = ctypes.CDLL(_SO)
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_open.argtypes = [ctypes.c_char_p]
+        lib.kv_put.restype = ctypes.c_int
+        lib.kv_put.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.kv_put_batch.restype = ctypes.c_int
+        lib.kv_put_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.kv_get.restype = ctypes.c_int
+        lib.kv_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.kv_delete.restype = ctypes.c_int
+        lib.kv_delete.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.kv_keys.restype = ctypes.c_int
+        lib.kv_keys.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.kv_record_count.restype = ctypes.c_uint64
+        lib.kv_record_count.argtypes = [ctypes.c_void_p]
+        lib.kv_live_count.restype = ctypes.c_uint64
+        lib.kv_live_count.argtypes = [ctypes.c_void_p]
+        lib.kv_compact.restype = ctypes.c_int
+        lib.kv_compact.argtypes = [ctypes.c_void_p]
+        lib.kv_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except (RuntimeError, OSError):
+        return False
+
+
+class NativeKVStore:
+    """KVStore backed by the C++ append-log store. Thread-safe via a
+    coarse lock (the reference serializes writes through LevelDB too)."""
+
+    def __init__(self, path: str):
+        self._lib = _load()
+        self._h = self._lib.kv_open(path.encode())
+        if not self._h:
+            raise RuntimeError(f"kv_open failed for {path}")
+        self._lock = threading.Lock()
+
+    def get(self, column: bytes, key: bytes):
+        out = ctypes.POINTER(ctypes.c_char)()
+        out_len = ctypes.c_uint32()
+        with self._lock:
+            found = self._lib.kv_get(
+                self._h, column, len(column), key, len(key),
+                ctypes.byref(out), ctypes.byref(out_len),
+            )
+            if not found:
+                return None
+            try:
+                return ctypes.string_at(out, out_len.value)
+            finally:
+                self._lib.kv_free(out)
+
+    def put(self, column: bytes, key: bytes, value: bytes) -> None:
+        value = bytes(value)
+        with self._lock:
+            rc = self._lib.kv_put(
+                self._h, column, len(column), key, len(key), value,
+                len(value),
+            )
+        if rc != 0:
+            raise IOError("kv_put failed")
+
+    def delete(self, column: bytes, key: bytes) -> None:
+        with self._lock:
+            rc = self._lib.kv_delete(
+                self._h, column, len(column), key, len(key)
+            )
+        if rc != 0:
+            raise IOError("kv_delete failed")
+
+    def put_batch(self, items) -> None:
+        items = [(c, k, bytes(v)) for c, k, v in items]
+        n = len(items)
+        if n == 0:
+            return
+        ops = (ctypes.c_uint8 * n)(*([1] * n))
+        cols = (ctypes.c_char_p * n)(*[c for c, _, _ in items])
+        cls_ = (ctypes.c_uint32 * n)(*[len(c) for c, _, _ in items])
+        keys = (ctypes.c_char_p * n)(*[k for _, k, _ in items])
+        kls = (ctypes.c_uint32 * n)(*[len(k) for _, k, _ in items])
+        vals = (ctypes.c_char_p * n)(*[v for _, _, v in items])
+        vls = (ctypes.c_uint32 * n)(*[len(v) for _, _, v in items])
+        with self._lock:
+            rc = self._lib.kv_put_batch(
+                self._h, n, ops, cols, cls_, keys, kls, vals, vls
+            )
+        if rc != 0:
+            raise IOError("kv_put_batch failed")
+
+    def keys(self, column: bytes):
+        out = ctypes.POINTER(ctypes.c_char)()
+        out_len = ctypes.c_uint32()
+        count = ctypes.c_uint32()
+        with self._lock:
+            self._lib.kv_keys(
+                self._h, column, len(column),
+                ctypes.byref(out), ctypes.byref(out_len),
+                ctypes.byref(count),
+            )
+            try:
+                blob = ctypes.string_at(out, out_len.value)
+            finally:
+                self._lib.kv_free(out)
+        keys, off = [], 0
+        for _ in range(count.value):
+            klen = int.from_bytes(blob[off : off + 4], "little")
+            off += 4
+            keys.append(blob[off : off + klen])
+            off += klen
+        return keys
+
+    def compact(self) -> None:
+        with self._lock:
+            if self._lib.kv_compact(self._h) != 0:
+                raise IOError("kv_compact failed")
+
+    def stats(self):
+        with self._lock:
+            return {
+                "log_records": self._lib.kv_record_count(self._h),
+                "live_records": self._lib.kv_live_count(self._h),
+            }
+
+    def close(self):
+        with self._lock:
+            if self._h:
+                self._lib.kv_close(self._h)
+                self._h = None

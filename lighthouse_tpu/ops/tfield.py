@@ -71,9 +71,45 @@ def _relax(x, out_len, passes=3):
     return x
 
 
-def _const_col(limbs):
-    """Static limb list -> (len, 1) column broadcastable over (..., L, B)."""
+import contextlib
+
+# Inside a Pallas kernel, captured array constants are not allowed — the
+# kernel passes them as inputs and installs them here for the duration of
+# its trace (see ops.pallas_miller). Keys: "off", "spread_sub", "comp_2p",
+# "one".
+_CONST_OVERRIDES: dict = {}
+
+
+_MISSING = object()
+
+
+@contextlib.contextmanager
+def const_overrides(**cols):
+    """Reentrant: saves and restores any previously-installed value per
+    key, so nested kernel traces cannot leak each other's tracers."""
+    prev = {k: _CONST_OVERRIDES.get(k, _MISSING) for k in cols}
+    _CONST_OVERRIDES.update(cols)
+    try:
+        yield
+    finally:
+        for k, v in prev.items():
+            if v is _MISSING:
+                _CONST_OVERRIDES.pop(k, None)
+            else:
+                _CONST_OVERRIDES[k] = v
+
+
+def _const_col(limbs, name=None):
+    """Static limb list -> (len, 1) column broadcastable over (..., L, B);
+    an installed override (a traced in-kernel value) takes precedence."""
+    if name is not None and name in _CONST_OVERRIDES:
+        return _CONST_OVERRIDES[name]
     return jnp.asarray(np.array(limbs, dtype=np.int32)[:, None])
+
+
+def one_col():
+    """Montgomery 1 as a (NB, 1) column."""
+    return _const_col(list(fb.ONE_MONT_B), "one")
 
 
 def reduce_small(x):
@@ -81,10 +117,19 @@ def reduce_small(x):
     top two limbs, subtract q*2p via the 2^396-complement."""
     t2 = x[..., NB - 1, :] * (1 << LIMB_BITS) + x[..., NB - 2, :]
     q = t2 // 833
-    return _relax(x + q[..., None, :] * _const_col(_COMP_2P), NB)
+    return _relax(x + q[..., None, :] * _const_col(_COMP_2P, "comp_2p"), NB)
 
 
 # ------------------------------------------------------------- multiplies
+
+
+def _shift_pad(x, lo: int, total: int):
+    """Place x at limb offset `lo` within a length-`total` limb axis.
+    Pad-and-sum composition (NO .at[] scatter updates: those lower to
+    scatter-add with empty index constants, which Pallas kernels reject)."""
+    pad = [(0, 0)] * x.ndim
+    pad[-2] = (lo, total - lo - x.shape[-2])
+    return jnp.pad(x, pad)
 
 
 def mul_lazy(a, b):
@@ -95,35 +140,31 @@ def mul_lazy(a, b):
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)
-    tshape = shape[:-2] + (2 * NB, shape[-1])
-    t = jnp.zeros(tshape, dtype=jnp.int32)
-    for i in range(NB):
-        t = t.at[..., i : i + NB, :].add(a[..., i : i + 1, :] * b)
+    t = sum(
+        _shift_pad(a[..., i : i + 1, :] * b, i, 2 * NB) for i in range(NB)
+    )
     t = _relax(t, 2 * NB)
 
     t_low = t[..., :NLIMBS, :]
-    m = jnp.zeros(shape[:-2] + (NLIMBS, shape[-1]), dtype=jnp.int32)
-    for j in range(NLIMBS):
-        npj = _NPRIME[j]
-        if npj == 0:
-            continue
-        # shift t_low up by j limbs, truncated at NLIMBS (mod R)
-        m = m.at[..., j:, :].add(npj * t_low[..., : NLIMBS - j, :])
+    # shift t_low up by j limbs, truncated at NLIMBS (mod R)
+    m = sum(
+        _shift_pad(_NPRIME[j] * t_low[..., : NLIMBS - j, :], j, NLIMBS)
+        for j in range(NLIMBS)
+        if _NPRIME[j] != 0
+    )
     m = _relax(m, NLIMBS)
 
-    mp = jnp.zeros(shape[:-2] + (2 * NLIMBS - 1, shape[-1]), dtype=jnp.int32)
-    for j in range(NLIMBS):
-        pj = _PLIMBS[j]
-        if pj == 0:
-            continue
-        mp = mp.at[..., j : j + NLIMBS, :].add(pj * m)
-    pad = [(0, 0)] * len(tshape)
-    pad[-2] = (0, 2 * NB - (2 * NLIMBS - 1))
-    full = _relax(t + jnp.pad(mp, pad), 2 * NB)
+    mp = sum(
+        _shift_pad(_PLIMBS[j] * m, j, 2 * NLIMBS - 1)
+        for j in range(NLIMBS)
+        if _PLIMBS[j] != 0
+    )
+    full = _relax(t + _shift_pad(mp, 0, 2 * NB), 2 * NB)
 
     low_nonzero = jnp.any(full[..., :NLIMBS, :] != 0, axis=-2)
     out = full[..., NLIMBS : NLIMBS + NB, :]
-    return out.at[..., 0, :].add(low_nonzero.astype(jnp.int32))
+    bump = low_nonzero[..., None, :].astype(jnp.int32)
+    return out + _shift_pad(bump, 0, NB)
 
 
 def sqr_lazy(a):
@@ -139,7 +180,7 @@ def apply_combo(x, matrix):
     double-reduced exactly like fieldb.apply_combo."""
     m = np.asarray(matrix, dtype=np.int64)
     assert np.abs(m).sum(axis=1).max() <= fb._OFF_K, "combo L1 too large"
-    off = _const_col(_OFF)
+    off = _const_col(_OFF, "off")
     rows = []
     for o in range(m.shape[0]):
         acc = None
@@ -162,7 +203,7 @@ def add(a, b):
 
 
 def sub(a, b):
-    s = a - b + _const_col(_SPREAD_SUB)
+    s = a - b + _const_col(_SPREAD_SUB, "spread_sub")
     return reduce_small(_relax(s, NB, passes=2))
 
 

@@ -1,0 +1,202 @@
+"""Transposed-layout (batch-last) Miller loop — the Pallas kernel body.
+
+Same math as ops.pairing (inversion-free Jacobian twist Miller loop, fused
+double/line and add/line steps, one scan over the 63 bits of |x|), re-laid
+onto ops.tfield bundles `(S, NB, B)`: slots lead, limbs on sublanes, batch
+on lanes. Runs in three modes:
+  * pure jnp under jit (XLA; this module's public miller_loop_t);
+  * as the body of the Pallas VMEM kernel (ops.pallas_miller);
+  * numerically validated against ops.pairing in tests.
+
+Values are bundles with NO leading batch axes — the batch IS the lane
+axis. Stacked groups of n Fp2 values are `(n, 2, NB, B)`.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import BLS_X, BLS_X_ABS
+from lighthouse_tpu.ops import tfield as tf
+from lighthouse_tpu.ops.programs import FP2_MUL, FP12_MUL, LINE_MUL
+
+NB = tf.NB
+
+_X_BITS = np.array([int(b) for b in bin(BLS_X_ABS)[3:]], dtype=np.int32)
+
+
+def bilinear(x, y, prog):
+    return tf.apply_combo(
+        tf.mul_lazy(tf.apply_combo(x, prog.A), tf.apply_combo(y, prog.B)),
+        prog.C,
+    )
+
+
+def fp12_sqr(f):
+    return bilinear(f, f, FP12_MUL)
+
+
+def fp12_mul(a, b):
+    return bilinear(a, b, FP12_MUL)
+
+
+def _mul_by_line(f, line):
+    """f (12, NB, B) times the sparse line (6, NB, B)."""
+    return bilinear(f, line, LINE_MUL)
+
+
+def _mul2(pairs):
+    """One stacked Fp2 multiply over a list of ((2,NB,B), (2,NB,B))."""
+    A = jnp.stack([a for a, _ in pairs])
+    B = jnp.stack([b for _, b in pairs])
+    out = bilinear(A, B, FP2_MUL)
+    return [out[i] for i in range(len(pairs))]
+
+
+def _combo2(vals, coeffs):
+    """One apply_combo over a list of Fp2 bundles; `coeffs` (n_out, n_in)
+    acts Fp2-componentwise."""
+    x = jnp.concatenate(vals, axis=-3)
+    m = np.kron(np.asarray(coeffs, dtype=np.int64), np.eye(2, dtype=np.int64))
+    y = tf.apply_combo(x, m.astype(np.int32))
+    return [y[..., 2 * i : 2 * i + 2, :, :] for i in range(coeffs.shape[0])]
+
+
+def _line_scale(ca, cb, px, py):
+    """(ca*px, cb*py) as one 4-slot raw multiply (Fp acting componentwise
+    on Fp2)."""
+    lhs = jnp.concatenate([ca, cb], axis=-3)
+    rhs = jnp.concatenate(
+        [
+            jnp.broadcast_to(px, ca.shape),
+            jnp.broadcast_to(py, cb.shape),
+        ],
+        axis=-3,
+    )
+    out = tf.mul_lazy(lhs, rhs)
+    return out[..., 0:2, :, :], out[..., 2:4, :, :]
+
+
+def _dbl_step(t, px, py):
+    """Fused tangent-line + doubling (ops.pairing._dbl_step transposed)."""
+    X, Y, Z = t
+    a, b, z2, yz = _mul2([(X, X), (Y, Y), (Z, Z), (Y, Z)])
+    xb, e = _combo2(
+        [X, a, b],
+        np.array([[1, 0, 1], [0, 3, 0]]),
+    )
+    c, xb2, f, x3c, x2z2, yz3 = _mul2(
+        [(b, b), (xb, xb), (e, e), (X, a), (a, z2), (yz, z2)]
+    )
+    x3, dmx, c0, m3xz, c3p, z3 = _combo2(
+        [xb2, a, c, f, x3c, b, x2z2, yz3, yz],
+        np.array(
+            [
+                [-4, 4, 4, 1, 0, 0, 0, 0, 0],
+                [6, -6, -6, -1, 0, 0, 0, 0, 0],
+                [0, 0, 0, 0, 3, -2, 0, 0, 0],
+                [0, 0, 0, 0, 0, 0, -3, 0, 0],
+                [0, 0, 0, 0, 0, 0, 0, 2, 0],
+                [0, 0, 0, 0, 0, 0, 0, 0, 2],
+            ]
+        ),
+    )
+    (edmx,) = _mul2([(e, dmx)])
+    c2, c3 = _line_scale(m3xz, c3p, px, py)
+    (y3,) = _combo2([edmx, c], np.array([[1, -8]]))
+    line = jnp.concatenate([c0, c2, c3], axis=-3)
+    return (x3, y3, z3), line
+
+
+def _add_step(t, q_affine, px, py):
+    """Fused chord-line + mixed addition (ops.pairing._add_step)."""
+    X1, Y1, Z1 = t
+    qx, qy = q_affine
+    (z1s,) = _mul2([(Z1, Z1)])
+    u2, z1c = _mul2([(qx, z1s), (z1s, Z1)])
+    (gamma,) = _combo2([u2, X1], np.array([[1, -1]]))
+    qyz, hh, z1gam = _mul2([(qy, z1c), (gamma, gamma), (Z1, gamma)])
+    (theta,) = _combo2([qyz, Y1], np.array([[1, -1]]))
+    tt, hhh, v, tqx, qyz3 = _mul2(
+        [(theta, theta), (gamma, hh), (X1, hh), (theta, qx), (qy, z1gam)]
+    )
+    x3, vmx, c0, mtheta = _combo2(
+        [tt, hhh, v, tqx, qyz3, theta],
+        np.array(
+            [
+                [1, -1, -2, 0, 0, 0],
+                [-1, 1, 3, 0, 0, 0],
+                [0, 0, 0, 1, -1, 0],
+                [0, 0, 0, 0, 0, -1],
+            ]
+        ),
+    )
+    tvmx, y1hhh = _mul2([(theta, vmx), (Y1, hhh)])
+    c2, c3 = _line_scale(mtheta, z1gam, px, py)
+    (y3,) = _combo2([tvmx, y1hhh], np.array([[1, -1]]))
+    line = jnp.concatenate([c0, c2, c3], axis=-3)
+    return (x3, y3, z1gam), line
+
+
+def _one_slot0(slots: int, batch: int):
+    """Montgomery 1 in slot 0, zero elsewhere — built from tf.one_col()
+    so a Pallas kernel can substitute a ref-read constant."""
+    col = tf.one_col()[None, :, :]  # (1, NB, 1)
+    pad = jnp.zeros((slots - 1, NB, 1), dtype=jnp.int32)
+    one = jnp.concatenate([col, pad], axis=0)
+    return jnp.broadcast_to(one, (slots, NB, batch))
+
+
+def fp12_one(batch: int):
+    return _one_slot0(12, batch)
+
+
+def fp2_one(batch: int):
+    return _one_slot0(2, batch)
+
+
+def miller_body(f, t, px, py, qx, qy, bit):
+    """One Miller iteration (shared between the XLA scan and the Pallas
+    in-kernel fori_loop). `bit` is a traced scalar."""
+    f = fp12_sqr(f)
+    t, line = _dbl_step(t, px, py)
+    f = _mul_by_line(f, line)
+
+    def do_add(op):
+        f_, t_ = op
+        t_next, line_add = _add_step(t_, (qx, qy), px, py)
+        return _mul_by_line(f_, line_add), t_next
+
+    f, t = jax.lax.cond(bit == 1, do_add, lambda op: op, (f, t))
+    return f, t
+
+
+def miller_loop_t(p_g1_affine, q_g2_affine, valid_mask=None):
+    """Batched Miller loop in transposed layout.
+
+    p_g1_affine: (px, py) Fp bundles (1, NB, B), Montgomery.
+    q_g2_affine: (qx, qy) Fp2 bundles (2, NB, B).
+    valid_mask: optional (B,) bool; False pairs contribute f = 1.
+    Returns f (12, NB, B).
+    """
+    px, py = p_g1_affine
+    qx, qy = q_g2_affine
+    B = qx.shape[-1]
+    t0 = (qx, qy, fp2_one(B))
+    f0 = fp12_one(B)
+    bits = jnp.asarray(_X_BITS)
+
+    def step(carry, bit):
+        f, t = carry
+        f, t = miller_body(f, t, px, py, qx, qy, bit)
+        return (f, t), None
+
+    (f, _), _ = jax.lax.scan(step, (f0, t0), bits)
+    if BLS_X < 0:
+        # conj: negate the w-part (slots 6..12)
+        m = np.diag([1] * 6 + [-1] * 6).astype(np.int32)
+        f = tf.apply_combo(f, m)
+    if valid_mask is not None:
+        f = tf.select(valid_mask, f, fp12_one(B))
+    return f

@@ -1,2 +1,15 @@
 from lighthouse_tpu.store.kv import MemoryStore, SqliteStore  # noqa: F401
 from lighthouse_tpu.store.hot_cold import HotColdDB  # noqa: F401
+from lighthouse_tpu.store.schema import (  # noqa: F401
+    CURRENT_SCHEMA_VERSION,
+    SchemaError,
+    get_schema_version,
+    migrate_schema,
+)
+
+def native_kv_store(path):
+    """Open the C++ append-log KV backend (the LevelDB-role store);
+    raises RuntimeError if the native toolchain is unavailable."""
+    from lighthouse_tpu.native.kvstore import NativeKVStore
+
+    return NativeKVStore(path)

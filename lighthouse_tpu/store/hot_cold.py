@@ -50,6 +50,13 @@ class HotColdDB:
             slots_per_restore_point or spec.SLOTS_PER_EPOCH * 4
         )
         self._replay_pubkeys = PubkeyCache()
+        # schema versioning: stamp fresh stores, migrate old ones on open
+        # (store/src/metadata.rs + schema_change.rs). Every production
+        # store is created through here, so a missing version record means
+        # a fresh database.
+        from lighthouse_tpu.store.schema import migrate_schema
+
+        migrate_schema(kv)
 
     # ------------------------------------------------------------- codecs
 
@@ -79,6 +86,9 @@ class HotColdDB:
 
     def get_canonical_block_root(self, slot: int):
         return self.kv.get(COL_BLOCK_ROOTS, _u64(slot))
+
+    def clear_canonical_block_root(self, slot: int) -> None:
+        self.kv.delete(COL_BLOCK_ROOTS, _u64(slot))
 
     # ------------------------------------------------------------- states
 

@@ -12,7 +12,7 @@ Values are the published Ethereum consensus-spec mainnet/minimal constants
 (phase0 + altair).
 """
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field, fields, replace
 
 FAR_FUTURE_EPOCH = 2**64 - 1
 GENESIS_EPOCH = 0
@@ -282,3 +282,63 @@ def minimal_spec(**overrides) -> Spec:
         BELLATRIX_FORK_VERSION=bytes.fromhex("02000001"),
     )
     return replace(base, **overrides) if overrides else base
+
+
+def gnosis_spec(**overrides) -> Spec:
+    """Gnosis chain preset + config (eth_spec.rs:327 `GnosisEthSpec`,
+    chain_spec.rs:637 `ChainSpec::gnosis`): mainnet container sizes with
+    5 s slots, xDai-denominated deposits kept at the same gwei values,
+    faster eth1 follow, and gnosis fork versions."""
+    base = replace(
+        mainnet_spec(),
+        name="gnosis",
+        SECONDS_PER_SLOT=5,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=4096,
+        MIN_GENESIS_TIME=1638968400,
+        GENESIS_DELAY=6000,
+        GENESIS_FORK_VERSION=bytes.fromhex("00000064"),
+        ALTAIR_FORK_VERSION=bytes.fromhex("01000064"),
+        ALTAIR_FORK_EPOCH=512,
+        BELLATRIX_FORK_VERSION=bytes.fromhex("02000064"),
+        ETH1_FOLLOW_DISTANCE=1024,
+        SECONDS_PER_ETH1_BLOCK=6,
+        CHURN_LIMIT_QUOTIENT=4096,
+        BASE_REWARD_FACTOR=25,
+    )
+    return replace(base, **overrides) if overrides else base
+
+
+def spec_from_config_yaml(text: str, base: Spec | None = None) -> Spec:
+    """Build a Spec from a consensus config.yaml (the runtime-tier override
+    file every network directory carries — eth2_network_config's
+    config.yaml + config_and_preset.rs). Minimal YAML subset: `KEY: value`
+    lines, comments, 0x-hex and decimal scalars, named presets via
+    PRESET_BASE."""
+    values: dict[str, object] = {}
+    preset_base = "mainnet"
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line or ":" not in line:
+            continue
+        key, _, val = line.partition(":")
+        key, val = key.strip(), val.strip().strip("'\"")
+        if key == "PRESET_BASE":
+            preset_base = val
+            continue
+        if val.startswith("0x"):
+            values[key] = bytes.fromhex(val[2:])
+        elif val.isdigit():
+            values[key] = int(val)
+        else:
+            values[key] = val
+    if base is None:
+        base = {
+            "mainnet": mainnet_spec,
+            "minimal": minimal_spec,
+            "gnosis": gnosis_spec,
+        }.get(preset_base, mainnet_spec)()
+    known = {f.name for f in fields(Spec)}
+    overrides = {k: v for k, v in values.items() if k in known}
+    if "CONFIG_NAME" in values:
+        overrides["name"] = str(values["CONFIG_NAME"])
+    return replace(base, **overrides)

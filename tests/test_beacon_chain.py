@@ -176,3 +176,36 @@ def test_hot_cold_migration_and_replay(spec):
         bytes(st5.latest_block_header.parent_root)
         == bytes(chain.store.get_block(canonical_root).message.parent_root)
     )
+
+
+def test_revert_to_fork_boundary(rig):
+    """fork_revert.rs:24 — reset the head to the last pre-boundary block
+    and clear stale canonical entries."""
+    h, chain = rig
+    spec = chain.spec
+    for slot in range(1, spec.SLOTS_PER_EPOCH * 2 + 1):
+        chain.process_block(h.advance_slot_with_block(slot))
+        chain.set_slot(slot)
+    head_before = chain.head_root
+    revert_root = chain.revert_to_fork_boundary(fork_epoch=1)
+    boundary = spec.epoch_start_slot(1)
+    assert chain.head_root == revert_root
+    assert chain.head_state.slot < boundary
+    assert chain.head_root != head_before
+    # canonical index past the boundary is cleared
+    for s in range(boundary, spec.SLOTS_PER_EPOCH * 2 + 1):
+        assert chain.store.get_canonical_block_root(s) is None
+    # pre-boundary index intact
+    assert chain.store.get_canonical_block_root(
+        chain.head_state.slot
+    ) == revert_root
+    # the revert survives a head recompute: fork choice was rebuilt at the
+    # revert anchor, so the wrong-fork head cannot win get_head again
+    chain.recompute_head()
+    assert chain.head_root == revert_root
+    # and the correct chain re-imports cleanly from the boundary
+    h.state = chain.head_state.copy()
+    h.pending_attestations = []
+    nxt = h.produce_block(chain.head_state.slot + 1, [])
+    new_root = chain.process_block(nxt)
+    assert chain.head_root == new_root

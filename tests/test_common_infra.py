@@ -187,3 +187,71 @@ def test_monitoring_service_ships_snapshots():
     mon2 = MonitoringService("http://127.0.0.1:1/api", timeout=0.3)
     assert not mon2.send_once()
     assert mon2.errors == 1
+
+
+def test_store_schema_migrations():
+    """Versioned schema: fresh stamp, stepwise upgrade, and downgrade
+    (store/src/metadata.rs + schema_change.rs + database_manager roles)."""
+    from lighthouse_tpu.store import MemoryStore
+    from lighthouse_tpu.store.schema import (
+        CURRENT_SCHEMA_VERSION,
+        SchemaError,
+        get_schema_version,
+        migrate_schema,
+        set_schema_version,
+    )
+
+    kv = MemoryStore()
+    # fresh store is stamped at current
+    assert migrate_schema(kv) == CURRENT_SCHEMA_VERSION
+    assert get_schema_version(kv) == CURRENT_SCHEMA_VERSION
+
+    # simulate a v1 database with legacy index keys
+    kv2 = MemoryStore()
+    set_schema_version(kv2, 1)
+    kv2.put(b"idx", (5).to_bytes(8, "little"), b"root5")
+    assert migrate_schema(kv2) == CURRENT_SCHEMA_VERSION
+    assert kv2.get(b"idx", b"s" + (5).to_bytes(8, "little")) == b"root5"
+    assert kv2.get(b"idx", (5).to_bytes(8, "little")) is None
+
+    # downgrade back to v1 restores the legacy layout
+    assert migrate_schema(kv2, target=1) == 1
+    assert kv2.get(b"idx", (5).to_bytes(8, "little")) == b"root5"
+
+    # unknown step errors
+    import pytest
+
+    set_schema_version(kv2, 7)
+    with pytest.raises(SchemaError):
+        migrate_schema(kv2, target=9)
+
+
+def test_spec_presets_and_yaml_config():
+    """Gnosis preset + config.yaml runtime overrides
+    (eth_spec.rs:327, eth2_network_config config.yaml)."""
+    from lighthouse_tpu.types.spec import (
+        gnosis_spec,
+        mainnet_spec,
+        spec_from_config_yaml,
+    )
+
+    g = gnosis_spec()
+    assert g.SECONDS_PER_SLOT == 5
+    assert g.GENESIS_FORK_VERSION == bytes.fromhex("00000064")
+    assert g.SLOTS_PER_EPOCH == mainnet_spec().SLOTS_PER_EPOCH
+
+    s = spec_from_config_yaml(
+        """
+# holesky-like overrides
+PRESET_BASE: 'mainnet'
+CONFIG_NAME: 'holesky'
+ALTAIR_FORK_EPOCH: 0
+GENESIS_FORK_VERSION: 0x01017000
+SECONDS_PER_SLOT: 12
+"""
+    )
+    assert s.name == "holesky"
+    assert s.ALTAIR_FORK_EPOCH == 0
+    assert s.GENESIS_FORK_VERSION == bytes.fromhex("01017000")
+    # preset tier inherited from mainnet
+    assert s.MAX_ATTESTATIONS == 128

@@ -1,0 +1,97 @@
+"""Native C++ KV store: durability, crash recovery, batches, compaction,
+and HotColdDB integration (the LevelDB-role backend)."""
+
+import os
+
+import pytest
+
+from lighthouse_tpu.native import kvstore
+
+pytestmark = pytest.mark.skipif(
+    not kvstore.available(), reason="native toolchain unavailable"
+)
+
+
+def test_put_get_delete_roundtrip(tmp_path):
+    db = kvstore.NativeKVStore(str(tmp_path / "kv.log"))
+    db.put(b"blk", b"k1", b"v1")
+    db.put(b"blk", b"k2", b"v2" * 1000)
+    db.put(b"st", b"k1", b"other-column")
+    assert db.get(b"blk", b"k1") == b"v1"
+    assert db.get(b"blk", b"k2") == b"v2" * 1000
+    assert db.get(b"st", b"k1") == b"other-column"
+    assert db.get(b"blk", b"missing") is None
+    db.delete(b"blk", b"k1")
+    assert db.get(b"blk", b"k1") is None
+    assert sorted(db.keys(b"blk")) == [b"k2"]
+    db.close()
+
+
+def test_durability_across_reopen(tmp_path):
+    path = str(tmp_path / "kv.log")
+    db = kvstore.NativeKVStore(path)
+    db.put(b"c", b"a", b"1")
+    db.put(b"c", b"b", b"2")
+    db.delete(b"c", b"a")
+    db.close()
+    db2 = kvstore.NativeKVStore(path)
+    assert db2.get(b"c", b"a") is None
+    assert db2.get(b"c", b"b") == b"2"
+    db2.close()
+
+
+def test_torn_tail_record_ignored(tmp_path):
+    """A crash mid-append must not corrupt the replayable prefix."""
+    path = str(tmp_path / "kv.log")
+    db = kvstore.NativeKVStore(path)
+    db.put(b"c", b"good", b"value")
+    db.close()
+    with open(path, "ab") as f:
+        f.write(b"\x01\xff\xff")  # torn header
+    db2 = kvstore.NativeKVStore(path)
+    assert db2.get(b"c", b"good") == b"value"
+    # the store remains writable after recovery
+    db2.put(b"c", b"after", b"crash")
+    db2.close()
+    db3 = kvstore.NativeKVStore(path)
+    assert db3.get(b"c", b"after") == b"crash"
+    db3.close()
+
+
+def test_batch_and_compaction(tmp_path):
+    path = str(tmp_path / "kv.log")
+    db = kvstore.NativeKVStore(path)
+    db.put_batch([(b"c", f"k{i}".encode(), b"x" * 100) for i in range(50)])
+    for i in range(49):
+        db.delete(b"c", f"k{i}".encode())
+    stats = db.stats()
+    assert stats["log_records"] == 99
+    assert stats["live_records"] == 1
+    size_before = os.path.getsize(path)
+    db.compact()
+    assert os.path.getsize(path) < size_before
+    assert db.get(b"c", b"k49") == b"x" * 100
+    db.close()
+    db2 = kvstore.NativeKVStore(path)
+    assert db2.get(b"c", b"k49") == b"x" * 100
+    assert db2.stats()["log_records"] == 1
+    db2.close()
+
+
+def test_hot_cold_db_over_native_store(tmp_path):
+    """The beacon store runs unchanged over the native backend."""
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.store import HotColdDB
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec(ALTAIR_FORK_EPOCH=2**64 - 1)
+    h = Harness(spec, 16)
+    kv = kvstore.NativeKVStore(str(tmp_path / "beacon.log"))
+    db = HotColdDB(kv, spec)
+    db.put_hot_state(h.state)
+    blk = h.produce_block(1, [])
+    root = type(blk.message).hash_tree_root(blk.message)
+    db.put_block(root, blk)
+    got = db.get_block(root)
+    assert type(got.message).hash_tree_root(got.message) == root
+    kv.close()
