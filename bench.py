@@ -92,7 +92,22 @@ def main():
     )
     args = jax.device_put(args)
 
-    fn = jax.jit(batch_verify.verify_signature_sets)
+    # BENCH_IMPL=pallas runs the Miller loop as the fused VMEM kernel
+    impl = os.environ.get("BENCH_IMPL", "xla")
+    if impl == "pallas":
+        import functools
+
+        fn = jax.jit(
+            functools.partial(
+                batch_verify.verify_signature_sets_pallas,
+                # on the CPU fallback the TPU kernel cannot lower — run
+                # the kernel body in interpret mode so the JSON line
+                # still lands
+                interpret=(platform == "cpu"),
+            )
+        )
+    else:
+        fn = jax.jit(batch_verify.verify_signature_sets)
     ok = bool(np.asarray(fn(*args)))  # compile + warm
     assert ok, "benchmark batch failed to verify"
 

@@ -104,3 +104,45 @@ def verify_signature_sets(
         set_mask,
     )
     return pairing.multi_pairing_is_one(g1_side, g2_side, pair_mask)
+
+
+def verify_signature_sets_pallas(
+    msgs_g2_aff,
+    sigs_g2_aff,
+    pubkeys_g1_aff,
+    key_mask,
+    rand_bits,
+    set_mask,
+    block_b: int = 128,
+    interpret: bool = False,
+):
+    """Same verdict as verify_signature_sets, with the Miller loop running
+    as the fused Pallas VMEM kernel (ops.pallas_miller). The pair axis is
+    padded to a lane-tile multiple with masked identity pairs; MSM folds,
+    RLC ladders, and the final exponentiation stay on the XLA path."""
+    from lighthouse_tpu.ops import tfield as tf, tower
+    from lighthouse_tpu.ops.pallas_miller import miller_loop_pallas
+
+    g1_side, g2_side, pair_mask = miller_inputs(
+        msgs_g2_aff, sigs_g2_aff, pubkeys_g1_aff, key_mask, rand_bits,
+        set_mask,
+    )
+    n_pairs = g1_side[0].shape[0]
+    pad = (-n_pairs) % block_b
+    if pad:
+        def pad0(c):
+            widths = [(0, pad)] + [(0, 0)] * (c.ndim - 1)
+            return jnp.pad(c, widths)
+
+        g1_side = tuple(pad0(c) for c in g1_side)
+        g2_side = tuple(pad0(c) for c in g2_side)
+        pair_mask = jnp.pad(pair_mask, (0, pad))
+
+    p_t = tuple(tf.from_batchlead(c) for c in g1_side)
+    q_t = tuple(tf.from_batchlead(c) for c in g2_side)
+    f_t = miller_loop_pallas(
+        p_t, q_t, pair_mask, block_b=block_b, interpret=interpret
+    )
+    f = tf.to_batchlead(f_t)
+    prod = tower.fp12_product_axis(f, axis=0)
+    return pairing.final_exp_is_one(prod)

@@ -46,3 +46,19 @@ def test_pallas_kernel_grid_tiling_interpret():
     f_one = miller_loop_pallas(p_t, q_t, None, block_b=6, interpret=True)
     f_tiled = miller_loop_pallas(p_t, q_t, None, block_b=3, interpret=True)
     assert np.array_equal(np.asarray(f_one), np.asarray(f_tiled))
+
+
+def test_pallas_verify_path_end_to_end():
+    """verify_signature_sets_pallas agrees with the XLA path including
+    padding to lane tiles and negative probes. 4 sets -> 5 Miller pairs,
+    block_b=4 -> 3 masked padding lanes actually exercised."""
+    import functools
+
+    args = td.make_signature_set_batch(4, max_keys=2, seed=2)
+    fn = functools.partial(
+        batch_verify.verify_signature_sets_pallas, block_b=4, interpret=True
+    )
+    assert bool(np.asarray(jax.jit(fn)(*args)))
+    msgs, sigs, pks, km, rb, sm = args
+    bad = (sigs[0].at[0, 0, 0].add(1), sigs[1])
+    assert not bool(np.asarray(jax.jit(fn)(msgs, bad, pks, km, rb, sm)))
