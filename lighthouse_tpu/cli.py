@@ -157,6 +157,40 @@ def cmd_account(args):
         sk = bls.SecretKey.from_bytes(secret)
         print(f"imported 0x{sk.public_key().to_bytes().hex()}")
         return 0
+    if args.account_cmd == "wallet-create":
+        from lighthouse_tpu.accounts.wallet import Wallet
+
+        w = Wallet.create(
+            args.name, args.password, mnemonic=args.mnemonic,
+            seed=bytes.fromhex(args.seed) if args.seed else None,
+        )
+        with open(args.out or f"{args.name}.wallet.json", "w") as f:
+            f.write(w.to_json())
+        print(json.dumps({"wallet": w.name, "nextaccount": w.nextaccount}))
+        return 0
+    if args.account_cmd == "wallet-next":
+        from lighthouse_tpu.accounts.wallet import Wallet
+
+        if not args.wallet:
+            raise SystemExit("wallet-next requires --wallet <file>")
+        with open(args.wallet) as f:
+            w = Wallet.from_json(f.read())
+        index, ks, _wd = w.next_validator(
+            args.password, args.keystore_password or args.password
+        )
+        # keystore first, wallet (with the bumped counter) last — a
+        # keystore write failure must not burn the account index
+        out = args.out or f"validator_{index}.keystore.json"
+        with open(out, "w") as f:
+            f.write(ks.to_json())
+        with open(args.wallet, "w") as f:
+            f.write(w.to_json())
+        print(
+            json.dumps(
+                {"index": index, "pubkey": "0x" + ks.pubkey_hex, "out": out}
+            )
+        )
+        return 0
     raise SystemExit(f"unknown account command {args.account_cmd}")
 
 
@@ -229,10 +263,12 @@ def cmd_db(args):
             COL_COLD_STATE,
             COL_HOT_STATE,
         )
+        from lighthouse_tpu.store.schema import get_schema_version
 
         print(
             json.dumps(
                 {
+                    "schema_version": get_schema_version(kv),
                     "blocks": len(kv.keys(COL_BLOCK)),
                     "hot_states": len(kv.keys(COL_HOT_STATE)),
                     "cold_states": len(kv.keys(COL_COLD_STATE)),
@@ -240,7 +276,62 @@ def cmd_db(args):
             )
         )
         return 0
+    if args.db_cmd == "version":
+        from lighthouse_tpu.store.schema import (
+            CURRENT_SCHEMA_VERSION,
+            get_schema_version,
+        )
+
+        print(
+            json.dumps(
+                {
+                    "schema_version": get_schema_version(kv),
+                    "current": CURRENT_SCHEMA_VERSION,
+                }
+            )
+        )
+        return 0
+    if args.db_cmd == "migrate":
+        from lighthouse_tpu.store.schema import (
+            CURRENT_SCHEMA_VERSION,
+            migrate_schema,
+        )
+
+        target = (
+            args.target if args.target is not None
+            else CURRENT_SCHEMA_VERSION
+        )
+        final = migrate_schema(kv, target=target)
+        print(json.dumps({"schema_version": final}))
+        return 0
     raise SystemExit(f"unknown db command {args.db_cmd}")
+
+
+def cmd_boot_node(args):
+    """Standalone bootstrap-node entry point (`lighthouse boot_node`,
+    boot_node/src). The registry here is in-process: simulated nodes join
+    it directly (network.discovery.BootstrapRegistry is how the node-sim
+    wires discovery); there is no wire listener yet."""
+    from lighthouse_tpu.network.discovery import (
+        BootstrapRegistry,
+        PeerRecord,
+    )
+
+    registry = BootstrapRegistry()
+    node_id = args.node_id or "boot"
+    registry.register(PeerRecord(node_id=node_id))
+    print(
+        json.dumps(
+            {
+                "node_id": node_id,
+                "role": "boot_node",
+                "peers": len(registry.records),
+            }
+        )
+    )
+    if args.serve_seconds:
+        time.sleep(args.serve_seconds)
+    return 0
 
 
 def build_parser():
@@ -266,11 +357,18 @@ def build_parser():
     vc.set_defaults(fn=cmd_vc)
 
     acct = sub.add_parser("account", help="keys & keystores")
-    acct.add_argument("account_cmd", choices=["new", "import"])
+    acct.add_argument(
+        "account_cmd",
+        choices=["new", "import", "wallet-create", "wallet-next"],
+    )
     acct.add_argument("--password", required=True)
     acct.add_argument("--kdf", default="pbkdf2")
     acct.add_argument("--mnemonic", default=None)
+    acct.add_argument("--seed", default=None)
     acct.add_argument("--index", type=int, default=0)
+    acct.add_argument("--name", default="wallet")
+    acct.add_argument("--wallet", default=None)
+    acct.add_argument("--keystore-password", default=None)
     acct.add_argument("--out", default=None)
     acct.add_argument("--keystore", default=None)
     acct.set_defaults(fn=cmd_account)
@@ -288,9 +386,15 @@ def build_parser():
     lcli.set_defaults(fn=cmd_lcli)
 
     db = sub.add_parser("db", help="database tools")
-    db.add_argument("db_cmd", choices=["inspect"])
+    db.add_argument("db_cmd", choices=["inspect", "version", "migrate"])
     db.add_argument("--path", required=True)
+    db.add_argument("--target", type=int, default=None)
     db.set_defaults(fn=cmd_db)
+
+    boot = sub.add_parser("boot_node", help="discovery bootstrap node")
+    boot.add_argument("--node-id", default=None)
+    boot.add_argument("--serve-seconds", type=float, default=0)
+    boot.set_defaults(fn=cmd_boot_node)
     return p
 
 

@@ -89,6 +89,13 @@ class BeaconNodeHttpClient:
     def post_attestations_json(self, atts_json):
         return self._post("/eth/v1/beacon/pool/attestations", atts_json)
 
+    def post_liveness(self, epoch: int, indices):
+        """Per-validator liveness for an epoch (doppelganger input)."""
+        return self._post(
+            f"/eth/v1/validator/liveness/{epoch}",
+            [str(i) for i in indices],
+        )["data"]
+
     def get_metrics_text(self) -> str:
         with urllib.request.urlopen(
             self.base + "/metrics", timeout=self.timeout

@@ -168,6 +168,27 @@ class BeaconApiServer:
 
     def handle_post(self, path: str, body: bytes):
         chain = self.chain
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if (
+            parts[:4] == ["eth", "v1", "validator", "liveness"]
+            and len(parts) == 5
+        ):
+            # standard liveness endpoint backing doppelganger detection:
+            # a validator is "live" in an epoch if the chain has seen an
+            # attestation from it (observed_attesters first-seen cache)
+            epoch = int(parts[4])
+            indices = [int(i) for i in json.loads(body)]
+            return {
+                "data": [
+                    {
+                        "index": str(i),
+                        "is_live": chain.observed_attesters.is_known(
+                            epoch, i
+                        ),
+                    }
+                    for i in indices
+                ]
+            }
         if path == "/eth/v1/beacon/blocks":
             doc = json.loads(body)
             slot = int(doc["message"]["slot"])

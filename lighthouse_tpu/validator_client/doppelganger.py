@@ -1,0 +1,77 @@
+"""Doppelganger protection: liveness-check form.
+
+Role of validator_client/src/doppelganger_service.rs (1,439 LoC): after
+startup, for DEFAULT_REMAINING_DETECTION_EPOCHS epochs the VC polls the
+beacon node's liveness endpoint for its own validator indices instead of
+signing. Any observed liveness for a managed key means another instance
+is signing with it — signing stays disabled and the operator must
+intervene. Only after the full quiet window does signing enable.
+"""
+
+from dataclasses import dataclass, field
+
+DEFAULT_REMAINING_DETECTION_EPOCHS = 1
+
+
+@dataclass
+class DoppelgangerState:
+    started_epoch: int
+    remaining_epochs: int
+    checked_epochs: set = field(default_factory=set)
+    detected: bool = False
+
+
+class DoppelgangerService:
+    def __init__(
+        self,
+        liveness_fn,
+        detection_epochs: int = DEFAULT_REMAINING_DETECTION_EPOCHS,
+    ):
+        """liveness_fn(epoch, indices) -> list of {index, is_live} —
+        BeaconNodeHttpClient.post_liveness or an in-process chain probe."""
+        self.liveness_fn = liveness_fn
+        self.detection_epochs = detection_epochs
+        self.states: dict[int, DoppelgangerState] = {}
+
+    def register(self, validator_index: int, current_epoch: int):
+        self.states.setdefault(
+            validator_index,
+            DoppelgangerState(
+                started_epoch=current_epoch,
+                remaining_epochs=self.detection_epochs,
+            ),
+        )
+
+    def check_epoch(self, epoch: int):
+        """Poll liveness for every validator still in detection; called
+        once per epoch tick (the reference polls at 3/4 through)."""
+        pending = [
+            i
+            for i, st in self.states.items()
+            if st.remaining_epochs > 0
+            and not st.detected
+            and epoch not in st.checked_epochs
+            and epoch > st.started_epoch  # skip the partial startup epoch
+        ]
+        if not pending:
+            return
+        results = self.liveness_fn(epoch, pending)
+        live = {
+            int(r["index"]) for r in results if r.get("is_live")
+        }
+        for i in pending:
+            st = self.states[i]
+            st.checked_epochs.add(epoch)
+            if i in live:
+                st.detected = True
+            else:
+                st.remaining_epochs -= 1
+
+    def detected_validators(self):
+        return [i for i, st in self.states.items() if st.detected]
+
+    def signing_enabled(self, validator_index: int) -> bool:
+        st = self.states.get(validator_index)
+        if st is None:
+            return True  # never registered => not gated
+        return not st.detected and st.remaining_epochs <= 0

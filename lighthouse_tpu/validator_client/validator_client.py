@@ -135,13 +135,30 @@ class ValidatorClient:
 
     # ------------------------------------------------- doppelganger gating
 
+    def attach_doppelganger(self, service):
+        """Use liveness-based doppelganger protection (DoppelgangerService
+        polling the BN liveness endpoint) instead of the plain epoch
+        counter; registers every managed validator."""
+        self._doppelganger = service
+        epoch = self.spec.slot_to_epoch(self.chain.current_slot())
+        for index in self.keys:
+            service.register(index, epoch)
+
     def start_epoch(self, epoch: int):
         if self._started_epoch is None:
             self._started_epoch = epoch
+        svc = getattr(self, "_doppelganger", None)
+        if svc is not None:
+            svc.check_epoch(epoch)
 
     def signing_enabled(self, epoch: int) -> bool:
-        """Doppelganger protection: no signing for the first N epochs after
-        startup (doppelganger_service.rs semantics, liveness-check form)."""
+        """Doppelganger protection. With an attached DoppelgangerService,
+        signing enables only after the liveness-quiet window and latches
+        off on detection; otherwise the plain N-epoch startup counter
+        applies (doppelganger_service.rs semantics)."""
+        svc = getattr(self, "_doppelganger", None)
+        if svc is not None:
+            return all(svc.signing_enabled(i) for i in self.keys)
         if self._started_epoch is None:
             self._started_epoch = epoch
         return epoch >= self._started_epoch + self.doppelganger_epochs

@@ -230,3 +230,93 @@ def test_wallet_derives_distinct_validators():
     assert w2.nextaccount == 2
     i2, _, _ = w2.next_validator("wpass", "vpass")
     assert i2 == 2
+
+
+def test_doppelganger_service_liveness_detection():
+    """doppelganger_service.rs semantics: quiet epochs count down to
+    enablement; any observed liveness for a managed key latches detection
+    and keeps signing disabled."""
+    from lighthouse_tpu.validator_client.doppelganger import (
+        DoppelgangerService,
+    )
+
+    live_by_epoch = {11: set(), 12: {7}}
+
+    def liveness(epoch, indices):
+        return [
+            {"index": str(i), "is_live": i in live_by_epoch.get(epoch, ())}
+            for i in indices
+        ]
+
+    svc = DoppelgangerService(liveness, detection_epochs=2)
+    svc.register(3, current_epoch=10)
+    svc.register(7, current_epoch=10)
+    assert not svc.signing_enabled(3) and not svc.signing_enabled(7)
+
+    svc.check_epoch(11)  # both quiet
+    assert not svc.signing_enabled(3)
+    svc.check_epoch(12)  # validator 7 seen live elsewhere!
+    assert svc.signing_enabled(3)          # two quiet epochs -> enabled
+    assert not svc.signing_enabled(7)      # detected -> latched off
+    assert svc.detected_validators() == [7]
+    # further quiet epochs do not un-latch detection
+    svc.check_epoch(13)
+    assert not svc.signing_enabled(7)
+    # unregistered validators are not gated
+    assert svc.signing_enabled(99)
+
+
+def test_liveness_endpoint_over_http():
+    """The BN liveness route reflects the chain's observed attesters."""
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.http_api.client import BeaconNodeHttpClient
+    from lighthouse_tpu.http_api.server import BeaconApiServer
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec(ALTAIR_FORK_EPOCH=2**64 - 1)
+    h = Harness(spec, 16)
+    chain = BeaconChain(h.state.copy(), spec, backend="ref")
+    chain.observed_attesters.observe(epoch=1, validator_index=4)
+    srv = BeaconApiServer(chain)
+    srv.start()
+    try:
+        client = BeaconNodeHttpClient(f"http://127.0.0.1:{srv.port}")
+        data = client.post_liveness(1, [3, 4])
+        by_index = {int(d["index"]): d["is_live"] for d in data}
+        assert by_index == {3: False, 4: True}
+    finally:
+        srv.stop()
+
+
+def test_vc_liveness_doppelganger_integration():
+    """attach_doppelganger routes the VC's signing gate through the
+    liveness service."""
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.types.spec import minimal_spec
+    from lighthouse_tpu.validator_client.doppelganger import (
+        DoppelgangerService,
+    )
+    from lighthouse_tpu.validator_client.validator_client import (
+        ValidatorClient,
+    )
+
+    spec = minimal_spec(ALTAIR_FORK_EPOCH=2**64 - 1)
+    h = Harness(spec, 8)
+    chain = BeaconChain(h.state.copy(), spec, backend="ref")
+    vc = ValidatorClient(chain, {0: h.keypairs[0], 1: h.keypairs[1]})
+
+    def liveness(epoch, indices):
+        # validator 1 is signing somewhere else at epoch 1
+        return [
+            {"index": str(i), "is_live": i == 1 and epoch == 1}
+            for i in indices
+        ]
+
+    svc = DoppelgangerService(liveness, detection_epochs=1)
+    vc.attach_doppelganger(svc)
+    assert not vc.signing_enabled(0)
+    vc.start_epoch(1)  # polls liveness: validator 1 detected live
+    assert not vc.signing_enabled(1)  # any detection keeps the VC gated
+    assert svc.detected_validators() == [1]
