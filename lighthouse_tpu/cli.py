@@ -163,6 +163,7 @@ def cmd_account(args):
         w = Wallet.create(
             args.name, args.password, mnemonic=args.mnemonic,
             seed=bytes.fromhex(args.seed) if args.seed else None,
+            kdf=args.kdf,
         )
         with open(args.out or f"{args.name}.wallet.json", "w") as f:
             f.write(w.to_json())

@@ -61,18 +61,14 @@ def rlc_combined_signature(sigs_g2_aff, rand_bits, set_mask):
     return curve.PG2.sum_axis(sig_r, axis=0)
 
 
-def miller_inputs(
-    msgs_g2_aff, sigs_g2_aff, pubkeys_g1_aff, key_mask, rand_bits, set_mask
+def _assemble_pairs(
+    msgs_g2_aff, set_mask, pk_aff, sig_aff
 ):
-    """Build the (S+1)-pair multi-pairing inputs; shared with the sharded
-    path."""
-    agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)
-    agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
-    pk_x, pk_y, pk_inf = curve.PG1.to_affine(agg_pk_r)
-
-    sig_acc = rlc_combined_signature(sigs_g2_aff, rand_bits, set_mask)
-    s_x, s_y, s_inf = curve.PG2.to_affine(_expand0(sig_acc))
-
+    """Assemble the (S+1)-pair multi-pairing inputs from the affinized
+    RLC'd pubkeys and signature sum — shared by the XLA and Pallas
+    input builders so the pair/mask rules cannot diverge."""
+    pk_x, pk_y, pk_inf = pk_aff
+    s_x, s_y, s_inf = sig_aff
     neg_g1 = (
         jnp.asarray(NEG_G1_AFFINE[0])[None],
         jnp.asarray(NEG_G1_AFFINE[1])[None],
@@ -87,6 +83,20 @@ def miller_inputs(
     )
     pair_mask = jnp.concatenate([set_mask & ~pk_inf, ~s_inf], axis=0)
     return g1_side, g2_side, pair_mask
+
+
+def miller_inputs(
+    msgs_g2_aff, sigs_g2_aff, pubkeys_g1_aff, key_mask, rand_bits, set_mask
+):
+    """Build the (S+1)-pair multi-pairing inputs; shared with the sharded
+    path."""
+    agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)
+    agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
+    pk_aff = curve.PG1.to_affine(agg_pk_r)
+
+    sig_acc = rlc_combined_signature(sigs_g2_aff, rand_bits, set_mask)
+    sig_aff = curve.PG2.to_affine(_expand0(sig_acc))
+    return _assemble_pairs(msgs_g2_aff, set_mask, pk_aff, sig_aff)
 
 
 def verify_signature_sets(
@@ -106,6 +116,68 @@ def verify_signature_sets(
     return pairing.multi_pairing_is_one(g1_side, g2_side, pair_mask)
 
 
+def _pad_lanes_projective(pt_t, block_b: int, group):
+    """Pad the lane axis of a transposed projective point to a block
+    multiple with identity lanes."""
+    B = pt_t[0].shape[-1]
+    pad = (-B) % block_b
+    if not pad:
+        return pt_t
+    ix, iy, iz = group.identity(pad)
+    return tuple(
+        jnp.concatenate([c, i], axis=-1)
+        for c, i in zip(pt_t, (ix, iy, iz))
+    )
+
+
+def miller_inputs_pallas(
+    msgs_g2_aff,
+    sigs_g2_aff,
+    pubkeys_g1_aff,
+    key_mask,
+    rand_bits,
+    set_mask,
+    block_b: int = 128,
+    interpret: bool = False,
+):
+    """miller_inputs with the per-set G1 and per-signature G2 RLC ladders
+    running as fused Pallas VMEM kernels (ops.pallas_ladder); MSM folds
+    and the to-affine inversions stay on the XLA path."""
+    from lighthouse_tpu.ops import tcurve, tfield as tf
+    from lighthouse_tpu.ops.pallas_ladder import ladder_pallas
+
+    bits_t = jnp.transpose(rand_bits).astype(jnp.int32)  # (64, S)
+
+    # ---- G1: aggregate per set (XLA fold), then the pallas ladder
+    agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)  # (S,) projective
+    agg_t = tuple(tf.from_batchlead(c) for c in agg_pk)
+    agg_t = _pad_lanes_projective(agg_t, block_b, tcurve.TPG1)
+    padded = agg_t[0].shape[-1] - agg_pk[0].shape[0]
+    bits_pad = jnp.pad(bits_t, ((0, 0), (0, padded)))
+    pk_r_t = ladder_pallas(
+        agg_t, bits_pad, group_name="G1", block_b=block_b,
+        interpret=interpret,
+    )
+    n_sets = agg_pk[0].shape[0]
+    pk_r = tuple(tf.to_batchlead(c)[:n_sets] for c in pk_r_t)
+    pk_aff = curve.PG1.to_affine(pk_r)
+
+    # ---- G2: pallas ladder over the signatures, then the XLA fold
+    # (sliced back to the real lane count first — folding identity
+    # padding would widen every tree level for nothing)
+    sx, sy = (tf.from_batchlead(c) for c in sigs_g2_aff)
+    sig_t = tcurve.TPG2.from_affine((sx, sy), set_mask)
+    sig_t = _pad_lanes_projective(sig_t, block_b, tcurve.TPG2)
+    sig_r_t = ladder_pallas(
+        sig_t, bits_pad, group_name="G2", block_b=block_b,
+        interpret=interpret,
+    )
+    sig_r = tuple(tf.to_batchlead(c)[:n_sets] for c in sig_r_t)
+    sig_acc = curve.PG2.sum_axis(sig_r, axis=0)
+    sig_aff = curve.PG2.to_affine(_expand0(sig_acc))
+    return _assemble_pairs(msgs_g2_aff, set_mask, pk_aff, sig_aff)
+
+
 def verify_signature_sets_pallas(
     msgs_g2_aff,
     sigs_g2_aff,
@@ -116,16 +188,17 @@ def verify_signature_sets_pallas(
     block_b: int = 128,
     interpret: bool = False,
 ):
-    """Same verdict as verify_signature_sets, with the Miller loop running
-    as the fused Pallas VMEM kernel (ops.pallas_miller). The pair axis is
-    padded to a lane-tile multiple with masked identity pairs; MSM folds,
-    RLC ladders, and the final exponentiation stay on the XLA path."""
+    """Same verdict as verify_signature_sets, with the Miller loop AND
+    the RLC scalar ladders running as fused Pallas VMEM kernels. The
+    pair axis is padded to a lane-tile multiple with masked identity
+    pairs; MSM folds, to-affine inversions, and the final exponentiation
+    stay on the XLA path."""
     from lighthouse_tpu.ops import tfield as tf, tower
     from lighthouse_tpu.ops.pallas_miller import miller_loop_pallas
 
-    g1_side, g2_side, pair_mask = miller_inputs(
+    g1_side, g2_side, pair_mask = miller_inputs_pallas(
         msgs_g2_aff, sigs_g2_aff, pubkeys_g1_aff, key_mask, rand_bits,
-        set_mask,
+        set_mask, block_b=block_b, interpret=interpret,
     )
     n_pairs = g1_side[0].shape[0]
     pad = (-n_pairs) % block_b

@@ -1,0 +1,137 @@
+"""Transposed-layout (batch-last) complete projective point ops.
+
+The RCB complete-formula plane of ops.curve.ProjectiveGroup re-laid onto
+ops.tfield bundles: a point is (X, Y, Z) with each coordinate (w, NB, B)
+— w = 1 (G1/Fp) or 2 (G2/Fp2), batch on lanes. Reuses the EXACT combo
+matrices built (and validated) by curve.PG1/PG2, so the two layouts
+cannot drift. Runs under plain jit and inside Pallas kernels
+(ops.pallas_ladder).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lighthouse_tpu.ops import curve, tfield as tf
+from lighthouse_tpu.ops.programs import FP2_MUL
+
+NB = tf.NB
+
+
+class TProjective:
+    def __init__(self, pg):
+        """pg: curve.PG1 or curve.PG2 (matrix provider)."""
+        self.pg = pg
+        self.w = pg.F.w
+
+    # ------------------------------------------------------------ helpers
+
+    def _mul(self, a, b):
+        """Stacked coordinate multiply on (n, w, NB, B)."""
+        if self.w == 1:
+            return tf.mul_lazy(a, b)
+        from lighthouse_tpu.ops.tpairing import bilinear
+
+        return bilinear(a, b, FP2_MUL)
+
+    def _stack_mul(self, avals, bvals):
+        A = jnp.stack(avals)
+        B = jnp.stack(bvals)
+        out = self._mul(A, B)
+        return [out[i] for i in range(len(avals))]
+
+    def _combo(self, vals, matrix, n_out):
+        w = self.w
+        x = jnp.concatenate(vals, axis=-3)
+        y = tf.apply_combo(x, matrix)
+        return [y[..., w * i : w * (i + 1), :, :] for i in range(n_out)]
+
+    def identity(self, batch: int):
+        from lighthouse_tpu.ops.tpairing import _one_slot0
+
+        zero = jnp.zeros((self.w, NB, batch), jnp.int32)
+        return (zero, _one_slot0(self.w, batch), zero)
+
+    def select(self, cond, a, b):
+        return tuple(tf.select(cond, ca, cb) for ca, cb in zip(a, b))
+
+    def from_affine(self, aff, valid):
+        """(x, y) (w, NB, B) + (B,) mask -> projective; invalid lanes
+        become the identity (0 : 1 : 0)."""
+        x, y = aff
+        B = x.shape[-1]
+        ix, iy, iz = self.identity(B)
+        from lighthouse_tpu.ops.tpairing import _one_slot0
+
+        one = _one_slot0(self.w, B)
+        return (
+            tf.select(valid, x, ix),
+            tf.select(valid, y, iy),
+            tf.select(valid, one, iz),
+        )
+
+    # ---------------------------------------------------------- group ops
+
+    def add(self, p, q):
+        """RCB Algorithm 7 — same matrices as curve.ProjectiveGroup.add."""
+        pg = self.pg
+        a_ops = self._combo(list(p), pg._ADD_OPS, 6)
+        b_ops = self._combo(list(q), pg._ADD_OPS, 6)
+        m = self._stack_mul(a_ops, b_ops)
+        t3, t4, t5, T0, Z3s, t1m = self._combo(m, pg._ADD_C1, 6)
+        (y3c,) = self._combo([t5], pg._B3_ROW, 1)
+        prods = self._stack_mul(
+            [t4, t3, y3c, t1m, T0, Z3s],
+            [y3c, t1m, T0, Z3s, t3, t4],
+        )
+        x3, y3, z3 = self._combo(prods, pg._ADD_C3, 3)
+        return (x3, y3, z3)
+
+    def double(self, pt):
+        """RCB Algorithm 9 — same matrices as curve.ProjectiveGroup."""
+        pg = self.pg
+        X, Y, Z = pt
+        m0, m1, m2, m3 = self._stack_mul([Y, Y, Z, X], [Y, Z, Z, Y])
+        z8, t2v, y3s = self._combo([m0, m1, m2, m3], pg._DBL_C1, 3)
+        (t0f,) = self._combo([m0, t2v], pg._DBL_C2, 1)
+        prods = self._stack_mul([t2v, m1, t0f, t0f], [z8, z8, y3s, m3])
+        x3, y3, z3 = self._combo(prods, pg._DBL_C3, 3)
+        return (x3, y3, z3)
+
+    def ladder_step(self, acc, addend, bit):
+        """One double-add iteration: acc += addend when bit, addend
+        doubles. `bit` is (B,) int32 (per-lane scalar bits)."""
+        added = self.add(acc, addend)
+        acc = self.select(bit == 1, added, acc)
+        addend = self.double(addend)
+        return acc, addend
+
+    def mul_scalar_bits(self, pt, bits):
+        """bits (nbits, B) int32 LSB-first -> per-lane scalar multiple."""
+        B = pt[0].shape[-1]
+
+        def step(carry, bit):
+            acc, addend = carry
+            acc, addend = self.ladder_step(acc, addend, bit)
+            return (acc, addend), None
+
+        (acc, _), _ = jax.lax.scan(step, (self.identity(B), pt), bits)
+        return acc
+
+    def sum_lanes(self, pt, axis: int = -1):
+        """Tree-fold the lane axis down to ONE point (1-lane bundles).
+        Lane count must be a power of two (pad with identities first)."""
+        x, y, z = pt
+        n = x.shape[axis]
+        assert n & (n - 1) == 0, "sum_lanes needs a power-of-two lane count"
+        while n > 1:
+            half = n // 2
+            a = tuple(c[..., :half] for c in (x, y, z))
+            b = tuple(c[..., half : 2 * half] for c in (x, y, z))
+            x, y, z = self.add(a, b)
+            n = half
+        return (x, y, z)
+
+
+TPG1 = TProjective(curve.PG1)
+TPG2 = TProjective(curve.PG2)

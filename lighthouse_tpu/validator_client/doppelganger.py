@@ -43,25 +43,31 @@ class DoppelgangerService:
         )
 
     def check_epoch(self, epoch: int):
-        """Poll liveness for every validator still in detection; called
-        once per epoch tick (the reference polls at 3/4 through)."""
+        """Called at each epoch tick: polls liveness for the COMPLETED
+        epoch (epoch - 1), which is the earliest epoch whose attestations
+        have all been observed. Polling the just-started epoch would race
+        a doppelganger's mid-epoch attestation and always read quiet
+        (the reference polls the prior epoch, plus the current one at
+        3/4 through)."""
+        target = epoch - 1
         pending = [
             i
             for i, st in self.states.items()
             if st.remaining_epochs > 0
             and not st.detected
-            and epoch not in st.checked_epochs
-            and epoch > st.started_epoch  # skip the partial startup epoch
+            and target not in st.checked_epochs
+            # the partial startup epoch proves nothing either way
+            and target > st.started_epoch
         ]
         if not pending:
             return
-        results = self.liveness_fn(epoch, pending)
+        results = self.liveness_fn(target, pending)
         live = {
             int(r["index"]) for r in results if r.get("is_live")
         }
         for i in pending:
             st = self.states[i]
-            st.checked_epochs.add(epoch)
+            st.checked_epochs.add(target)
             if i in live:
                 st.detected = True
             else:

@@ -62,3 +62,52 @@ def test_pallas_verify_path_end_to_end():
     msgs, sigs, pks, km, rb, sm = args
     bad = (sigs[0].at[0, 0, 0].add(1), sigs[1])
     assert not bool(np.asarray(jax.jit(fn)(msgs, bad, pks, km, rb, sm)))
+
+
+def test_pallas_ladder_matches_xla_path():
+    """ops.pallas_ladder G2 ladder + XLA fold equals the production
+    rlc_combined_signature (projective cross-equality)."""
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.ops import curve, tcurve
+    from lighthouse_tpu.ops.pallas_ladder import ladder_pallas
+
+    args = td.make_signature_set_batch(8, max_keys=1, seed=5)
+    msgs, sigs, pks, km, rb, sm = args
+    ref = jax.jit(batch_verify.rlc_combined_signature)(sigs, rb, sm)
+
+    sx, sy = (tf.from_batchlead(c) for c in sigs)
+    sig_t = tcurve.TPG2.from_affine((sx, sy), jnp.asarray(np.asarray(sm)))
+    bits_t = jnp.asarray(np.asarray(rb)).T.astype(np.int32)
+    out = ladder_pallas(
+        sig_t, bits_t, group_name="G2", block_b=4, interpret=True
+    )
+    out_bl = tuple(tf.to_batchlead(c) for c in out)
+    acc = curve.PG2.sum_axis(out_bl, axis=0)
+    eq = curve.PG2.eq(
+        tuple(c[None] for c in acc), tuple(c[None] for c in ref)
+    )
+    assert bool(np.asarray(eq)[0])
+
+
+def test_tcurve_scan_ladder_and_lane_fold():
+    """tcurve's XLA-level ladder (mul_scalar_bits) and power-of-two lane
+    fold (sum_lanes) agree with the batch-leading production path."""
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.ops import curve, tcurve
+
+    args = td.make_signature_set_batch(8, max_keys=1, seed=7)
+    msgs, sigs, pks, km, rb, sm = args
+    ref = jax.jit(batch_verify.rlc_combined_signature)(sigs, rb, sm)
+
+    sx, sy = (tf.from_batchlead(c) for c in sigs)
+    pt = tcurve.TPG2.from_affine((sx, sy), jnp.asarray(np.asarray(sm)))
+    bits_t = jnp.asarray(np.asarray(rb)).T.astype(np.int32)
+    acc = jax.jit(tcurve.TPG2.mul_scalar_bits)(pt, bits_t)
+    folded = jax.jit(tcurve.TPG2.sum_lanes)(acc)
+    out_bl = tuple(tf.to_batchlead(c)[0] for c in folded)
+    eq = curve.PG2.eq(
+        tuple(c[None] for c in out_bl), tuple(c[None] for c in ref)
+    )
+    assert bool(np.asarray(eq)[0])

@@ -253,14 +253,17 @@ def test_doppelganger_service_liveness_detection():
     svc.register(7, current_epoch=10)
     assert not svc.signing_enabled(3) and not svc.signing_enabled(7)
 
-    svc.check_epoch(11)  # both quiet
+    # each tick polls the COMPLETED epoch (tick at N queries N-1); the
+    # partial startup epoch (10) proves nothing and is skipped
+    svc.check_epoch(11)  # would query 10 == started epoch: skipped
+    svc.check_epoch(12)  # queries 11: both quiet
     assert not svc.signing_enabled(3)
-    svc.check_epoch(12)  # validator 7 seen live elsewhere!
+    svc.check_epoch(13)  # queries 12: validator 7 seen live elsewhere!
     assert svc.signing_enabled(3)          # two quiet epochs -> enabled
     assert not svc.signing_enabled(7)      # detected -> latched off
     assert svc.detected_validators() == [7]
     # further quiet epochs do not un-latch detection
-    svc.check_epoch(13)
+    svc.check_epoch(14)
     assert not svc.signing_enabled(7)
     # unregistered validators are not gated
     assert svc.signing_enabled(99)
@@ -317,6 +320,6 @@ def test_vc_liveness_doppelganger_integration():
     svc = DoppelgangerService(liveness, detection_epochs=1)
     vc.attach_doppelganger(svc)
     assert not vc.signing_enabled(0)
-    vc.start_epoch(1)  # polls liveness: validator 1 detected live
-    assert not vc.signing_enabled(1)  # any detection keeps the VC gated
+    vc.start_epoch(2)  # tick at epoch 2 polls COMPLETED epoch 1: live!
+    assert not vc.signing_enabled(2)  # any detection keeps the VC gated
     assert svc.detected_validators() == [1]
