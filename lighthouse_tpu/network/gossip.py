@@ -95,3 +95,20 @@ class GossipHub:
     def prune_seen(self, keep: int = 100_000):
         if len(self._seen) > keep:
             self._seen = set(list(self._seen)[-keep // 2 :])
+
+
+# ----------------------------------------------------------- wire codecs
+
+
+def encode_gossip(ssz_bytes: bytes) -> bytes:
+    """Gossip payloads are raw-snappy-block compressed SSZ (the
+    `/ssz_snappy` topic encoding, types/pubsub.rs)."""
+    from lighthouse_tpu.network.snappy_codec import compress_block
+
+    return compress_block(ssz_bytes)
+
+
+def decode_gossip(data: bytes, max_len: int = 10 * 1024 * 1024) -> bytes:
+    from lighthouse_tpu.network.snappy_codec import decompress_block
+
+    return decompress_block(data, max_len)

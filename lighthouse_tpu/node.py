@@ -82,7 +82,17 @@ class BeaconNode:
         return topic_str.split("/")[3]
 
     def _deliver(self, topic_str: str, data: bytes, from_peer: str):
+        from lighthouse_tpu.network.gossip import decode_gossip
+        from lighthouse_tpu.network.snappy_codec import SnappyError
+
         name = self._topic_name(topic_str)
+        try:
+            data = decode_gossip(data)
+        except SnappyError:
+            from lighthouse_tpu.network.gossip import SCORE_INVALID_MESSAGE
+
+            self.hub.report(from_peer, SCORE_INVALID_MESSAGE)
+            return
         if name == "beacon_block":
             fork = self.spec.fork_name_at_epoch(0)
             block = self.chain.t.signed_block_classes[fork].decode(data)
@@ -105,28 +115,31 @@ class BeaconNode:
     def publish_block(self, signed_block):
         if self.hub is None:
             return
+        from lighthouse_tpu.network.gossip import encode_gossip
         self.hub.publish(
             self.node_id,
             topic(self.fork_digest, "beacon_block"),
-            signed_block.to_bytes(),
+            encode_gossip(signed_block.to_bytes()),
         )
 
     def publish_attestation(self, att):
         if self.hub is None:
             return
+        from lighthouse_tpu.network.gossip import encode_gossip
         self.hub.publish(
             self.node_id,
             topic(self.fork_digest, "beacon_attestation_0"),
-            att.to_bytes(),
+            encode_gossip(att.to_bytes()),
         )
 
     def publish_aggregate(self, sap):
         if self.hub is None:
             return
+        from lighthouse_tpu.network.gossip import encode_gossip
         self.hub.publish(
             self.node_id,
             topic(self.fork_digest, "beacon_aggregate_and_proof"),
-            sap.to_bytes(),
+            encode_gossip(sap.to_bytes()),
         )
 
     # ------------------------------------------------------------ handlers

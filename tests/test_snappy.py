@@ -1,0 +1,117 @@
+"""Snappy block + frame codec (native matcher with Python fallback)."""
+
+import os
+import random
+
+import pytest
+
+from lighthouse_tpu.network import snappy_codec as sc
+
+rng = random.Random(9)
+
+
+def _cases():
+    return [
+        b"",
+        b"a",
+        b"hello world " * 200,              # highly compressible
+        bytes(rng.randbytes(10_000)),        # incompressible
+        bytes([7] * 100_000),                # run-length
+        b"ab" * 40_000,                      # short-period copies
+        bytes(rng.randbytes(65536 + 17)),    # crosses frame chunking
+    ]
+
+
+def test_block_roundtrip_all_shapes():
+    for data in _cases():
+        enc = sc.compress_block(data)
+        assert sc.decompress_block(enc) == data
+
+
+def test_native_compression_actually_compresses():
+    if not sc.native_available():
+        pytest.skip("no native toolchain")
+    data = b"the quick brown fox " * 1000
+    enc = sc.compress_block(data)
+    assert len(enc) < len(data) // 4
+
+
+def test_python_decoder_reads_native_output():
+    """Cross-check: native encoder output decoded by the pure-Python
+    path (and vice versa via the literal-only fallback)."""
+    if not sc.native_available():
+        pytest.skip("no native toolchain")
+    data = b"abcabcabcabc" * 500 + bytes(rng.randbytes(100))
+    enc = sc.compress_block(data)
+    # force the pure-Python decode path
+    lib, sc._lib = sc._lib, False
+    try:
+        assert sc.decompress_block(enc) == data
+    finally:
+        sc._lib = lib
+
+
+def test_block_rejects_malformed():
+    with pytest.raises(sc.SnappyError):
+        sc.decompress_block(b"\x05\x00")  # declared 5, contains less
+    with pytest.raises(sc.SnappyError):
+        # copy with offset beyond output start
+        sc.decompress_block(b"\x04" + bytes([0b000000_01, 0xFF]))
+    with pytest.raises(sc.SnappyError):
+        sc.decompress_block(b"\xff\xff\xff\xff\xff")  # bad varint
+
+
+def test_frame_roundtrip_and_checksum():
+    for data in _cases():
+        enc = sc.frame_compress(data)
+        assert enc.startswith(b"\xff\x06\x00\x00sNaPpY")
+        assert sc.frame_decompress(enc) == data
+    # corrupt one payload byte -> checksum mismatch
+    data = b"framed " * 1000
+    enc = bytearray(sc.frame_compress(data))
+    enc[-1] ^= 0xFF
+    with pytest.raises(sc.SnappyError):
+        sc.frame_decompress(bytes(enc))
+
+
+def test_frame_rejects_oversize():
+    data = bytes(1000)
+    enc = sc.frame_compress(data)
+    with pytest.raises(sc.SnappyError):
+        sc.frame_decompress(enc, max_len=100)
+
+
+def test_crc32c_known_vector():
+    # RFC 3720 test vector: CRC32C of 32 zero bytes
+    assert sc._crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_block_rejects_overflow_length_literal():
+    """Regression: a literal declaring len 0xFFFFFFFF must error, not
+    wrap the 32-bit bounds checks and overrun the output buffer."""
+    evil = b"\x05\x00A" + bytes([63 << 2]) + b"\xfe\xff\xff\xff"
+    with pytest.raises(sc.SnappyError):
+        sc.decompress_block(evil)
+
+
+def test_block_rejects_zero_length_garbage():
+    """Regression: declared length 0 followed by garbage is malformed on
+    BOTH the native and pure-Python paths."""
+    evil = b"\x00" + b"\x01\x02\x03"
+    with pytest.raises(sc.SnappyError):
+        sc.decompress_block(evil)
+    lib, sc._lib = sc._lib, False
+    try:
+        with pytest.raises(sc.SnappyError):
+            sc.decompress_block(evil)
+    finally:
+        sc._lib = lib
+
+
+def test_frame_padding_chunk_skipped():
+    data = b"padded stream " * 100
+    enc = bytearray(sc.frame_compress(data))
+    # splice a padding chunk (0xfe) after the stream identifier
+    pad = bytes([0xFE]) + (4).to_bytes(3, "little") + b"\x00" * 4
+    enc[10:10] = pad
+    assert sc.frame_decompress(bytes(enc)) == data
