@@ -204,20 +204,19 @@ int64_t snappy_uncompress(const uint8_t* src, uint32_t n, uint8_t* dst,
 /* ------------------------------------------------------------- CRC32C */
 
 static uint32_t crc32c_table[256];
-static int crc32c_init_done = 0;
 
-static void crc32c_init(void) {
+/* runs at dlopen, before any Python thread can call in — no lazy-init
+ * data race */
+__attribute__((constructor)) static void crc32c_init(void) {
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t c = i;
     for (int k = 0; k < 8; k++)
       c = (c & 1) ? (0x82f63b78u ^ (c >> 1)) : (c >> 1);
     crc32c_table[i] = c;
   }
-  crc32c_init_done = 1;
 }
 
 uint32_t snappy_crc32c(const uint8_t* data, uint32_t n) {
-  if (!crc32c_init_done) crc32c_init();
   uint32_t c = 0xffffffffu;
   for (uint32_t i = 0; i < n; i++)
     c = crc32c_table[(c ^ data[i]) & 0xff] ^ (c >> 8);

@@ -29,6 +29,8 @@ class SnappyError(ValueError):
 
 def _load():
     global _lib
+    if _lib is not None:  # lock-free fast path; _lib written once under lock
+        return _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
@@ -142,7 +144,8 @@ def decompress_block(data: bytes, max_len: int = 1 << 27) -> bytes:
         return out.raw[:expect]
     # pure-Python decode
     out = bytearray()
-    while pos < len(data):
+    n = len(data)
+    while pos < n:
         tag = data[pos]
         pos += 1
         kind = tag & 3
@@ -150,22 +153,30 @@ def decompress_block(data: bytes, max_len: int = 1 << 27) -> bytes:
             ln = (tag >> 2) + 1
             if ln > 60:
                 extra = ln - 60
+                if n - pos < extra:
+                    raise SnappyError("truncated length")
                 ln = int.from_bytes(data[pos : pos + extra], "little") + 1
                 pos += extra
-            if pos + ln > len(data):
+            if ln > n - pos:
                 raise SnappyError("truncated literal")
             out += data[pos : pos + ln]
             pos += ln
         else:
             if kind == 1:
+                if pos >= n:
+                    raise SnappyError("truncated copy")
                 ln = ((tag >> 2) & 7) + 4
                 offset = ((tag >> 5) << 8) | data[pos]
                 pos += 1
             elif kind == 2:
+                if n - pos < 2:
+                    raise SnappyError("truncated copy")
                 ln = (tag >> 2) + 1
                 offset = int.from_bytes(data[pos : pos + 2], "little")
                 pos += 2
             else:
+                if n - pos < 4:
+                    raise SnappyError("truncated copy")
                 ln = (tag >> 2) + 1
                 offset = int.from_bytes(data[pos : pos + 4], "little")
                 pos += 4

@@ -12,12 +12,15 @@ from lighthouse_tpu.beacon_chain import BeaconChain
 from lighthouse_tpu.common.slot_clock import ManualSlotClock
 from lighthouse_tpu.network.beacon_processor import BeaconProcessor
 from lighthouse_tpu.network.gossip import (
+    GossipHub,
     SCORE_INVALID_MESSAGE,
     SCORE_VALID,
-    GossipHub,
+    decode_gossip,
+    encode_gossip,
     topic,
 )
 from lighthouse_tpu.network.rpc import RpcServer
+from lighthouse_tpu.network.snappy_codec import SnappyError
 from lighthouse_tpu.network.sync import SyncManager
 from lighthouse_tpu.types.helpers import compute_fork_digest
 
@@ -82,15 +85,10 @@ class BeaconNode:
         return topic_str.split("/")[3]
 
     def _deliver(self, topic_str: str, data: bytes, from_peer: str):
-        from lighthouse_tpu.network.gossip import decode_gossip
-        from lighthouse_tpu.network.snappy_codec import SnappyError
-
         name = self._topic_name(topic_str)
         try:
             data = decode_gossip(data)
         except SnappyError:
-            from lighthouse_tpu.network.gossip import SCORE_INVALID_MESSAGE
-
             self.hub.report(from_peer, SCORE_INVALID_MESSAGE)
             return
         if name == "beacon_block":
@@ -115,7 +113,6 @@ class BeaconNode:
     def publish_block(self, signed_block):
         if self.hub is None:
             return
-        from lighthouse_tpu.network.gossip import encode_gossip
         self.hub.publish(
             self.node_id,
             topic(self.fork_digest, "beacon_block"),
@@ -125,7 +122,6 @@ class BeaconNode:
     def publish_attestation(self, att):
         if self.hub is None:
             return
-        from lighthouse_tpu.network.gossip import encode_gossip
         self.hub.publish(
             self.node_id,
             topic(self.fork_digest, "beacon_attestation_0"),
@@ -135,7 +131,6 @@ class BeaconNode:
     def publish_aggregate(self, sap):
         if self.hub is None:
             return
-        from lighthouse_tpu.network.gossip import encode_gossip
         self.hub.publish(
             self.node_id,
             topic(self.fork_digest, "beacon_aggregate_and_proof"),

@@ -1,0 +1,92 @@
+"""Device (jnp) slasher plane: batched min/max-target updates + surround
+detection.
+
+Role of slasher/src/array.rs (:15-45): the per-validator arrays
+
+    max_targets[v][e] = max target over v's attestations with source <= e
+    min_targets[v][e] = min target over v's attestations with source >= e
+
+are exactly a scatter + running extremum along the epoch axis — here ONE
+jittable update over a whole attestation batch (scatter-max/min then a
+cumulative max / reversed cumulative min), where the reference walks
+chunk-by-chunk on the CPU. Surround checks are gathers against the
+pre-update arrays plus a post-update pass that catches batch-internal
+surround pairs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NO_TARGET_MIN = np.iinfo(np.int32).max
+NO_TARGET_MAX = -1
+
+
+def _gather_checks(min_arr, max_arr, v_idx, s, t, valid):
+    """surrounded: an existing attestation (s' < s, t' > t) exists
+    <=> max_targets[v][s-1] > t; surrounds: (s' > s, t' < t) exists
+    <=> min_targets[v][s+1] < t."""
+    H = max_arr.shape[1]
+    s_prev = jnp.clip(s - 1, 0, H - 1)
+    s_next = jnp.clip(s + 1, 0, H - 1)
+    max_prev = max_arr[v_idx, s_prev]
+    min_next = min_arr[v_idx, s_next]
+    surrounded = valid & (s > 0) & (max_prev > t)
+    surrounds = valid & (s + 1 < H) & (min_next < t)
+    return surrounded, surrounds
+
+
+def batch_update(min_arr, max_arr, v_idx, s, t, valid):
+    """Apply a batch of attestations to the (V, H) min/max-target arrays.
+
+    v_idx, s, t: (N,) int32 (epochs must be < H, pre-windowed by the
+    caller); valid: (N,) bool — masked lanes contribute nothing.
+
+    Returns (new_min, new_max, surrounded, surrounds): per-attestation
+    surround verdicts covering both existing state and pairs WITHIN the
+    batch (post-update re-check)."""
+    V, H = max_arr.shape
+    sur_pre, srs_pre = _gather_checks(min_arr, max_arr, v_idx, s, t, valid)
+
+    # scatter the batch extremes at the source column, then run the
+    # extremum along the epoch axis:
+    #   max_targets[e] >= t for e >= s  -> scatter-max at s, cummax ->
+    #   min_targets[e] <= t for e <= s  -> scatter-min at s, reversed cummin
+    v_safe = jnp.where(valid, v_idx, 0)
+    s_safe = jnp.where(valid, s, 0)
+    t_max = jnp.where(valid, t, NO_TARGET_MAX)
+    t_min = jnp.where(valid, t, NO_TARGET_MIN)
+
+    scat_max = jnp.full((V, H), NO_TARGET_MAX, jnp.int32).at[
+        v_safe, s_safe
+    ].max(t_max)
+    scat_min = jnp.full((V, H), NO_TARGET_MIN, jnp.int32).at[
+        v_safe, s_safe
+    ].min(t_min)
+
+    run_max = jax.lax.associative_scan(jnp.maximum, scat_max, axis=1)
+    run_min = jax.lax.associative_scan(
+        jnp.minimum, scat_min, axis=1, reverse=True
+    )
+
+    new_max = jnp.maximum(max_arr, run_max)
+    new_min = jnp.minimum(min_arr, run_min)
+
+    # post-update pass: batch-internal surrounds now visible
+    sur_post, srs_post = _gather_checks(
+        new_min, new_max, v_idx, s, t, valid
+    )
+    # an attestation "is surrounded" post-update also when it equals its
+    # own contribution; exclude self-hits by requiring a STRICT conflict
+    # beyond what this attestation itself wrote:
+    #   its own write puts t at max_targets[v][e>=s] and min[v][e<=s],
+    #   which never touches max[v][s-1] nor min[v][s+1] rows for itself,
+    #   so self-exclusion is automatic.
+    surrounded = sur_pre | sur_post
+    surrounds = srs_pre | srs_post
+    return new_min, new_max, surrounded, surrounds
+
+
+@jax.jit
+def batch_update_jit(min_arr, max_arr, v_idx, s, t, valid):
+    return batch_update(min_arr, max_arr, v_idx, s, t, valid)

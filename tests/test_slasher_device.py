@@ -1,0 +1,114 @@
+"""Device slasher plane vs brute-force surround semantics."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from lighthouse_tpu.slasher.device import (
+    NO_TARGET_MAX,
+    NO_TARGET_MIN,
+    batch_update_jit,
+)
+
+rng = random.Random(13)
+
+
+def _brute_force(history, atts):
+    """Sequentially applied ground truth: for each attestation, does any
+    EARLIER-applied or same-batch attestation surround / get surrounded
+    by it (reference array.rs semantics)."""
+    surrounded = [False] * len(atts)
+    surrounds = [False] * len(atts)
+    for i, (v1, s1, t1) in enumerate(atts):
+        for j, (v2, s2, t2) in enumerate(atts):
+            if i == j or v1 != v2:
+                continue
+            if s2 < s1 and t2 > t1:
+                surrounded[i] = True
+            if s2 > s1 and t2 < t1:
+                surrounds[i] = True
+    return surrounded, surrounds
+
+
+def _run_device(V, H, atts, prior=()):
+    min_arr = np.full((V, H), NO_TARGET_MIN, np.int32)
+    max_arr = np.full((V, H), NO_TARGET_MAX, np.int32)
+    if prior:
+        pv = jnp.asarray([a[0] for a in prior], jnp.int32)
+        ps = jnp.asarray([a[1] for a in prior], jnp.int32)
+        pt = jnp.asarray([a[2] for a in prior], jnp.int32)
+        ok = jnp.ones(len(prior), bool)
+        min_arr, max_arr, _, _ = batch_update_jit(
+            jnp.asarray(min_arr), jnp.asarray(max_arr), pv, ps, pt, ok
+        )
+    v = jnp.asarray([a[0] for a in atts], jnp.int32)
+    s = jnp.asarray([a[1] for a in atts], jnp.int32)
+    t = jnp.asarray([a[2] for a in atts], jnp.int32)
+    ok = jnp.ones(len(atts), bool)
+    _, _, surrounded, surrounds = batch_update_jit(
+        jnp.asarray(min_arr), jnp.asarray(max_arr), v, s, t, ok
+    )
+    return np.asarray(surrounded), np.asarray(surrounds)
+
+
+def test_simple_surround_pair():
+    # (s=1, t=4) surrounds (s=2, t=3)
+    atts = [(0, 1, 4), (0, 2, 3)]
+    surrounded, surrounds = _run_device(4, 8, atts)
+    assert list(surrounded) == [False, True]
+    assert list(surrounds) == [True, False]
+
+
+def test_existing_state_surround():
+    # prior attestation surrounds a later batch's attestation
+    surrounded, surrounds = _run_device(
+        4, 8, atts=[(1, 3, 4)], prior=[(1, 2, 6)]
+    )
+    assert list(surrounded) == [True]
+    # and the reverse direction
+    surrounded, surrounds = _run_device(
+        4, 8, atts=[(1, 1, 7)], prior=[(1, 2, 6)]
+    )
+    assert list(surrounds) == [True]
+
+
+def test_no_false_positives_on_doubles_and_same_source():
+    # same source, different target: NOT a surround either way
+    atts = [(2, 3, 5), (2, 3, 6)]
+    surrounded, surrounds = _run_device(4, 8, atts)
+    assert not any(surrounded) and not any(surrounds)
+    # identical attestations are not self-surrounding
+    atts = [(2, 3, 5), (2, 3, 5)]
+    surrounded, surrounds = _run_device(4, 8, atts)
+    assert not any(surrounded) and not any(surrounds)
+
+
+def test_randomized_against_brute_force():
+    V, H = 8, 16
+    for trial in range(10):
+        n = rng.randrange(2, 20)
+        atts = []
+        for _ in range(n):
+            s = rng.randrange(0, H - 1)
+            t = rng.randrange(s, H)
+            atts.append((rng.randrange(V), s, t))
+        want_surrounded, want_surrounds = _brute_force(H, atts)
+        got_surrounded, got_surrounds = _run_device(V, H, atts)
+        assert list(got_surrounded) == want_surrounded, (trial, atts)
+        assert list(got_surrounds) == want_surrounds, (trial, atts)
+
+
+def test_masked_lanes_contribute_nothing():
+    min_arr = jnp.full((4, 8), NO_TARGET_MIN, jnp.int32)
+    max_arr = jnp.full((4, 8), NO_TARGET_MAX, jnp.int32)
+    v = jnp.asarray([0, 0], jnp.int32)
+    s = jnp.asarray([1, 2], jnp.int32)
+    t = jnp.asarray([7, 3], jnp.int32)
+    valid = jnp.asarray([False, True])
+    new_min, new_max, surrounded, surrounds = batch_update_jit(
+        min_arr, max_arr, v, s, t, valid
+    )
+    # the masked (0,1,7) attestation must not flag (0,2,3) as surrounded
+    assert not bool(surrounded[1])
+    assert int(new_max[0, 1]) == NO_TARGET_MAX  # no write from masked lane

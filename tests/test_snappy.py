@@ -115,3 +115,17 @@ def test_frame_padding_chunk_skipped():
     pad = bytes([0xFE]) + (4).to_bytes(3, "little") + b"\x00" * 4
     enc[10:10] = pad
     assert sc.frame_decompress(bytes(enc)) == data
+
+
+def test_python_decoder_rejects_truncated_copies():
+    """Regression: truncated copy tags raise SnappyError (not IndexError)
+    on the pure-Python path — node._deliver only catches SnappyError."""
+    lib, sc._lib = sc._lib, False
+    try:
+        for evil in (b"\x04\x01", b"\x04\x02\x01", b"\x04\x03\x01\x02"):
+            with pytest.raises(sc.SnappyError):
+                sc.decompress_block(evil)
+        with pytest.raises(sc.SnappyError):
+            sc.decompress_block(b"\x04" + bytes([63 << 2]) + b"\x01")
+    finally:
+        sc._lib = lib
