@@ -21,7 +21,10 @@ _lib_lock = threading.Lock()
 
 def build(force: bool = False) -> bool:
     """Compile the shared library with g++; returns True on success."""
-    if os.path.exists(_SO) and not force:
+    fresh = os.path.exists(_SO) and os.path.getmtime(
+        _SO
+    ) >= os.path.getmtime(_SRC)
+    if fresh and not force:
         return True
     cxx = os.environ.get("CXX", "g++")
     cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]

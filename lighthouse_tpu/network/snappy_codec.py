@@ -34,7 +34,10 @@ def _load():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO):
+        stale = os.path.exists(_SO) and os.path.getmtime(
+            _SO
+        ) < os.path.getmtime(_SRC)
+        if not os.path.exists(_SO) or stale:
             cc = os.environ.get("CC", "cc")
             # compile to a private temp file and rename into place:
             # concurrent processes must never CDLL a half-written .so

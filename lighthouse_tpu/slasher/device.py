@@ -46,7 +46,6 @@ def batch_update(min_arr, max_arr, v_idx, s, t, valid):
     surround verdicts covering both existing state and pairs WITHIN the
     batch (post-update re-check)."""
     V, H = max_arr.shape
-    sur_pre, srs_pre = _gather_checks(min_arr, max_arr, v_idx, s, t, valid)
 
     # scatter the batch extremes at the source column, then run the
     # extremum along the epoch axis:
@@ -72,18 +71,15 @@ def batch_update(min_arr, max_arr, v_idx, s, t, valid):
     new_max = jnp.maximum(max_arr, run_max)
     new_min = jnp.minimum(min_arr, run_min)
 
-    # post-update pass: batch-internal surrounds now visible
-    sur_post, srs_post = _gather_checks(
+    # ONE post-update pass suffices: the updated arrays are pointwise
+    # extremal vs the inputs and the gather conditions are monotone, so
+    # every pre-existing conflict is still visible, and batch-internal
+    # pairs become visible too. Self-hits are impossible: an
+    # attestation's own write fills max[v][e>=s] / min[v][e<=s], never
+    # the max[v][s-1] / min[v][s+1] cells it checks.
+    surrounded, surrounds = _gather_checks(
         new_min, new_max, v_idx, s, t, valid
     )
-    # an attestation "is surrounded" post-update also when it equals its
-    # own contribution; exclude self-hits by requiring a STRICT conflict
-    # beyond what this attestation itself wrote:
-    #   its own write puts t at max_targets[v][e>=s] and min[v][e<=s],
-    #   which never touches max[v][s-1] nor min[v][s+1] rows for itself,
-    #   so self-exclusion is automatic.
-    surrounded = sur_pre | sur_post
-    surrounds = srs_pre | srs_post
     return new_min, new_max, surrounded, surrounds
 
 

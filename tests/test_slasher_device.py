@@ -14,7 +14,7 @@ from lighthouse_tpu.slasher.device import (
 rng = random.Random(13)
 
 
-def _brute_force(history, atts):
+def _brute_force(atts):
     """Sequentially applied ground truth: for each attestation, does any
     EARLIER-applied or same-batch attestation surround / get surrounded
     by it (reference array.rs semantics)."""
@@ -93,7 +93,7 @@ def test_randomized_against_brute_force():
             s = rng.randrange(0, H - 1)
             t = rng.randrange(s, H)
             atts.append((rng.randrange(V), s, t))
-        want_surrounded, want_surrounds = _brute_force(H, atts)
+        want_surrounded, want_surrounds = _brute_force(atts)
         got_surrounded, got_surrounds = _run_device(V, H, atts)
         assert list(got_surrounded) == want_surrounded, (trial, atts)
         assert list(got_surrounds) == want_surrounds, (trial, atts)
