@@ -30,6 +30,16 @@ class EventBus:
                 self._subs[t].append(q)
         return q
 
+    def unsubscribe(self, q):
+        """Detach a subscriber queue from every topic — callers must pair
+        this with subscribe() or the bus leaks dead queues."""
+        with self._lock:
+            for subs in self._subs.values():
+                try:
+                    subs.remove(q)
+                except ValueError:
+                    pass
+
     def publish(self, topic: str, payload: dict):
         with self._lock:
             subs = list(self._subs.get(topic, ()))

@@ -57,6 +57,8 @@ class BeaconApiServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path.split("?")[0] == "/eth/v1/events":
+                    return self._serve_events()
                 try:
                     out = api.handle_get(self.path)
                     if isinstance(out, tuple):
@@ -69,6 +71,60 @@ class BeaconApiServer:
                     )
                 except Exception as e:  # pragma: no cover
                     self._send(500, {"code": 500, "message": str(e)})
+
+            def _serve_events(self):
+                """Server-sent events stream (/eth/v1/events?topics=…,
+                beacon_chain/src/events.rs + the http_api SSE route).
+                Streams until the client disconnects or the idle window
+                passes with no events. Unknown topics are a 400, per the
+                standard beacon API."""
+                import queue as _queue
+                from urllib.parse import parse_qs, urlparse
+
+                from lighthouse_tpu.beacon_chain.events import TOPICS
+
+                try:
+                    q = urlparse(self.path)
+                    requested = [
+                        t
+                        for part in parse_qs(q.query).get("topics", [])
+                        for t in part.split(",")
+                        if t
+                    ]
+                    bad = [t for t in requested if t not in TOPICS]
+                    if bad:
+                        return self._send(
+                            400,
+                            {
+                                "code": 400,
+                                "message": f"unknown topics {bad}",
+                            },
+                        )
+                    wanted = requested or list(TOPICS)
+                    sub = api.chain.events.subscribe(wanted)
+                except Exception as e:
+                    return self._send(500, {"code": 500, "message": str(e)})
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                idle_limit = getattr(api, "sse_idle_seconds", 10.0)
+                try:
+                    while True:
+                        try:
+                            ev = sub.get(timeout=idle_limit)
+                        except _queue.Empty:
+                            break
+                        frame = (
+                            f"event: {ev['event']}\n"
+                            f"data: {json.dumps(ev['data'])}\n\n"
+                        )
+                        self.wfile.write(frame.encode())
+                        self.wfile.flush()
+                except OSError:
+                    pass  # client went away mid-stream
+                finally:
+                    api.chain.events.unsubscribe(sub)
 
             def do_POST(self):
                 try:

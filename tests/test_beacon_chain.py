@@ -209,3 +209,68 @@ def test_revert_to_fork_boundary(rig):
     nxt = h.produce_block(chain.head_state.slot + 1, [])
     new_root = chain.process_block(nxt)
     assert chain.head_root == new_root
+
+
+def test_sse_events_stream(rig):
+    """/eth/v1/events streams head/block events as SSE frames
+    (events.rs + the http_api SSE route)."""
+    import threading
+    import urllib.request
+
+    from lighthouse_tpu.http_api.server import BeaconApiServer
+
+    h, chain = rig
+    srv = BeaconApiServer(chain)
+    srv.sse_idle_seconds = 3.0
+    srv.start()
+    frames, errors = [], []
+    connected = threading.Event()
+
+    def reader():
+        try:
+            req = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}"
+                "/eth/v1/events?topics=block,head",
+                timeout=10,
+            )
+            if req.headers.get("Content-Type") != "text/event-stream":
+                raise AssertionError(req.headers.get("Content-Type"))
+            connected.set()
+            while True:
+                line = req.readline()
+                if not line:
+                    break
+                frames.append(line.decode())
+        except Exception as e:  # surfaced in the main thread below
+            errors.append(e)
+            connected.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    assert connected.wait(timeout=10)
+    # headers arrive after subscribe() in _serve_events, so the
+    # subscription is registered once the reader sees them
+    assert not errors, errors
+    block = h.advance_slot_with_block(1)
+    chain.process_block(block)
+    t.join(timeout=15)
+    assert not errors, errors
+    text = "".join(frames)
+    assert "event: block" in text
+    assert "data: " in text
+
+    # unknown topics are a 400, and closed subscribers are detached
+    import urllib.error
+
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/eth/v1/events?topics=blocks",
+            timeout=5,
+        )
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    srv.stop()
+    assert all(
+        not subs for subs in chain.events._subs.values()
+    ), "SSE subscriber queue leaked"
