@@ -71,10 +71,58 @@ def _ensure_backend():
     return jax, "cpu"
 
 
-def main():
-    import numpy as np
+def _run_cpu_fallback():
+    """In-process CPU bench (flip first, then measure)."""
+    import jax
 
-    jax, platform = _ensure_backend()
+    from lighthouse_tpu.backend import force_cpu_backend
+
+    force_cpu_backend(1)
+    _measure(jax, "cpu")
+
+
+def main():
+    """Two-stage watchdog: the TPU attempt runs in a SUBPROCESS with a
+    hard deadline (the tunnel can hang mid-compile, not just at init);
+    on any failure the CPU fallback runs in-process so the driver always
+    gets exactly one JSON line on stdout."""
+    import subprocess
+
+    if os.environ.get("BENCH_INNER") == "1":
+        jax, platform = _ensure_backend()
+        _measure(jax, platform)
+        return
+
+    env = dict(os.environ, BENCH_INNER="1")
+    deadline = float(os.environ.get("BENCH_TPU_DEADLINE", "480"))
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            timeout=deadline,
+            capture_output=True,
+            env=env,
+        )
+        lines = [
+            ln
+            for ln in r.stdout.decode().splitlines()
+            if ln.startswith("{")
+        ]
+        if r.returncode == 0 and lines:
+            print(lines[-1])
+            return
+        sys.stderr.write(r.stderr.decode(errors="replace"))
+        print(
+            f"bench: inner run failed (rc={r.returncode}); CPU fallback",
+            file=sys.stderr,
+        )
+    except (subprocess.TimeoutExpired, OSError) as e:
+        print(f"bench: inner run hung/failed ({e!r}); CPU fallback",
+              file=sys.stderr)
+    _run_cpu_fallback()
+
+
+def _measure(jax, platform):
+    import numpy as np
 
     from lighthouse_tpu import testing as td
     from lighthouse_tpu.ops import batch_verify

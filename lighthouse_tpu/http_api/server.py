@@ -100,7 +100,9 @@ class BeaconApiServer:
                                 "message": f"unknown topics {bad}",
                             },
                         )
-                    wanted = requested or list(TOPICS)
+                    # dedupe: duplicate topics would double-register
+                    # the queue (and leak one copy on unsubscribe)
+                    wanted = list(dict.fromkeys(requested)) or list(TOPICS)
                     sub = api.chain.events.subscribe(wanted)
                 except Exception as e:
                     return self._send(500, {"code": 500, "message": str(e)})
@@ -108,7 +110,13 @@ class BeaconApiServer:
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.end_headers()
-                idle_limit = getattr(api, "sse_idle_seconds", 10.0)
+                # idle window must exceed the slot interval or steady-state
+                # consumers get disconnected between block events
+                idle_limit = getattr(
+                    api,
+                    "sse_idle_seconds",
+                    4.0 * api.chain.spec.SECONDS_PER_SLOT,
+                )
                 try:
                     while True:
                         try:
