@@ -1,0 +1,84 @@
+"""On-disk validator directory layout.
+
+Role of common/validator_dir + account_utils: each validator gets
+`<base>/0x<pubkey>/` holding its EIP-2335 voting keystore and a PID
+lockfile guarding against two processes loading the same keys; passwords
+live in a sibling secrets dir keyed by pubkey.
+"""
+
+import os
+
+from lighthouse_tpu.accounts.keystore import Keystore
+from lighthouse_tpu.common.lockfile import Lockfile
+
+VOTING_KEYSTORE_FILE = "voting-keystore.json"
+LOCK_FILE = ".lock"
+
+
+class ValidatorDir:
+    def __init__(self, path: str):
+        self.path = path
+        self.lock = Lockfile(os.path.join(path, LOCK_FILE))
+
+    @property
+    def pubkey_hex(self) -> str:
+        return os.path.basename(self.path)
+
+    @classmethod
+    def create(
+        cls,
+        base_dir: str,
+        keystore: Keystore,
+        password: str,
+        secrets_dir: str | None = None,
+    ) -> "ValidatorDir":
+        """Materialize `<base>/0x<pubkey>/voting-keystore.json` (+ the
+        password in the secrets dir)."""
+        name = "0x" + keystore.pubkey_hex
+        path = os.path.join(base_dir, name)
+        os.makedirs(path, mode=0o700, exist_ok=True)
+
+        def _write_private(p: str, content: str):
+            # 0600: keystores and plaintext passwords must not be
+            # world-readable on shared hosts
+            fd = os.open(p, os.O_CREAT | os.O_TRUNC | os.O_WRONLY, 0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(content)
+
+        _write_private(
+            os.path.join(path, VOTING_KEYSTORE_FILE), keystore.to_json()
+        )
+        if secrets_dir is not None:
+            os.makedirs(secrets_dir, mode=0o700, exist_ok=True)
+            _write_private(os.path.join(secrets_dir, name), password)
+        return cls(path)
+
+    def voting_keystore(self) -> Keystore:
+        with open(os.path.join(self.path, VOTING_KEYSTORE_FILE)) as f:
+            return Keystore.from_json(f.read())
+
+    def decrypt_voting_key(
+        self, password: str | None = None, secrets_dir: str | None = None
+    ) -> bytes:
+        if password is None:
+            if secrets_dir is None:
+                raise ValueError("need a password or a secrets dir")
+            with open(
+                os.path.join(secrets_dir, self.pubkey_hex)
+            ) as f:
+                # tolerate `echo pw > file`-style provisioning
+                password = f.read().rstrip("\n")
+        return self.voting_keystore().decrypt(password)
+
+
+def list_validator_dirs(base_dir: str):
+    if not os.path.isdir(base_dir):
+        return []
+    return [
+        ValidatorDir(os.path.join(base_dir, d))
+        for d in sorted(os.listdir(base_dir))
+        if d.startswith("0x")
+        and os.path.isfile(
+            os.path.join(base_dir, d, VOTING_KEYSTORE_FILE)
+        )
+    ]

@@ -1,0 +1,117 @@
+"""PID lockfiles for datadir/keystore exclusivity.
+
+Role of common/lockfile + validator_dir's `.lock` files: two validator
+client processes must never hold the same keys (a local double-run is a
+self-inflicted doppelganger). A Lockfile contains the holder's PID and
+is considered stale — and reclaimed — only if that PID is dead.
+
+Race-safety protocol:
+  * the PID is written to a private temp file FIRST and published with
+    an atomic os.link, so a visible lockfile always carries its
+    holder's pid (no empty-file window);
+  * stale reclaim steals the file with an atomic os.rename to a private
+    name — exactly one racer wins the rename — and re-verifies the
+    stolen copy still names the dead pid before discarding it;
+  * an unparsable pidfile is treated as HELD (fail closed).
+"""
+
+import os
+
+
+class LockfileError(Exception):
+    pass
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+
+
+class Lockfile:
+    def __init__(self, path: str):
+        self.path = path
+        self._held = False
+
+    def _publish(self) -> bool:
+        """Atomically create the lockfile already containing our pid."""
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(str(os.getpid()))
+            try:
+                os.link(tmp, self.path)
+                return True
+            except FileExistsError:
+                return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+
+    def _holder_pid(self, path):
+        try:
+            with open(path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def acquire(self):
+        while True:
+            if self._publish():
+                self._held = True
+                return self
+            pid = self._holder_pid(self.path)
+            if pid is None:
+                # unreadable mid-publish or garbage: fail closed
+                raise LockfileError(
+                    f"{self.path} exists with unreadable holder"
+                )
+            if _pid_alive(pid):
+                raise LockfileError(f"{self.path} held by live pid {pid}")
+            # stale: steal atomically — only one racer wins the rename
+            stolen = f"{self.path}.{os.getpid()}.stale"
+            try:
+                os.rename(self.path, stolen)
+            except FileNotFoundError:
+                continue  # another racer already reclaimed; retry
+            # re-verify the stolen copy really named the dead holder
+            stolen_pid = self._holder_pid(stolen)
+            if stolen_pid is not None and _pid_alive(stolen_pid):
+                # a racer reclaimed and published between our liveness
+                # check and the rename: restore its lock and fail closed
+                try:
+                    os.link(stolen, self.path)
+                except FileExistsError:
+                    pass
+                try:
+                    os.unlink(stolen)
+                except FileNotFoundError:
+                    pass
+                raise LockfileError(
+                    f"{self.path} was re-acquired by live pid {stolen_pid}"
+                )
+            try:
+                os.unlink(stolen)
+            except FileNotFoundError:
+                pass
+
+    def release(self):
+        if self._held:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass
+            self._held = False
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc):
+        self.release()

@@ -197,3 +197,77 @@ def test_slashing_db_blocks_vc_equivocation(spec):
 
     with pytest.raises(SlashingError):
         vc.propose(1, producer2)
+
+
+def test_lockfile_exclusivity_and_stale_reclaim(tmp_path):
+    """common/lockfile + validator_dir .lock semantics: a live holder
+    excludes, a dead holder's lock is reclaimed."""
+    from lighthouse_tpu.common.lockfile import Lockfile, LockfileError
+
+    path = str(tmp_path / "datadir.lock")
+    with Lockfile(path):
+        with pytest.raises(LockfileError):
+            Lockfile(path).acquire()
+    # released: acquirable again
+    lk = Lockfile(path).acquire()
+    lk.release()
+    # stale lock (dead pid) is silently reclaimed
+    with open(path, "w") as f:
+        f.write("999999999")
+    with Lockfile(path):
+        pass
+
+
+def test_validator_dir_layout_roundtrip(tmp_path):
+    """validator_dir: keystore + secrets layout, discovery, decryption,
+    and per-directory locking."""
+    from lighthouse_tpu.accounts.keystore import Keystore
+    from lighthouse_tpu.accounts.validator_dir import (
+        ValidatorDir,
+        list_validator_dirs,
+    )
+    from lighthouse_tpu.common.lockfile import LockfileError
+
+    base = str(tmp_path / "validators")
+    secrets = str(tmp_path / "secrets")
+    sk = bls.interop_keypairs(1)[0].sk
+    ks = Keystore.encrypt(
+        sk.to_bytes(), "pw1", kdf="pbkdf2",
+        pubkey=sk.public_key().to_bytes(),
+    )
+    vd = ValidatorDir.create(base, ks, "pw1", secrets_dir=secrets)
+    found = list_validator_dirs(base)
+    assert len(found) == 1
+    assert found[0].pubkey_hex == "0x" + ks.pubkey_hex
+    # decrypt via the secrets dir
+    assert found[0].decrypt_voting_key(secrets_dir=secrets) == sk.to_bytes()
+    # the lock guards double-use
+    with vd.lock:
+        with pytest.raises(LockfileError):
+            found[0].lock.acquire()
+
+
+def test_secrets_files_are_private_and_newline_tolerant(tmp_path):
+    import os
+    import stat
+
+    from lighthouse_tpu.accounts.keystore import Keystore
+    from lighthouse_tpu.accounts.validator_dir import ValidatorDir
+
+    base, secrets = str(tmp_path / "v"), str(tmp_path / "s")
+    sk = bls.interop_keypairs(1)[0].sk
+    ks = Keystore.encrypt(
+        sk.to_bytes(), "pw", kdf="pbkdf2",
+        pubkey=sk.public_key().to_bytes(),
+    )
+    vd = ValidatorDir.create(base, ks, "pw", secrets_dir=secrets)
+    name = "0x" + ks.pubkey_hex
+    for f in (
+        os.path.join(vd.path, "voting-keystore.json"),
+        os.path.join(secrets, name),
+    ):
+        assert stat.S_IMODE(os.stat(f).st_mode) == 0o600, f
+    # trailing newline in an operator-provisioned password file is fine
+    with open(os.path.join(secrets, name), "w") as f:
+        f.write("pw\n")
+    assert vd.decrypt_voting_key(secrets_dir=secrets) == sk.to_bytes()
