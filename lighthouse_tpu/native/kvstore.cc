@@ -10,7 +10,11 @@
 // Record framing (little-endian u32 lengths, 1-byte op):
 //   [op][col_len][key_len][val_len][col][key][val]   op: 1=put 2=del
 // A record is only honored on replay if fully present (torn tail
-// records from a crash are ignored).
+// records from a crash are ignored). Batches are framed as ONE outer
+// record (op 3, col/key empty, val = concatenated inner records), so a
+// crash mid-batch drops the whole batch on replay — all-or-nothing like
+// a LevelDB WriteBatch. An optional fsync mode (kv_set_fsync) makes
+// each committed write durable via fdatasync.
 
 #include <unistd.h>
 
@@ -44,6 +48,7 @@ struct Store {
   FILE* log = nullptr;
   std::unordered_map<ColumnKey, std::string, ColumnKeyHash> data;
   uint64_t log_records = 0;
+  bool fsync_writes = false;
 };
 
 void append_u32(std::string& out, uint32_t v) {
@@ -67,6 +72,56 @@ std::string frame(uint8_t op, const std::string& col, const std::string& key,
   return rec;
 }
 
+// Apply one inner (op 1/2) record to the map. Returns false on corrupt op.
+bool apply_record(Store* s, uint8_t op, std::string col, std::string key,
+                  std::string val) {
+  if (op == 1) {
+    s->data[ColumnKey{std::move(col), std::move(key)}] = std::move(val);
+  } else if (op == 2) {
+    s->data.erase(ColumnKey{std::move(col), std::move(key)});
+  } else {
+    return false;
+  }
+  s->log_records++;
+  return true;
+}
+
+// Parse a group payload (concatenated inner records) and apply every
+// record. The payload was already length-framed by the outer record, so
+// it is either fully present or the whole group was dropped as torn.
+// The group is fully parsed and validated BEFORE any record is applied,
+// so a corrupt group leaves the map untouched (all-or-nothing even
+// against in-place corruption, not just torn tails).
+bool apply_group(Store* s, const std::string& payload) {
+  struct Rec {
+    uint8_t op;
+    std::string col, key, val;
+  };
+  std::vector<Rec> recs;
+  size_t off = 0;
+  while (off < payload.size()) {
+    if (off + 13 > payload.size()) return false;
+    uint8_t op = static_cast<uint8_t>(payload[off]);
+    if (op != 1 && op != 2) return false;
+    uint32_t cl, kl, vl;
+    memcpy(&cl, payload.data() + off + 1, 4);
+    memcpy(&kl, payload.data() + off + 5, 4);
+    memcpy(&vl, payload.data() + off + 9, 4);
+    off += 13;
+    if (off + static_cast<size_t>(cl) + kl + vl > payload.size())
+      return false;
+    recs.push_back(Rec{op, payload.substr(off, cl),
+                       payload.substr(off + cl, kl),
+                       payload.substr(off + cl + kl, vl)});
+    off += static_cast<size_t>(cl) + kl + vl;
+  }
+  for (auto& r : recs) {
+    apply_record(s, r.op, std::move(r.col), std::move(r.key),
+                 std::move(r.val));
+  }
+  return true;
+}
+
 bool replay(Store* s) {
   FILE* f = fopen(s->path.c_str(), "rb");
   if (!f) return true;  // fresh store
@@ -83,14 +138,12 @@ bool replay(Store* s) {
         (kl && !read_exact(f, key.data(), kl)) ||
         (vl && !read_exact(f, val.data(), vl)))
       break;  // torn body
-    if (op == 1) {
-      s->data[ColumnKey{col, key}] = val;
-    } else if (op == 2) {
-      s->data.erase(ColumnKey{col, key});
-    } else {
+    if (op == 3) {
+      if (!apply_group(s, val)) break;  // corrupt group payload
+    } else if (!apply_record(s, op, std::move(col), std::move(key),
+                             std::move(val))) {
       break;  // corrupt stream
     }
-    s->log_records++;
     valid_end = ftell(f);
   }
   fclose(f);
@@ -100,9 +153,12 @@ bool replay(Store* s) {
 }
 
 bool write_all(Store* s, const std::string& bytes) {
+  if (!s->log) return false;  // e.g. reopen failed after compaction
   if (fwrite(bytes.data(), 1, bytes.size(), s->log) != bytes.size())
     return false;
-  return fflush(s->log) == 0;
+  if (fflush(s->log) != 0) return false;
+  if (s->fsync_writes && fdatasync(fileno(s->log)) != 0) return false;
+  return true;
 }
 
 }  // namespace
@@ -134,21 +190,26 @@ int kv_put(void* h, const char* col, uint32_t cl, const char* key,
   return 0;
 }
 
-// batch: ops/cols/keys/vals flattened; one buffered write = atomic-enough
-// (a torn tail drops only trailing records on replay, preserving prefix
-// semantics like a LevelDB WriteBatch under crash).
+// batch: ops/cols/keys/vals flattened; written as ONE op-3 group record
+// whose payload is the concatenated inner records. Replay applies it
+// all-or-nothing (a torn group is dropped entirely), matching LevelDB
+// WriteBatch crash semantics.
 int kv_put_batch(void* h, uint32_t n, const uint8_t* ops,
                  const char* const* cols, const uint32_t* cls,
                  const char* const* keys, const uint32_t* kls,
                  const char* const* vals, const uint32_t* vls) {
   Store* s = static_cast<Store*>(h);
-  std::string buf;
+  std::string payload;
   for (uint32_t i = 0; i < n; i++) {
-    buf += frame(ops[i], std::string(cols[i], cls[i]),
-                 std::string(keys[i], kls[i]),
-                 std::string(vals[i] ? vals[i] : "", vls[i]));
+    payload += frame(ops[i], std::string(cols[i], cls[i]),
+                     std::string(keys[i], kls[i]),
+                     std::string(vals[i] ? vals[i] : "", vls[i]));
   }
-  if (!write_all(s, buf)) return -1;
+  // the outer record's u32 length field bounds a group at 4 GiB; callers
+  // split larger batches (the Python wrapper does) rather than let the
+  // cast truncate and corrupt the log
+  if (payload.size() > 0xffffffffull) return -2;
+  if (!write_all(s, frame(3, "", "", payload))) return -1;
   for (uint32_t i = 0; i < n; i++) {
     ColumnKey ck{std::string(cols[i], cls[i]), std::string(keys[i], kls[i])};
     if (ops[i] == 1) {
@@ -169,6 +230,7 @@ int kv_get(void* h, const char* col, uint32_t cl, const char* key,
   if (it == s->data.end()) return 0;
   *out_len = static_cast<uint32_t>(it->second.size());
   *out = static_cast<char*>(malloc(it->second.size() ? it->second.size() : 1));
+  if (!*out) return -1;
   memcpy(*out, it->second.data(), it->second.size());
   return 1;
 }
@@ -198,9 +260,15 @@ int kv_keys(void* h, const char* col, uint32_t cl, char** out,
   }
   *out_len = static_cast<uint32_t>(buf.size());
   *out = static_cast<char*>(malloc(buf.size() ? buf.size() : 1));
+  if (!*out) return -1;
   memcpy(*out, buf.data(), buf.size());
   *count = n;
   return 0;
+}
+
+// 1 = fdatasync after every committed write; 0 = flush-only (default).
+void kv_set_fsync(void* h, int on) {
+  static_cast<Store*>(h)->fsync_writes = on != 0;
 }
 
 uint64_t kv_record_count(void* h) {

@@ -82,6 +82,8 @@ def _load():
             ctypes.POINTER(ctypes.c_uint32),
             ctypes.POINTER(ctypes.c_uint32),
         ]
+        lib.kv_set_fsync.restype = None
+        lib.kv_set_fsync.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.kv_record_count.restype = ctypes.c_uint64
         lib.kv_record_count.argtypes = [ctypes.c_void_p]
         lib.kv_live_count.restype = ctypes.c_uint64
@@ -106,12 +108,18 @@ class NativeKVStore:
     """KVStore backed by the C++ append-log store. Thread-safe via a
     coarse lock (the reference serializes writes through LevelDB too)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, fsync: bool = False):
         self._lib = _load()
         self._h = self._lib.kv_open(path.encode())
         if not self._h:
             raise RuntimeError(f"kv_open failed for {path}")
+        if fsync:
+            self._lib.kv_set_fsync(self._h, 1)
         self._lock = threading.Lock()
+
+    def set_fsync(self, on: bool) -> None:
+        with self._lock:
+            self._lib.kv_set_fsync(self._h, 1 if on else 0)
 
     def get(self, column: bytes, key: bytes):
         out = ctypes.POINTER(ctypes.c_char)()
@@ -121,6 +129,8 @@ class NativeKVStore:
                 self._h, column, len(column), key, len(key),
                 ctypes.byref(out), ctypes.byref(out_len),
             )
+            if found < 0:
+                raise MemoryError("kv_get allocation failed")
             if not found:
                 return None
             try:
@@ -146,11 +156,28 @@ class NativeKVStore:
         if rc != 0:
             raise IOError("kv_delete failed")
 
+    # one group record is bounded by its u32 length field; stay well
+    # below it and split giant batches (each chunk all-or-nothing)
+    _BATCH_PAYLOAD_LIMIT = 1 << 30
+
     def put_batch(self, items) -> None:
         items = [(c, k, bytes(v)) for c, k, v in items]
-        n = len(items)
-        if n == 0:
+        if not items:
             return
+        chunks, chunk, size = [], [], 0
+        for it in items:
+            rec = 13 + len(it[0]) + len(it[1]) + len(it[2])
+            if chunk and size + rec > self._BATCH_PAYLOAD_LIMIT:
+                chunks.append(chunk)
+                chunk, size = [], 0
+            chunk.append(it)
+            size += rec
+        chunks.append(chunk)
+        for chunk in chunks:
+            self._put_batch_chunk(chunk)
+
+    def _put_batch_chunk(self, items) -> None:
+        n = len(items)
         ops = (ctypes.c_uint8 * n)(*([1] * n))
         cols = (ctypes.c_char_p * n)(*[c for c, _, _ in items])
         cls_ = (ctypes.c_uint32 * n)(*[len(c) for c, _, _ in items])
@@ -170,11 +197,13 @@ class NativeKVStore:
         out_len = ctypes.c_uint32()
         count = ctypes.c_uint32()
         with self._lock:
-            self._lib.kv_keys(
+            rc = self._lib.kv_keys(
                 self._h, column, len(column),
                 ctypes.byref(out), ctypes.byref(out_len),
                 ctypes.byref(count),
             )
+            if rc != 0:
+                raise MemoryError("kv_keys allocation failed")
             try:
                 blob = ctypes.string_at(out, out_len.value)
             finally:

@@ -292,13 +292,14 @@ def gnosis_spec(**overrides) -> Spec:
     base = replace(
         mainnet_spec(),
         name="gnosis",
+        SLOTS_PER_EPOCH=16,
         SECONDS_PER_SLOT=5,
         MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=4096,
         MIN_GENESIS_TIME=1638968400,
         GENESIS_DELAY=6000,
         GENESIS_FORK_VERSION=bytes.fromhex("00000064"),
         ALTAIR_FORK_VERSION=bytes.fromhex("01000064"),
-        ALTAIR_FORK_EPOCH=512,
+        ALTAIR_FORK_EPOCH=256,
         BELLATRIX_FORK_VERSION=bytes.fromhex("02000064"),
         ETH1_FOLLOW_DISTANCE=1024,
         SECONDS_PER_ETH1_BLOCK=6,

@@ -79,5 +79,8 @@ class DoppelgangerService:
     def signing_enabled(self, validator_index: int) -> bool:
         st = self.states.get(validator_index)
         if st is None:
-            return True  # never registered => not gated
+            # Fail closed: an unregistered key has served no quiet window
+            # and must not sign. Callers register keys (including ones
+            # added after startup) so the window actually starts.
+            return False
         return not st.detected and st.remaining_epochs <= 0

@@ -133,19 +133,25 @@ class KeymanagerServer:
                 if not path_ok:
                     return self._send(404, {"message": "not found"})
                 statuses = []
+                deleted = []
                 for pk_hex in body.get("pubkeys", []):
                     pk = bytes.fromhex(pk_hex[2:])
                     if pk in km.store.validators:
                         km.store.remove_validator(pk)
+                        deleted.append(pk)
                         statuses.append({"status": "deleted"})
                     else:
                         statuses.append({"status": "not_found"})
                 resp = {"data": statuses}
                 if self.path == "/eth/v1/keystores":
                     # deletion exports the slashing-protection history for
-                    # the removed keys (keymanager spec)
+                    # the removed keys only, under the chain's real GVR
+                    # (keymanager spec)
                     resp["slashing_protection"] = (
-                        km.store.slashing_db.export_interchange(b"\x00" * 32)
+                        km.store.slashing_db.export_interchange(
+                            km.store.genesis_validators_root,
+                            only_pubkeys=deleted,
+                        )
                     )
                 return self._send(200, resp)
 

@@ -124,7 +124,14 @@ class SlashingProtectionDB:
 
     # --------------------------------------------------------- interchange
 
-    def export_interchange(self, genesis_validators_root: bytes) -> str:
+    def export_interchange(
+        self,
+        genesis_validators_root: bytes,
+        only_pubkeys=None,
+    ) -> str:
+        """EIP-3076 export. `only_pubkeys` restricts the document to those
+        keys (the keymanager DELETE flow exports just the deleted keys'
+        history, not every validator's)."""
         with self._lock:
             data = {
                 "metadata": {
@@ -141,6 +148,8 @@ class SlashingProtectionDB:
                     "UNION SELECT DISTINCT pubkey FROM signed_attestations"
                 )
             }
+            if only_pubkeys is not None:
+                pubkeys &= {bytes(pk) for pk in only_pubkeys}
             for pk in sorted(pubkeys):
                 blocks = self._conn.execute(
                     "SELECT slot, signing_root FROM signed_blocks "

@@ -149,6 +149,10 @@ class ValidatorClient:
             self._started_epoch = epoch
         svc = getattr(self, "_doppelganger", None)
         if svc is not None:
+            # keys added after attach_doppelganger start their own quiet
+            # window here (the service fails closed until registered)
+            for index in self.keys:
+                svc.register(index, epoch)
             svc.check_epoch(epoch)
 
     def signing_enabled(self, epoch: int) -> bool:

@@ -32,10 +32,12 @@ class ValidatorStore:
         self,
         slashing_db: SlashingProtectionDB | None = None,
         doppelganger_epochs: int = 0,
+        genesis_validators_root: bytes = b"\x00" * 32,
     ):
         self.validators: dict[bytes, InitializedValidator] = {}
         self.slashing_db = slashing_db or SlashingProtectionDB()
         self.doppelganger_epochs = doppelganger_epochs
+        self.genesis_validators_root = genesis_validators_root
         self._started_epoch: int | None = None
         self.metrics = {"signed": 0, "blocked": 0}
 

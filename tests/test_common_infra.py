@@ -238,7 +238,11 @@ def test_spec_presets_and_yaml_config():
     g = gnosis_spec()
     assert g.SECONDS_PER_SLOT == 5
     assert g.GENESIS_FORK_VERSION == bytes.fromhex("00000064")
-    assert g.SLOTS_PER_EPOCH == mainnet_spec().SLOTS_PER_EPOCH
+    # gnosis runs 16-slot epochs (eth_spec.rs:334 SlotsPerEpoch = U16)
+    # and activated altair at epoch 256 (chain_spec.rs:756)
+    assert g.SLOTS_PER_EPOCH == 16
+    assert g.ALTAIR_FORK_EPOCH == 256
+    assert mainnet_spec().SLOTS_PER_EPOCH == 32
 
     s = spec_from_config_yaml(
         """

@@ -58,6 +58,38 @@ def test_torn_tail_record_ignored(tmp_path):
     db3.close()
 
 
+def test_torn_batch_dropped_whole(tmp_path):
+    """A batch is one group record: a crash mid-batch must drop the WHOLE
+    batch on replay (LevelDB WriteBatch all-or-nothing), never apply a
+    prefix of it."""
+    path = str(tmp_path / "kv.log")
+    db = kvstore.NativeKVStore(path)
+    db.put(b"c", b"base", b"v0")
+    db.close()
+    size_before = os.path.getsize(path)
+    db = kvstore.NativeKVStore(path)
+    db.put_batch([(b"c", b"a", b"1"), (b"c", b"b", b"2"), (b"c", b"z", b"3")])
+    db.close()
+    # simulate a crash that tore the tail of the group record
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 2)
+    db2 = kvstore.NativeKVStore(path)
+    assert db2.get(b"c", b"base") == b"v0"
+    # none of the batch survives — not even its intact prefix records
+    assert db2.get(b"c", b"a") is None
+    assert db2.get(b"c", b"b") is None
+    assert db2.get(b"c", b"z") is None
+    db2.close()
+    # an intact batch replays fully (and fsync mode stays functional)
+    db3 = kvstore.NativeKVStore(path, fsync=True)
+    db3.put_batch([(b"c", b"a", b"1"), (b"c", b"b", b"2")])
+    db3.close()
+    db4 = kvstore.NativeKVStore(path)
+    assert db4.get(b"c", b"a") == b"1" and db4.get(b"c", b"b") == b"2"
+    db4.close()
+    assert os.path.getsize(path) > size_before
+
+
 def test_batch_and_compaction(tmp_path):
     path = str(tmp_path / "kv.log")
     db = kvstore.NativeKVStore(path)

@@ -265,7 +265,13 @@ def test_doppelganger_service_liveness_detection():
     # further quiet epochs do not un-latch detection
     svc.check_epoch(14)
     assert not svc.signing_enabled(7)
-    # unregistered validators are not gated
+    # unregistered validators fail CLOSED: no quiet window served yet
+    assert not svc.signing_enabled(99)
+    # ...and registering one starts its own window from scratch
+    svc.register(99, current_epoch=14)
+    assert not svc.signing_enabled(99)
+    svc.check_epoch(16)  # queries 15: quiet
+    svc.check_epoch(17)  # queries 16: quiet -> window served
     assert svc.signing_enabled(99)
 
 
