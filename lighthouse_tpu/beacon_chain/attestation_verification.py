@@ -125,13 +125,14 @@ def batch_verify_unaggregated(chain, state, attestations):
             )
     if sets:
         ok = bls.verify_signature_sets(sets, backend=chain.backend)
+        # batch failure -> exact per-set verdicts in ONE extra device
+        # call (per-set residues), not a round trip per set
         verdicts = (
             [True] * len(sets)
             if ok
-            else [
-                bls.verify_signature_sets([s], backend=chain.backend)
-                for s in sets
-            ]
+            else bls.verify_signature_sets_individually(
+                sets, backend=chain.backend
+            )
         )
         for (i, indices), good in zip(set_owner, verdicts):
             att = attestations[i]
@@ -198,14 +199,16 @@ def batch_verify_aggregates(chain, state, signed_aggregates):
     if triples:
         flat = [s for triple in triples for s in triple]
         ok = bls.verify_signature_sets(flat, backend=chain.backend)
-        verdicts = (
-            [True] * len(triples)
-            if ok
-            else [
-                bls.verify_signature_sets(t, backend=chain.backend)
-                for t in triples
+        if ok:
+            verdicts = [True] * len(triples)
+        else:
+            per_set = bls.verify_signature_sets_individually(
+                flat, backend=chain.backend
+            )
+            verdicts = [
+                all(per_set[3 * i : 3 * i + 3])
+                for i in range(len(triples))
             ]
-        )
         for (i, indices), good in zip(owners, verdicts):
             sap = signed_aggregates[i]
             if good:

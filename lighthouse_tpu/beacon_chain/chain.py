@@ -14,14 +14,19 @@ block_verification.rs:21-44).
 import time
 
 from lighthouse_tpu.beacon_chain import attestation_verification as attn
+from lighthouse_tpu.beacon_chain import sync_committee_verification as syncv
 from lighthouse_tpu.beacon_chain.naive_aggregation_pool import (
     NaiveAggregationPool,
+    SyncContributionPool,
+    SyncMessageAggregationPool,
 )
 from lighthouse_tpu.beacon_chain.observed import (
     ObservedAggregates,
     ObservedAggregators,
     ObservedAttesters,
     ObservedBlockProducers,
+    ObservedSyncAggregators,
+    ObservedSyncContributors,
 )
 from lighthouse_tpu.beacon_chain.operation_pool import OperationPool
 from lighthouse_tpu.fork_choice import ForkChoice
@@ -121,6 +126,12 @@ class BeaconChain:
         self.observed_aggregators = ObservedAggregators()
         self.observed_aggregates = ObservedAggregates()
         self.observed_block_producers = ObservedBlockProducers()
+        # sync-committee message plane (sync_committee_verification.rs)
+        self.sync_message_pool = SyncMessageAggregationPool(spec, self.t)
+        self.sync_contribution_pool = SyncContributionPool(spec, self.t)
+        self.observed_sync_contributors = ObservedSyncContributors()
+        self.observed_sync_aggregators = ObservedSyncAggregators()
+        self.observed_sync_contributions = ObservedAggregates()
 
         self._justified_balances = [
             v.effective_balance for v in genesis_state.validators
@@ -184,6 +195,11 @@ class BeaconChain:
         self.fork_choice.set_slot(slot)
         self.naive_pool.prune(slot)
         self.observed_aggregates.prune(slot)
+        self.sync_message_pool.prune(slot)
+        self.sync_contribution_pool.prune(slot)
+        self.observed_sync_contributors.prune(slot)
+        self.observed_sync_aggregators.prune(slot)
+        self.observed_sync_contributions.prune(slot)
 
     def committee_for(self, data):
         """Committee for an AttestationData via the per-epoch shuffling
@@ -567,6 +583,148 @@ class BeaconChain:
                 self.op_pool.insert_attestation(res.attestation)
                 self.metrics["attestations_processed"] += 1
         return results
+
+    # ----------------------------------------------------- sync committee
+
+    def process_sync_messages(self, messages):
+        """Gossip batch of SyncCommitteeMessages: verify (one device
+        batch) and merge into the per-subcommittee contribution pool
+        (sync_committee_verification.rs:622 + naive aggregation)."""
+        state = self.head_state
+        results = syncv.batch_verify_sync_messages(self, state, messages)
+        for res in results:
+            if isinstance(res, syncv.VerifiedSyncMessage):
+                self.sync_message_pool.insert(res)
+                self.metrics["sync_messages_processed"] = (
+                    self.metrics.get("sync_messages_processed", 0) + 1
+                )
+        return results
+
+    def process_signed_contributions(self, signed_contributions):
+        """Gossip batch of SignedContributionAndProofs: verify (3 sets
+        each, one device batch) and keep the best per subcommittee for
+        block inclusion (sync_committee_verification.rs:422 +
+        VerifiedSyncContribution::add_to_pool)."""
+        state = self.head_state
+        results = syncv.batch_verify_contributions(
+            self, state, signed_contributions
+        )
+        for res in results:
+            if isinstance(res, syncv.VerifiedContribution):
+                self.sync_contribution_pool.insert(
+                    res.signed_contribution.message.contribution
+                )
+                self.metrics["contributions_processed"] = (
+                    self.metrics.get("contributions_processed", 0) + 1
+                )
+        return results
+
+    def produce_sync_aggregate(self, proposal_slot: int):
+        """SyncAggregate for a block proposed at `proposal_slot`: the
+        pooled contributions voting on the previous slot's block root."""
+        prev_slot = max(proposal_slot, 1) - 1
+        prev_root = self.store.get_canonical_block_root(prev_slot)
+        if prev_root is None:
+            prev_root = self.head_root
+        return self.sync_contribution_pool.produce_sync_aggregate(
+            prev_slot, prev_root
+        )
+
+    # ---------------------------------------------------------- production
+
+    def produce_attestation_data(self, slot: int, committee_index: int):
+        """AttestationData for (slot, committee) on the canonical head —
+        the BN half of the VC attestation flow (served over GET
+        /eth/v1/validator/attestation_data; the reference answers this
+        from attester/early-attester caches)."""
+        from lighthouse_tpu.state_processing.helpers import (
+            get_block_root_at_slot,
+        )
+
+        spec = self.spec
+        state = self.head_state
+        epoch = spec.slot_to_epoch(slot)
+        start_slot = spec.epoch_start_slot(epoch)
+        if state.slot > start_slot:
+            target_root = bytes(
+                get_block_root_at_slot(state, start_slot, spec)
+            )
+        else:
+            target_root = self.head_root
+        return self.t.AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=self.head_root,
+            source=state.current_justified_checkpoint,
+            target=self.t.Checkpoint(epoch=epoch, root=target_root),
+        )
+
+    def produce_block_unsigned(
+        self, slot: int, randao_reveal: bytes, graffiti: bytes = b"\x00" * 32
+    ):
+        """Unsigned block for `slot` on the canonical head — the VC-facing
+        half of block production (beacon_chain.rs:3014 produce_block /
+        :3144 produce_block_on_state, served over GET
+        /eth/v2/validator/blocks/{slot}): attestations packed from the
+        operation pool by greedy max-cover, slashings/exits from the pool,
+        the sync aggregate from pooled contributions, and the post-state
+        root computed with signatures skipped."""
+        from lighthouse_tpu.state_processing.helpers import (
+            get_beacon_proposer_index,
+        )
+
+        spec = self.spec
+        state = self.head_state.copy()
+        if state.slot > slot:
+            raise ValueError(f"head already past slot {slot}")
+        state = process_slots(state, slot, spec)
+        fork_name = spec.fork_name_at_epoch(get_current_epoch(state, spec))
+        proposer = get_beacon_proposer_index(state, spec)
+
+        attestations = self.op_pool.get_attestations(
+            state, spec.MAX_ATTESTATIONS
+        )
+        slashings_exits = self.op_pool.get_slashings_and_exits(state)
+        proposer_slashings, attester_slashings, exits = slashings_exits
+
+        body_cls = self.t.block_body_classes[fork_name]
+        body = body_cls(
+            randao_reveal=bytes(randao_reveal),
+            eth1_data=state.eth1_data,
+            graffiti=bytes(graffiti),
+            attestations=attestations,
+            deposits=[],
+            voluntary_exits=exits,
+            proposer_slashings=proposer_slashings,
+            attester_slashings=attester_slashings,
+        )
+        parent_root = self.head_root
+        if fork_name != "phase0":
+            body.sync_aggregate = self.produce_sync_aggregate(slot)
+        if fork_name == "bellatrix":
+            builder = getattr(self, "payload_builder", None)
+            if builder is not None:
+                body.execution_payload = builder(state)
+
+        block_cls = self.t.block_classes[fork_name]
+        block = block_cls(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=parent_root,
+            state_root=ZERO_BYTES32,
+            body=body,
+        )
+        trial = state.copy()
+        signed_cls = self.t.signed_block_classes[fork_name]
+        per_block_processing(
+            trial,
+            signed_cls(message=block, signature=b"\x00" * 96),
+            spec,
+            BlockSignatureStrategy.NO_VERIFICATION,
+            self.pubkey_cache,
+        )
+        block.state_root = type(trial).hash_tree_root(trial)
+        return block
 
     # --------------------------------------------------------------- head
 

@@ -62,3 +62,125 @@ class NaiveAggregationPool:
         cutoff = current_slot - SLOTS_RETAINED + 1
         for slot in [s for s in self._by_slot if s < cutoff]:
             del self._by_slot[slot]
+
+
+class SyncMessageAggregationPool:
+    """Naive aggregation of sync-committee messages into per-subcommittee
+    contributions (naive_aggregation_pool.rs `SyncContributionAggregateMap`:
+    keyed by SyncContributionData = (slot, block root, subcommittee)).
+
+    Messages are inserted with the validator's positions inside each
+    subcommittee (one message can land in several subcommittees)."""
+
+    def __init__(self, spec, types):
+        self.spec = spec
+        self.t = types
+        # (slot, root, subcommittee) -> contribution under construction
+        self._contributions: dict[tuple, object] = {}
+
+    def insert(self, verified_msg) -> str:
+        msg = verified_msg.message
+        size = max(
+            self.spec.SYNC_COMMITTEE_SIZE
+            // self.spec.SYNC_COMMITTEE_SUBNET_COUNT,
+            1,
+        )
+        outcome = InsertOutcome.ALREADY_KNOWN
+        for subcommittee, positions in verified_msg.subnet_positions.items():
+            key = (msg.slot, bytes(msg.beacon_block_root), subcommittee)
+            existing = self._contributions.get(key)
+            if existing is None:
+                bits = [False] * size
+                for p in positions:
+                    bits[p] = True
+                self._contributions[key] = self.t.SyncCommitteeContribution(
+                    slot=msg.slot,
+                    beacon_block_root=bytes(msg.beacon_block_root),
+                    subcommittee_index=subcommittee,
+                    aggregation_bits=bits,
+                    signature=bytes(msg.signature),
+                )
+                outcome = InsertOutcome.NEW
+                continue
+            old_bits = list(existing.aggregation_bits)
+            if all(old_bits[p] for p in positions):
+                continue
+            for p in positions:
+                old_bits[p] = True
+            existing.aggregation_bits = old_bits
+            existing.signature = bls.aggregate_signatures(
+                [
+                    bls.Signature.from_bytes(bytes(existing.signature)),
+                    bls.Signature.from_bytes(bytes(msg.signature)),
+                ]
+            ).to_bytes()
+            outcome = InsertOutcome.AGGREGATED
+        return outcome
+
+    def get_contribution(
+        self, slot: int, beacon_block_root: bytes, subcommittee: int
+    ):
+        return self._contributions.get(
+            (slot, bytes(beacon_block_root), subcommittee)
+        )
+
+    def prune(self, current_slot: int):
+        cutoff = current_slot - SLOTS_RETAINED + 1
+        for k in [k for k in self._contributions if k[0] < cutoff]:
+            del self._contributions[k]
+
+
+class SyncContributionPool:
+    """Verified SignedContributionAndProofs awaiting block inclusion;
+    keeps the best (most-participants) contribution per (slot, root,
+    subcommittee) and assembles the block's SyncAggregate
+    (operation_pool sync_aggregate assembly in the reference)."""
+
+    def __init__(self, spec, types):
+        self.spec = spec
+        self.t = types
+        self._best: dict[tuple, object] = {}
+
+    def insert(self, contribution) -> None:
+        key = (
+            contribution.slot,
+            bytes(contribution.beacon_block_root),
+            contribution.subcommittee_index,
+        )
+        existing = self._best.get(key)
+        if existing is None or sum(
+            map(bool, contribution.aggregation_bits)
+        ) > sum(map(bool, existing.aggregation_bits)):
+            self._best[key] = contribution.copy()
+
+    def produce_sync_aggregate(self, slot: int, beacon_block_root: bytes):
+        """SyncAggregate for a block at `slot`+1 voting on the block root
+        at `slot` — OR of the best contribution per subcommittee."""
+        spec = self.spec
+        size = max(
+            spec.SYNC_COMMITTEE_SIZE // spec.SYNC_COMMITTEE_SUBNET_COUNT, 1
+        )
+        bits = [False] * spec.SYNC_COMMITTEE_SIZE
+        sigs = []
+        for sub in range(spec.SYNC_COMMITTEE_SUBNET_COUNT):
+            c = self._best.get((slot, bytes(beacon_block_root), sub))
+            if c is None:
+                continue
+            for offset, bit in enumerate(c.aggregation_bits):
+                if bit:
+                    bits[sub * size + offset] = True
+            sigs.append(bls.Signature.from_bytes(bytes(c.signature)))
+        signature = (
+            bls.aggregate_signatures(sigs).to_bytes()
+            if sigs
+            else bls.INFINITY_SIGNATURE_BYTES
+        )
+        return self.t.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=signature,
+        )
+
+    def prune(self, current_slot: int):
+        cutoff = current_slot - SLOTS_RETAINED + 1
+        for k in [k for k in self._best if k[0] < cutoff]:
+            del self._best[k]

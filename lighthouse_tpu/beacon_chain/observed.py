@@ -71,3 +71,38 @@ class ObservedBlockProducers:
     def prune(self, finalized_slot: int):
         for k in [k for k in self._seen if k[0] < finalized_slot]:
             del self._seen[k]
+
+
+class ObservedSyncContributors:
+    """validator x (slot, subcommittee) first-seen filter for sync
+    committee messages (observed_attesters.rs SlotSubcommitteeIndex
+    variant used by sync_committee_verification.rs)."""
+
+    def __init__(self):
+        self._seen: dict[tuple[int, int], set[int]] = {}
+
+    def observe(
+        self, slot: int, subcommittee_index: int, validator_index: int
+    ) -> bool:
+        """Returns True if already seen (and records the observation)."""
+        bucket = self._seen.setdefault((slot, subcommittee_index), set())
+        if validator_index in bucket:
+            return True
+        bucket.add(validator_index)
+        return False
+
+    def is_known(
+        self, slot: int, subcommittee_index: int, validator_index: int
+    ) -> bool:
+        return validator_index in self._seen.get(
+            (slot, subcommittee_index), ()
+        )
+
+    def prune(self, current_slot: int, retained: int = 3):
+        for k in [k for k in self._seen if k[0] < current_slot - retained]:
+            del self._seen[k]
+
+
+class ObservedSyncAggregators(ObservedSyncContributors):
+    """aggregator x (slot, subcommittee) first-seen filter for signed
+    contribution-and-proofs."""

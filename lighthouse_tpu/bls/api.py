@@ -98,13 +98,19 @@ def interop_keypairs(n: int) -> list[Keypair]:
 
 
 class PublicKey:
-    """Validated G1 point (never infinity, always in-subgroup)."""
+    """Validated G1 point (never infinity, always in-subgroup).
 
-    __slots__ = ("point", "_bytes")
+    `validator_index`/`cache` are set by the chain's PubkeyCache so the
+    TPU backend can ship table indices instead of points (the
+    validator_pubkey_cache.rs analog's device half)."""
+
+    __slots__ = ("point", "_bytes", "validator_index", "cache")
 
     def __init__(self, point_jacobian, compressed: bytes | None = None):
         self.point = point_jacobian
         self._bytes = compressed
+        self.validator_index = None
+        self.cache = None
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "PublicKey":
@@ -159,6 +165,11 @@ class Signature:
 
 
 def aggregate_signatures(sigs) -> Signature:
+    sigs = list(sigs)
+    if not sigs:
+        # spec Aggregate() precondition: n >= 1 (the official bls
+        # aggregate vectors expect an error for the empty list)
+        raise BlsError("aggregate of zero signatures")
     acc = G2_GROUP.infinity
     for s in sigs:
         acc = G2_GROUP.add(acc, s.point)
@@ -268,4 +279,29 @@ def verify_signature_sets(
         from lighthouse_tpu.bls.tpu_backend import verify_signature_sets_tpu
 
         return verify_signature_sets_tpu(sets, seed=seed)
+    raise BlsError(f"unknown BLS backend {backend!r}")
+
+
+def verify_signature_sets_individually(
+    sets, backend: str | None = None
+) -> list:
+    """Per-set verdicts for a batch — the exact-fallback half of the
+    reference's batch semantics (attestation batch.rs:115-131): when the
+    RLC batch fails, recover which sets are bad. On the tpu backend this
+    is ONE extra device call (per-set pairing residues), not a round trip
+    per set. Empty input -> empty list."""
+    sets = list(sets)
+    if not sets:
+        return []
+    backend = backend or _DEFAULT_BACKEND
+    if backend == "fake":
+        return [True] * len(sets)
+    if backend == "ref":
+        return [_verify_one_ref(s) for s in sets]
+    if backend == "tpu":
+        from lighthouse_tpu.bls.tpu_backend import (
+            verify_signature_sets_tpu_individual,
+        )
+
+        return verify_signature_sets_tpu_individual(sets)
     raise BlsError(f"unknown BLS backend {backend!r}")

@@ -1,0 +1,79 @@
+"""Device-resident decompressed validator pubkey table.
+
+Role of beacon_node/beacon_chain/src/validator_pubkey_cache.rs:9-24 on
+the TPU plane (SURVEY §7 hard part 4): decompression and limb packing
+happen ONCE per validator at registration; a signature batch then ships
+(S, K) int32 validator indices instead of 48-byte points, and the device
+gathers affine Montgomery limbs from HBM-resident tables. At 30k sigs a
+slot this removes all per-pubkey Python bigint work from the hot path.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import P
+from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
+from lighthouse_tpu.ops import fieldb as fb
+
+
+def _mont_limbs(values) -> np.ndarray:
+    """ints -> (N, NB) Montgomery-domain canonical limbs, host-side
+    (cheap: one bigint mulmod per value; avoids a device round-trip per
+    append)."""
+    return fb.pack_ints([(v << 384) % P for v in values])
+
+
+class DevicePubkeyTable:
+    """(capacity, 1, NB) x/y Montgomery limb arrays on device, indexed by
+    validator index + 1. Row 0 is a zero row so masked-out gather lanes
+    read a harmless (0, 0); capacity grows in powers of two so the jitted
+    gather-verify graph recompiles O(log N) times over a chain's life."""
+
+    def __init__(self):
+        self._x_np = np.zeros((1, 1, fb.NB), dtype=np.int64)
+        self._y_np = np.zeros((1, 1, fb.NB), dtype=np.int64)
+        self._x = None
+        self._y = None
+        self.count = 0  # validator rows (excludes the zero row)
+
+    def append(self, pubkeys) -> None:
+        """Append decompressed `bls.PublicKey`s (one-time per validator)."""
+        if not pubkeys:
+            return
+        affs = [G1_GROUP.to_affine(p.point) for p in pubkeys]
+        xs = _mont_limbs([a[0] for a in affs])[:, None, :]
+        ys = _mont_limbs([a[1] for a in affs])[:, None, :]
+        used = self.count + 1
+        self._x_np = np.concatenate([self._x_np[:used], xs], axis=0)
+        self._y_np = np.concatenate([self._y_np[:used], ys], axis=0)
+        self.count += len(pubkeys)
+        self._x = None  # re-uploaded (padded) on next rows()
+
+    def _capacity(self) -> int:
+        cap = 8
+        while cap < self.count + 1:
+            cap *= 2
+        return cap
+
+    def rows(self):
+        """(x, y) device arrays, shape (capacity, 1, NB); validator i
+        lives at row i+1."""
+        if self._x is None:
+            cap = self._capacity()
+            pad = cap - self._x_np.shape[0]
+            widths = ((0, pad), (0, 0), (0, 0))
+            self._x = jnp.asarray(
+                np.pad(self._x_np, widths).astype(np.int32)
+            )
+            self._y = jnp.asarray(
+                np.pad(self._y_np, widths).astype(np.int32)
+            )
+        return self._x, self._y
+
+    @staticmethod
+    def gather_indices(validator_indices) -> np.ndarray:
+        """Host helper: validator indices -> table row indices (shifting
+        past the zero row; -1 == masked lane -> row 0)."""
+        idx = np.asarray(validator_indices, dtype=np.int32)
+        return np.where(idx >= 0, idx + 1, 0).astype(np.int32)

@@ -7,12 +7,23 @@ jit recompiles only per (set-bucket, key-bucket) shape class — the
 TPU-native replacement for the reference's dynamic per-set heap vectors
 (crypto/bls/src/impls/blst.rs:90-108).
 
-Messages are hashed to G2 on the host (hash_to_curve), pubkey/signature
-points are shipped as affine Montgomery limbs. Signature subgroup checks
-run host-side before dispatch, mirroring blst.rs:72-81.
+Hot-path design (SURVEY §7 hard part 4, validator_pubkey_cache.rs:9-24):
+  * pubkeys tagged by the chain's PubkeyCache ship as int32 table indices;
+    the device gathers affine Montgomery limbs from the HBM-resident
+    DevicePubkeyTable — zero per-pubkey Python work per batch.
+  * message hash_to_g2 results are memoized — a slot's 30k attestation
+    sets share ~committee-count distinct messages, so the cache collapses
+    the per-set cost to a dict hit.
+  * signature/message Jacobian->affine conversion uses one simultaneous
+    (Montgomery-trick) inversion per batch instead of one Fp2 inversion
+    per point.
+
+Signature subgroup checks run host-side before dispatch, mirroring
+blst.rs:72-81.
 """
 
 import secrets
+import time
 
 import numpy as np
 
@@ -24,6 +35,13 @@ from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
 from lighthouse_tpu.ops import batch_verify, curve, fieldb as fb, fp2
 
 _jitted = None
+_jitted_indexed = None
+
+# host-marshalling telemetry for the last dispatched batch (read by bench)
+LAST_HOST_STATS: dict = {}
+
+# device-dispatch counters (read by tests asserting the <=2-call fallback)
+CALL_COUNTS = {"batch": 0, "individual": 0}
 
 
 def _get_fn():
@@ -33,11 +51,83 @@ def _get_fn():
     return _jitted
 
 
+def _indexed_verify(
+    msgs, sigs, table_x, table_y, indices, key_mask, rand_bits, set_mask
+):
+    """Gather pubkey limb rows by validator index on device, then verify."""
+    import jax.numpy as jnp
+
+    pk_x = jnp.take(table_x, indices, axis=0)  # (S, K, 1, NB)
+    pk_y = jnp.take(table_y, indices, axis=0)
+    return batch_verify.verify_signature_sets(
+        msgs, sigs, (pk_x, pk_y), key_mask, rand_bits, set_mask
+    )
+
+
+def _get_indexed_fn():
+    global _jitted_indexed
+    if _jitted_indexed is None:
+        _jitted_indexed = jax.jit(_indexed_verify)
+    return _jitted_indexed
+
+
 def _bucket(n: int, minimum: int) -> int:
     b = minimum
     while b < n:
         b *= 2
     return b
+
+
+# --------------------------------------------------------- message hashing
+
+_MSG_CACHE: dict = {}
+_MSG_CACHE_MAX = 16_384
+
+
+def _msg_affine(message: bytes):
+    """Memoized hash_to_g2 -> affine ints. Attestation batches repeat the
+    same signing root across a whole committee."""
+    message = bytes(message)
+    hit = _MSG_CACHE.get(message)
+    if hit is None:
+        hit = G2_GROUP.to_affine(hash_to_g2(message))
+        if len(_MSG_CACHE) >= _MSG_CACHE_MAX:
+            _MSG_CACHE.clear()
+        _MSG_CACHE[message] = hit
+    return hit
+
+
+# ----------------------------------------------- batched affine conversion
+
+_F2 = G2_GROUP.F
+
+
+def batch_to_affine_g2(points):
+    """Jacobian G2 points -> affine, ONE Fp2 inversion total (Montgomery
+    simultaneous-inversion trick). Infinity points -> None."""
+    zs, keep = [], []
+    for i, pt in enumerate(points):
+        if not G2_GROUP.is_infinity(pt):
+            zs.append(pt[2])
+            keep.append(i)
+    out = [None] * len(points)
+    if not zs:
+        return out
+    # prefix products
+    prefix = [zs[0]]
+    for z in zs[1:]:
+        prefix.append(_F2.mul(prefix[-1], z))
+    acc = _F2.inv(prefix[-1])
+    invs = [None] * len(zs)
+    for j in range(len(zs) - 1, 0, -1):
+        invs[j] = _F2.mul(acc, prefix[j - 1])
+        acc = _F2.mul(acc, zs[j])
+    invs[0] = acc
+    for j, i in enumerate(keep):
+        x, y, _ = points[i]
+        zi2 = _F2.sqr(invs[j])
+        out[i] = (_F2.mul(x, zi2), _F2.mul(y, _F2.mul(zi2, invs[j])))
+    return out
 
 
 def _pack_g1_affine(affs):
@@ -53,63 +143,217 @@ def _pack_g2_affine(affs):
     return (fb.to_mont(xs), fb.to_mont(ys))
 
 
+def _rlc_scalars(n: int, seed):
+    """Full 64-bit RLC coefficients (blst.rs:15 RAND_BITS), seeded for
+    deterministic tests or from the OS entropy pool in production."""
+    top = 1 << batch_verify.RAND_BITS
+    if seed is not None:
+        rng = np.random.default_rng(seed)
+        return [
+            int(rng.integers(1, top, dtype=np.uint64)) for _ in range(n)
+        ]
+    return [1 + secrets.randbelow(top - 1) for _ in range(n)]
+
+
+def _table_for(sets):
+    """The shared DevicePubkeyTable when EVERY pubkey in every set is
+    tagged by one PubkeyCache covering its index; else None."""
+    cache = None
+    for s in sets:
+        for p in s.pubkeys:
+            c = getattr(p, "cache", None)
+            idx = getattr(p, "validator_index", None)
+            if c is None or idx is None:
+                return None
+            if cache is None:
+                cache = c
+            elif c is not cache:
+                return None
+    if cache is None:
+        return None
+    table = cache.device_table()
+    return table if table.count == len(cache) else None
+
+
+class _Marshalled:
+    """Static-shaped device inputs for one batch of SignatureSets."""
+
+    __slots__ = (
+        "msgs",
+        "sigs",
+        "key_mask",
+        "set_mask",
+        "table",
+        "indices",
+        "pubkeys",
+        "s_bucket",
+        "k_bucket",
+        "timings",
+    )
+
+
+def _marshal(sets) -> _Marshalled:
+    t0 = time.perf_counter()
+    n_sets = len(sets)
+    max_keys = max(len(s.pubkeys) for s in sets)
+    m = _Marshalled()
+    m.s_bucket = _bucket(n_sets, 4)
+    m.k_bucket = _bucket(max_keys, 1)
+
+    msgs = [_msg_affine(s.message) for s in sets]
+    sigs = batch_to_affine_g2([s.signature.point for s in sets])
+    msgs += [None] * (m.s_bucket - n_sets)
+    sigs += [None] * (m.s_bucket - n_sets)
+    t1 = time.perf_counter()
+
+    m.set_mask = np.array(
+        [True] * n_sets + [False] * (m.s_bucket - n_sets), dtype=bool
+    )
+    m.key_mask = np.array(
+        [
+            [True] * len(s.pubkeys)
+            + [False] * (m.k_bucket - len(s.pubkeys))
+            for s in sets
+        ]
+        + [[False] * m.k_bucket] * (m.s_bucket - n_sets),
+        dtype=bool,
+    )
+
+    m.table = _table_for(sets)
+    if m.table is not None:
+        indices = np.full((m.s_bucket, m.k_bucket), -1, dtype=np.int32)
+        for i, s in enumerate(sets):
+            for k, p in enumerate(s.pubkeys):
+                indices[i, k] = p.validator_index
+        m.indices = m.table.gather_indices(indices)
+        m.pubkeys = None
+    else:
+        # untagged pubkeys: legacy per-point packing
+        pk_rows = []
+        for s in sets:
+            row = [G1_GROUP.to_affine(p.point) for p in s.pubkeys]
+            pk_rows.append(row + [None] * (m.k_bucket - len(row)))
+        pk_rows += [[None] * m.k_bucket] * (m.s_bucket - n_sets)
+        pk_flat = [p for row in pk_rows for p in row]
+        pk_x, pk_y = _pack_g1_affine(pk_flat)
+        m.indices = None
+        m.pubkeys = (
+            np.asarray(pk_x).reshape(m.s_bucket, m.k_bucket, 1, fb.NB),
+            np.asarray(pk_y).reshape(m.s_bucket, m.k_bucket, 1, fb.NB),
+        )
+    m.msgs = _pack_g2_affine(msgs)
+    m.sigs = _pack_g2_affine(sigs)
+    t2 = time.perf_counter()
+    m.timings = {"points_ms": (t1 - t0) * 1e3, "pack_ms": (t2 - t1) * 1e3}
+    return m
+
+
+def _record_stats(n_sets, m, t_start, t_subgroup, t_marshal, t_end):
+    LAST_HOST_STATS.clear()
+    LAST_HOST_STATS.update(
+        {
+            "n_sets": n_sets,
+            "indexed_path": m.table is not None,
+            "subgroup_ms": (t_subgroup - t_start) * 1e3,
+            "points_ms": m.timings["points_ms"],
+            "pack_ms": m.timings["pack_ms"],
+            "host_ms": (t_marshal - t_start) * 1e3,
+            "device_ms": (t_end - t_marshal) * 1e3,
+        }
+    )
+
+
 def verify_signature_sets_tpu(sets, seed: int | None = None) -> bool:
+    t_start = time.perf_counter()
     # host-side policy checks (exact reference semantics)
     for s in sets:
         if s.signature.is_infinity() or not s.signature.in_subgroup():
             return False
+    t_subgroup = time.perf_counter()
 
-    n_sets = len(sets)
-    max_keys = max(len(s.pubkeys) for s in sets)
-    s_bucket = _bucket(n_sets, 4)
-    k_bucket = _bucket(max_keys, 1)
+    m = _marshal(sets)
+    rand_bits = curve.scalars_to_bits(
+        _rlc_scalars(m.s_bucket, seed), batch_verify.RAND_BITS
+    )
+    t_marshal = time.perf_counter()
 
-    rng = np.random.default_rng(seed) if seed is not None else None
-
-    msgs, sigs, pk_rows, key_mask = [], [], [], []
-    for s in sets:
-        msgs.append(G2_GROUP.to_affine(hash_to_g2(s.message)))
-        sigs.append(G2_GROUP.to_affine(s.signature.point))
-        row = [G1_GROUP.to_affine(p.point) for p in s.pubkeys]
-        key_mask.append(
-            [True] * len(row) + [False] * (k_bucket - len(row))
+    CALL_COUNTS["batch"] += 1
+    if m.table is not None:
+        tx, ty = m.table.rows()
+        ok = _get_indexed_fn()(
+            m.msgs, m.sigs, tx, ty, m.indices, m.key_mask, rand_bits,
+            m.set_mask,
         )
-        pk_rows.append(row + [None] * (k_bucket - len(row)))
-    for _ in range(s_bucket - n_sets):
-        msgs.append(None)
-        sigs.append(None)
-        pk_rows.append([None] * k_bucket)
-        key_mask.append([False] * k_bucket)
-
-    set_mask = np.array(
-        [True] * n_sets + [False] * (s_bucket - n_sets), dtype=bool
-    )
-    key_mask = np.array(key_mask, dtype=bool)
-
-    if rng is not None:
-        scalars = [
-            int(rng.integers(1, 1 << 63)) for _ in range(s_bucket)
-        ]
     else:
-        scalars = [
-            1 + secrets.randbelow((1 << batch_verify.RAND_BITS) - 1)
-            for _ in range(s_bucket)
-        ]
-    rand_bits = curve.scalars_to_bits(scalars, batch_verify.RAND_BITS)
+        ok = _get_fn()(
+            m.msgs, m.sigs, m.pubkeys, m.key_mask, rand_bits, m.set_mask
+        )
+    result = bool(np.asarray(ok))
+    _record_stats(
+        len(sets), m, t_start, t_subgroup, t_marshal, time.perf_counter()
+    )
+    return result
 
-    pk_flat = [p for row in pk_rows for p in row]
-    pk_x, pk_y = _pack_g1_affine(pk_flat)
-    pubkeys = (
-        np.asarray(pk_x).reshape(s_bucket, k_bucket, 1, fb.NB),
-        np.asarray(pk_y).reshape(s_bucket, k_bucket, 1, fb.NB),
+
+def _indexed_individual(
+    msgs, sigs, table_x, table_y, indices, key_mask, set_mask
+):
+    import jax.numpy as jnp
+
+    pk_x = jnp.take(table_x, indices, axis=0)
+    pk_y = jnp.take(table_y, indices, axis=0)
+    return batch_verify.verify_signature_sets_individual(
+        msgs, sigs, (pk_x, pk_y), key_mask, set_mask
     )
 
-    ok = _get_fn()(
-        _pack_g2_affine(msgs),
-        _pack_g2_affine(sigs),
-        pubkeys,
-        key_mask,
-        rand_bits,
-        set_mask,
+
+_jitted_individual = None
+_jitted_individual_indexed = None
+
+
+def _get_individual_fns():
+    global _jitted_individual, _jitted_individual_indexed
+    if _jitted_individual is None:
+        _jitted_individual = jax.jit(
+            batch_verify.verify_signature_sets_individual
+        )
+        _jitted_individual_indexed = jax.jit(_indexed_individual)
+    return _jitted_individual, _jitted_individual_indexed
+
+
+def verify_signature_sets_tpu_individual(sets) -> list:
+    """Per-set verdicts in ONE device call — the batch-failure fallback
+    without per-set round trips (attestation batch.rs:115-131 made
+    device-shaped; SURVEY §7 hard part 5)."""
+    t_start = time.perf_counter()
+    verdicts = [True] * len(sets)
+    live = []
+    for i, s in enumerate(sets):
+        if s.signature.is_infinity() or not s.signature.in_subgroup():
+            verdicts[i] = False
+        else:
+            live.append(i)
+    if not live:
+        return verdicts
+    t_subgroup = time.perf_counter()
+
+    subset = [sets[i] for i in live]
+    m = _marshal(subset)
+    t_marshal = time.perf_counter()
+
+    plain_fn, indexed_fn = _get_individual_fns()
+    CALL_COUNTS["individual"] += 1
+    if m.table is not None:
+        tx, ty = m.table.rows()
+        ok = indexed_fn(
+            m.msgs, m.sigs, tx, ty, m.indices, m.key_mask, m.set_mask
+        )
+    else:
+        ok = plain_fn(m.msgs, m.sigs, m.pubkeys, m.key_mask, m.set_mask)
+    ok = np.asarray(ok)
+    for j, i in enumerate(live):
+        verdicts[i] = bool(ok[j])
+    _record_stats(
+        len(sets), m, t_start, t_subgroup, t_marshal, time.perf_counter()
     )
-    return bool(np.asarray(ok))
+    return verdicts

@@ -145,6 +145,7 @@ class Harness:
         voluntary_exits=(),
         proposer_slashings=(),
         attester_slashings=(),
+        sync_aggregate=None,
     ):
         """Produce a signed block for `slot` on top of the current state."""
         spec = self.spec
@@ -180,7 +181,14 @@ class Harness:
                 if state.slot > 0
                 else self.head_block_root(state)
             )
-            body.sync_aggregate = self.make_sync_aggregate(state, prev_root)
+            # caller-provided aggregate (e.g. a chain's contribution pool)
+            # wins; default is the harness's omniscient full-participation
+            # aggregate
+            body.sync_aggregate = (
+                sync_aggregate
+                if sync_aggregate is not None
+                else self.make_sync_aggregate(state, prev_root)
+            )
         if fork_name == "bellatrix" and self.payload_builder is not None:
             body.execution_payload = self.payload_builder(state)
 

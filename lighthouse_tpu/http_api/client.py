@@ -12,7 +12,20 @@ from urllib.error import HTTPError
 
 
 class ApiClientError(Exception):
-    pass
+    def __init__(self, message, status=None, body=b""):
+        super().__init__(message)
+        self.status = status
+        self.body = body
+
+    def failure_indices(self):
+        """Per-item failure indices from a pool-style 400 body
+        (IndexedErrorMessage in the reference API), or None."""
+        try:
+            doc = json.loads(self.body)
+            failures = json.loads(doc["message"])
+            return [int(f["index"]) for f in failures]
+        except (ValueError, KeyError, TypeError):
+            return None
 
 
 class BeaconNodeHttpClient:
@@ -41,8 +54,11 @@ class BeaconNodeHttpClient:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return json.loads(r.read() or b"{}")
         except HTTPError as e:
+            err_body = e.read()
             raise ApiClientError(
-                f"POST {path}: {e.code} {e.read()[:200]!r}"
+                f"POST {path}: {e.code} {err_body[:200]!r}",
+                status=e.code,
+                body=err_body,
             ) from e
 
     # ------------------------------------------------------------- routes
@@ -95,6 +111,76 @@ class BeaconNodeHttpClient:
             f"/eth/v1/validator/liveness/{epoch}",
             [str(i) for i in indices],
         )["data"]
+
+    def get_validators(self, ids=None, state_id: str = "head"):
+        q = ""
+        if ids:
+            q = "?id=" + ",".join(str(i) for i in ids)
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/validators{q}"
+        )["data"]
+
+    def post_attester_duties(self, epoch: int, indices):
+        return self._post(
+            f"/eth/v1/validator/duties/attester/{epoch}",
+            [str(i) for i in indices],
+        )["data"]
+
+    def post_sync_duties(self, epoch: int, indices):
+        return self._post(
+            f"/eth/v1/validator/duties/sync/{epoch}",
+            [str(i) for i in indices],
+        )["data"]
+
+    def get_attestation_data(self, slot: int, committee_index: int):
+        return self._get(
+            "/eth/v1/validator/attestation_data"
+            f"?slot={slot}&committee_index={committee_index}"
+        )["data"]
+
+    def get_aggregate_attestation(
+        self, slot: int, attestation_data_root: bytes
+    ):
+        return self._get(
+            "/eth/v1/validator/aggregate_attestation"
+            f"?slot={slot}"
+            f"&attestation_data_root=0x{bytes(attestation_data_root).hex()}"
+        )["data"]
+
+    def post_aggregate_and_proofs_json(self, saps_json):
+        return self._post(
+            "/eth/v1/validator/aggregate_and_proofs", saps_json
+        )
+
+    def get_unsigned_block_json(
+        self,
+        slot: int,
+        randao_reveal: bytes,
+        graffiti: bytes | None = None,
+    ):
+        q = f"?randao_reveal=0x{bytes(randao_reveal).hex()}"
+        if graffiti is not None:
+            q += f"&graffiti=0x{bytes(graffiti).hex()}"
+        return self._get(f"/eth/v2/validator/blocks/{slot}{q}")
+
+    def post_sync_committee_messages_json(self, msgs_json):
+        return self._post(
+            "/eth/v1/beacon/pool/sync_committees", msgs_json
+        )
+
+    def get_sync_committee_contribution(
+        self, slot: int, subcommittee_index: int, beacon_block_root: bytes
+    ):
+        return self._get(
+            "/eth/v1/validator/sync_committee_contribution"
+            f"?slot={slot}&subcommittee_index={subcommittee_index}"
+            f"&beacon_block_root=0x{bytes(beacon_block_root).hex()}"
+        )["data"]
+
+    def post_contribution_and_proofs_json(self, caps_json):
+        return self._post(
+            "/eth/v1/validator/contribution_and_proofs", caps_json
+        )
 
     def get_metrics_text(self) -> str:
         with urllib.request.urlopen(

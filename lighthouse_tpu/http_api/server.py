@@ -11,11 +11,22 @@ Endpoints (the operative subset):
   GET  /eth/v1/node/version | health | syncing
   GET  /eth/v1/beacon/genesis
   GET  /eth/v1/beacon/states/{state_id}/finality_checkpoints | root
+  GET  /eth/v1/beacon/states/{state_id}/validators[?id=...]
   GET  /eth/v1/beacon/headers/{block_id}
   GET  /eth/v2/beacon/blocks/{block_id}
   POST /eth/v1/beacon/blocks
   POST /eth/v1/beacon/pool/attestations
+  POST /eth/v1/beacon/pool/sync_committees
   GET  /eth/v1/validator/duties/proposer/{epoch}
+  POST /eth/v1/validator/duties/attester/{epoch}
+  POST /eth/v1/validator/duties/sync/{epoch}
+  GET  /eth/v2/validator/blocks/{slot}?randao_reveal=...&graffiti=...
+  GET  /eth/v1/validator/attestation_data?slot=...&committee_index=...
+  GET  /eth/v1/validator/aggregate_attestation?slot=...&attestation_data_root=...
+  POST /eth/v1/validator/aggregate_and_proofs
+  GET  /eth/v1/validator/sync_committee_contribution?slot=...&subcommittee_index=...&beacon_block_root=...
+  POST /eth/v1/validator/contribution_and_proofs
+  POST /eth/v1/validator/liveness/{epoch}
   GET  /metrics
 """
 
@@ -211,6 +222,42 @@ class BeaconApiServer:
                             + type(state).hash_tree_root(state).hex()
                         }
                     }
+                if parts[5] == "validators":
+                    q = self._query(path)
+                    wanted = None
+                    if "id" in q:
+                        wanted = set()
+                        for part in q["id"].split(","):
+                            if part.startswith("0x"):
+                                wanted.add(part.lower())
+                            else:
+                                wanted.add(int(part))
+                    out = []
+                    for i, v in enumerate(state.validators):
+                        pk_hex = "0x" + bytes(v.pubkey).hex()
+                        if wanted is not None and not (
+                            i in wanted or pk_hex in wanted
+                        ):
+                            continue
+                        out.append(
+                            {
+                                "index": str(i),
+                                "balance": str(state.balances[i]),
+                                "status": "active_ongoing",
+                                "validator": {
+                                    "pubkey": pk_hex,
+                                    "effective_balance": str(
+                                        v.effective_balance
+                                    ),
+                                    "slashed": bool(v.slashed),
+                                    "activation_epoch": str(
+                                        v.activation_epoch
+                                    ),
+                                    "exit_epoch": str(v.exit_epoch),
+                                },
+                            }
+                        )
+                    return {"data": out}
             if parts[3] == "headers" and len(parts) >= 5:
                 block = self._resolve_block(parts[4])
                 header = self._header_json(block)
@@ -228,6 +275,51 @@ class BeaconApiServer:
             if parts[3] == "duties" and parts[4] == "proposer":
                 epoch = int(parts[5])
                 return self._proposer_duties(epoch)
+            if parts[3] == "attestation_data":
+                q = self._query(path)
+                data = chain.produce_attestation_data(
+                    int(q["slot"]), int(q["committee_index"])
+                )
+                return {"data": to_json(type(data), data)}
+            if parts[3] == "aggregate_attestation":
+                q = self._query(path)
+                root = bytes.fromhex(q["attestation_data_root"][2:])
+                agg = None
+                for a in chain.naive_pool.aggregates_at_slot(
+                    int(q["slot"])
+                ):
+                    if type(a.data).hash_tree_root(a.data) == root:
+                        agg = a
+                        break
+                if agg is None:
+                    raise ApiError(404, "no aggregate for data root")
+                return {"data": to_json(type(agg), agg)}
+            if parts[3] == "sync_committee_contribution":
+                q = self._query(path)
+                c = chain.sync_message_pool.get_contribution(
+                    int(q["slot"]),
+                    bytes.fromhex(q["beacon_block_root"][2:]),
+                    int(q["subcommittee_index"]),
+                )
+                if c is None:
+                    raise ApiError(404, "no contribution known")
+                return {"data": to_json(type(c), c)}
+        if parts[:3] == ["eth", "v2", "validator"]:
+            if parts[3] == "blocks" and len(parts) >= 5:
+                q = self._query(path)
+                block = chain.produce_block_unsigned(
+                    int(parts[4]),
+                    bytes.fromhex(q["randao_reveal"][2:]),
+                    bytes.fromhex(q["graffiti"][2:])
+                    if "graffiti" in q
+                    else b"\x00" * 32,
+                )
+                return {
+                    "version": chain.spec.fork_name_at_epoch(
+                        chain.spec.slot_to_epoch(block.slot)
+                    ),
+                    "data": to_json(type(block), block),
+                }
         raise ApiError(404, f"unknown route {path}")
 
     def handle_post(self, path: str, body: bytes):
@@ -267,17 +359,124 @@ class BeaconApiServer:
             docs = json.loads(body)
             atts = [from_json(self.chain.t.Attestation, d) for d in docs]
             results = chain.process_unaggregated_attestations(atts)
-            failures = [
-                {"index": i, "message": str(r)}
-                for i, r in enumerate(results)
-                if isinstance(r, Exception)
+            return self._pool_response(results)
+        if path == "/eth/v1/beacon/pool/sync_committees":
+            docs = json.loads(body)
+            msgs = [
+                from_json(chain.t.SyncCommitteeMessage, d) for d in docs
             ]
-            if failures:
-                raise ApiError(400, json.dumps(failures))
-            return {}
+            return self._pool_response(chain.process_sync_messages(msgs))
+        if path == "/eth/v1/validator/aggregate_and_proofs":
+            docs = json.loads(body)
+            saps = [
+                from_json(chain.t.SignedAggregateAndProof, d)
+                for d in docs
+            ]
+            return self._pool_response(
+                chain.process_aggregated_attestations(saps)
+            )
+        if path == "/eth/v1/validator/contribution_and_proofs":
+            docs = json.loads(body)
+            caps = [
+                from_json(chain.t.SignedContributionAndProof, d)
+                for d in docs
+            ]
+            return self._pool_response(
+                chain.process_signed_contributions(caps)
+            )
+        if (
+            parts[:4] == ["eth", "v1", "validator", "duties"]
+            and len(parts) == 6
+        ):
+            indices = [int(i) for i in json.loads(body)]
+            if parts[4] == "attester":
+                return self._attester_duties(int(parts[5]), indices)
+            if parts[4] == "sync":
+                return self._sync_duties(indices)
         raise ApiError(404, f"unknown route {path}")
 
     # ------------------------------------------------------------ helpers
+
+    @staticmethod
+    def _query(path: str) -> dict:
+        from urllib.parse import parse_qs, urlparse
+
+        return {
+            k: v[0] for k, v in parse_qs(urlparse(path).query).items()
+        }
+
+    @staticmethod
+    def _pool_response(results):
+        failures = [
+            {"index": i, "message": str(r)}
+            for i, r in enumerate(results)
+            if isinstance(r, Exception)
+        ]
+        if failures:
+            raise ApiError(400, json.dumps(failures))
+        return {}
+
+    def _attester_duties(self, epoch: int, indices):
+        """POST /eth/v1/validator/duties/attester/{epoch}
+        (http_api/src/lib.rs attester-duties route): committee assignment
+        per requested validator."""
+        from lighthouse_tpu.state_processing.helpers import CommitteeCache
+
+        chain = self.chain
+        state = chain.state_for_epoch(epoch)
+        cache = CommitteeCache(state, epoch, chain.spec)
+        wanted = set(indices)
+        duties = []
+        for slot in range(
+            chain.spec.epoch_start_slot(epoch),
+            chain.spec.epoch_start_slot(epoch + 1),
+        ):
+            for index in range(cache.committees_per_slot):
+                committee = cache.get_beacon_committee(slot, index)
+                for pos, v in enumerate(committee):
+                    if v in wanted:
+                        duties.append(
+                            {
+                                "pubkey": "0x"
+                                + bytes(
+                                    state.validators[v].pubkey
+                                ).hex(),
+                                "validator_index": str(v),
+                                "committee_index": str(index),
+                                "committee_length": str(len(committee)),
+                                "committees_at_slot": str(
+                                    cache.committees_per_slot
+                                ),
+                                "validator_committee_index": str(pos),
+                                "slot": str(slot),
+                            }
+                        )
+        return {"data": duties}
+
+    def _sync_duties(self, indices):
+        """POST /eth/v1/validator/duties/sync/{epoch}: membership +
+        positions in the current sync committee."""
+        from lighthouse_tpu.beacon_chain.sync_committee_verification import (
+            committee_positions,
+        )
+
+        chain = self.chain
+        state = chain.head_state
+        duties = []
+        for v in indices:
+            positions = committee_positions(state, v, chain)
+            if positions:
+                duties.append(
+                    {
+                        "pubkey": "0x"
+                        + bytes(state.validators[v].pubkey).hex(),
+                        "validator_index": str(v),
+                        "validator_sync_committee_indices": [
+                            str(p) for p in positions
+                        ],
+                    }
+                )
+        return {"data": duties}
 
     def _resolve_state(self, state_id: str):
         chain = self.chain

@@ -1,0 +1,520 @@
+"""Socket transport: TCP gossip + req/resp RPC, UDP discovery pings.
+
+Role of the reference's real network edge
+(lighthouse_network/src/behaviour/mod.rs:148 gossipsub over TCP,
+rpc/codec/ssz_snappy.rs length-prefixed ssz_snappy req/resp,
+discovery/mod.rs discv5 UDP): bytes actually cross OS sockets between
+processes. `SocketNet` exposes the SAME surface as the in-process
+`GossipHub` (join/subscribe/publish/report) plus RPC client proxies with
+the `RpcServer` method surface, so `BeaconNode` and `SyncManager` run
+unchanged over either transport.
+
+Wire format (all little-endian):
+  frame   := [u32 len][u8 kind][body]
+  HELLO   (1): JSON {node_id, topics}           — handshake + interests
+  GOSSIP  (2): [u16 tlen][topic][payload]       — payload is ssz_snappy
+  RPC_REQ (3): [u32 req_id][u8 mlen][method][ssz_snappy payload]
+  RPC_RSP (4): [u32 req_id][u8 status][chunks]  — chunk := [u32 len][data]
+  SUB     (5): JSON {topics}                    — interest update
+
+Gossip propagation floods to all interested peers with message-id dedup
+(gossipsub's mesh degenerates to flood at the handful-of-peers scale the
+tests run); scores accumulate per peer and a banned peer's connection is
+dropped (peer_manager ban semantics).
+
+UDP discovery: a one-datagram PING {node_id, tcp_port} answered by PONG
+{node_id, tcp_port, known: [[host, port], ...]} — the discv5
+FINDNODE/NODES exchange collapsed to one hop (discovery/mod.rs's role:
+learn dialable peers from a bootstrap address).
+"""
+
+import json
+import socket
+import struct
+import threading
+
+from lighthouse_tpu.network.gossip import (
+    BAN_THRESHOLD,
+    GOSSIP_MAX_SIZE,
+    message_id,
+)
+from lighthouse_tpu.network.rpc import (
+    BlocksByRangeRequest,
+    MetaData,
+    Ping,
+    RpcError,
+    StatusMessage,
+)
+from lighthouse_tpu.network.snappy_codec import (
+    frame_compress,
+    frame_decompress,
+)
+
+KIND_HELLO = 1
+KIND_GOSSIP = 2
+KIND_RPC_REQ = 3
+KIND_RPC_RSP = 4
+KIND_SUB = 5
+
+FORK_ORDER = ["phase0", "altair", "bellatrix"]
+
+
+def _send_frame(sock, lock, kind: int, body: bytes):
+    frame = struct.pack("<IB", len(body) + 1, kind) + body
+    with lock:
+        sock.sendall(frame)
+
+
+def _recv_exact(sock, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _PeerConn:
+    def __init__(self, sock, node_id=None):
+        self.sock = sock
+        self.node_id = node_id
+        self.topics: set[str] = set()
+        self.score = 0.0
+        self.lock = threading.Lock()
+        self.alive = True
+        self.listen_port = None
+
+    def close(self):
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RpcClientProxy:
+    """RpcServer-shaped methods over the socket (the reference's
+    outbound substream half of rpc/handler.rs)."""
+
+    def __init__(self, net, peer_id: str, timeout: float = 10.0):
+        self.net = net
+        self.peer_id = peer_id
+        self.timeout = timeout
+
+    def _call(self, method: str, payload: bytes):
+        return self.net._rpc_call(
+            self.peer_id, method, payload, self.timeout
+        )
+
+    def status(self, caller: str) -> StatusMessage:
+        chunks = self._call("status", b"")
+        return StatusMessage.decode(frame_decompress(chunks[0]))
+
+    def ping(self, caller: str, data: int) -> int:
+        chunks = self._call("ping", frame_compress(Ping(data=data).to_bytes()))
+        return Ping.decode(frame_decompress(chunks[0])).data
+
+    def metadata(self, caller: str) -> MetaData:
+        chunks = self._call("metadata", b"")
+        return MetaData.decode(frame_decompress(chunks[0]))
+
+    def blocks_by_range(self, caller: str, req: BlocksByRangeRequest):
+        chunks = self._call(
+            "blocks_by_range", frame_compress(req.to_bytes())
+        )
+        return [self.net._decode_block(c) for c in chunks]
+
+    def blocks_by_root(self, caller: str, roots):
+        payload = frame_compress(b"".join(bytes(r) for r in roots))
+        chunks = self._call("blocks_by_root", payload)
+        return [self.net._decode_block(c) for c in chunks]
+
+
+class SocketNet:
+    def __init__(
+        self,
+        node_id: str,
+        types,
+        spec,
+        host: str = "127.0.0.1",
+        rpc_server=None,
+        on_peer_connected=None,
+    ):
+        self.node_id = node_id
+        self.t = types
+        self.spec = spec
+        self.host = host
+        self.rpc_server = rpc_server
+        self.on_peer_connected = on_peer_connected
+        self.deliver = None  # set by join()
+        self.local_topics: set[str] = set()
+        self.peers: dict[str, _PeerConn] = {}
+        self._seen: set[bytes] = set()
+        self._seen_lock = threading.Lock()
+        self._pending: dict[int, tuple[threading.Event, list]] = {}
+        self._req_id = 0
+        self._req_lock = threading.Lock()
+        self._stopping = False
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+        )
+        self._listener.bind((host, 0))
+        self._listener.listen(16)
+        self.tcp_port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+        # UDP discovery endpoint
+        self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._udp.bind((host, 0))
+        self.udp_port = self._udp.getsockname()[1]
+        threading.Thread(target=self._udp_loop, daemon=True).start()
+
+    # -------------------------------------------------- GossipHub surface
+
+    def join(self, node_id: str, deliver):
+        self.deliver = deliver
+        return self
+
+    def subscribe(self, node_id: str, topic_str: str):
+        self.local_topics.add(topic_str)
+        body = json.dumps({"topics": [topic_str]}).encode()
+        for conn in list(self.peers.values()):
+            try:
+                _send_frame(conn.sock, conn.lock, KIND_SUB, body)
+            except OSError:
+                self._drop(conn)
+
+    def unsubscribe(self, node_id: str, topic_str: str):
+        self.local_topics.discard(topic_str)
+
+    def publish(self, from_peer: str, topic_str: str, data: bytes) -> int:
+        if len(data) > GOSSIP_MAX_SIZE:
+            return 0
+        mid = message_id(topic_str.encode() + data)
+        with self._seen_lock:
+            if mid in self._seen:
+                return 0
+            self._seen.add(mid)
+        return self._fanout(topic_str, data, exclude=None)
+
+    def report(self, peer_id: str, delta: float):
+        conn = self.peers.get(peer_id)
+        if conn is None:
+            return
+        conn.score += delta
+        if conn.score <= BAN_THRESHOLD:
+            self._drop(conn)  # ban == disconnect (peer_manager)
+
+    # ------------------------------------------------------------- dialing
+
+    def connect(self, host: str, port: int):
+        """Dial a peer's TCP listener; returns its node_id."""
+        sock = socket.create_connection((host, port), timeout=10)
+        conn = _PeerConn(sock)
+        self._handshake_out(conn)
+        threading.Thread(
+            target=self._read_loop, args=(conn,), daemon=True
+        ).start()
+        return conn.node_id
+
+    def rpc_client(self, peer_id: str) -> RpcClientProxy:
+        return RpcClientProxy(self, peer_id)
+
+    def discover(self, host: str, udp_port: int):
+        """UDP ping a bootstrap node; connect to it and every peer it
+        knows (one-hop discv5)."""
+        ping = json.dumps(
+            {
+                "op": "ping",
+                "node_id": self.node_id,
+                "tcp_port": self.tcp_port,
+            }
+        ).encode()
+        # a throwaway socket: the bound listener's recvfrom loop would
+        # race us for the pong datagram
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.settimeout(5.0)
+        try:
+            probe.sendto(ping, (host, udp_port))
+            data, _addr = probe.recvfrom(65536)
+            pong = json.loads(data)
+        except (OSError, ValueError):
+            return []
+        finally:
+            probe.close()
+        connected = []
+        for peer_host, tcp_port in [
+            [host, pong.get("tcp_port")]
+        ] + pong.get("known", []):
+            if tcp_port is None:
+                continue
+            try:
+                pid = self.connect(peer_host, tcp_port)
+                connected.append(pid)
+            except OSError:
+                continue
+        return connected
+
+    def close(self):
+        self._stopping = True
+        for conn in list(self.peers.values()):
+            conn.close()
+        try:
+            self._listener.close()
+            self._udp.close()
+        except OSError:
+            pass
+
+    # ----------------------------------------------------------- internals
+
+    def _hello_body(self) -> bytes:
+        return json.dumps(
+            {
+                "node_id": self.node_id,
+                "topics": sorted(self.local_topics),
+                "tcp_port": self.tcp_port,
+            }
+        ).encode()
+
+    def _handshake_out(self, conn: _PeerConn):
+        _send_frame(conn.sock, conn.lock, KIND_HELLO, self._hello_body())
+        frame = self._read_frame(conn)
+        if frame is None or frame[0] != KIND_HELLO:
+            conn.close()
+            raise OSError("handshake failed")
+        self._apply_hello(conn, frame[1])
+
+    def _apply_hello(self, conn: _PeerConn, body: bytes):
+        doc = json.loads(body)
+        conn.node_id = doc["node_id"]
+        conn.topics.update(doc.get("topics", []))
+        conn.listen_port = doc.get("tcp_port")
+        self.peers[conn.node_id] = conn
+        if self.on_peer_connected is not None:
+            self.on_peer_connected(conn.node_id)
+
+    def _accept_loop(self):
+        while not self._stopping:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            conn = _PeerConn(sock)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: _PeerConn):
+        frame = self._read_frame(conn)
+        if frame is None or frame[0] != KIND_HELLO:
+            conn.close()
+            return
+        self._apply_hello(conn, frame[1])
+        _send_frame(conn.sock, conn.lock, KIND_HELLO, self._hello_body())
+        self._read_loop(conn)
+
+    def _read_frame(self, conn: _PeerConn):
+        header = _recv_exact(conn.sock, 5)
+        if header is None:
+            return None
+        length, kind = struct.unpack("<IB", header)
+        body = _recv_exact(conn.sock, length - 1)
+        if body is None:
+            return None
+        return kind, body
+
+    def _read_loop(self, conn: _PeerConn):
+        try:
+            while conn.alive:
+                frame = self._read_frame(conn)
+                if frame is None:
+                    break
+                self._handle_frame(conn, *frame)
+        except OSError:
+            pass
+        finally:
+            self._drop(conn)
+
+    def _handle_frame(self, conn: _PeerConn, kind: int, body: bytes):
+        if kind == KIND_GOSSIP:
+            (tlen,) = struct.unpack_from("<H", body)
+            topic_str = body[2 : 2 + tlen].decode()
+            payload = body[2 + tlen :]
+            mid = message_id(topic_str.encode() + payload)
+            with self._seen_lock:
+                if mid in self._seen:
+                    return
+                self._seen.add(mid)
+            if topic_str in self.local_topics and self.deliver is not None:
+                self.deliver(topic_str, payload, conn.node_id)
+            # flood onward to other interested peers
+            self._fanout(topic_str, payload, exclude=conn.node_id)
+        elif kind == KIND_SUB:
+            conn.topics.update(json.loads(body).get("topics", []))
+        elif kind == KIND_RPC_REQ:
+            threading.Thread(
+                target=self._serve_rpc,
+                args=(conn, body),
+                daemon=True,
+            ).start()
+        elif kind == KIND_RPC_RSP:
+            (req_id,) = struct.unpack_from("<I", body)
+            status = body[4]
+            chunks, pos = [], 5
+            while pos + 4 <= len(body):
+                (clen,) = struct.unpack_from("<I", body, pos)
+                chunks.append(body[pos + 4 : pos + 4 + clen])
+                pos += 4 + clen
+            waiter = self._pending.pop(req_id, None)
+            if waiter is not None:
+                event, out = waiter
+                out.append((status, chunks))
+                event.set()
+
+    def _fanout(self, topic_str: str, payload: bytes, exclude) -> int:
+        body = (
+            struct.pack("<H", len(topic_str))
+            + topic_str.encode()
+            + payload
+        )
+        sent = 0
+        for conn in list(self.peers.values()):
+            if not conn.alive or conn.node_id == exclude:
+                continue
+            if topic_str not in conn.topics:
+                continue
+            try:
+                _send_frame(conn.sock, conn.lock, KIND_GOSSIP, body)
+                sent += 1
+            except OSError:
+                self._drop(conn)
+        return sent
+
+    # ---------------------------------------------------------------- rpc
+
+    def _rpc_call(self, peer_id, method, payload, timeout):
+        conn = self.peers.get(peer_id)
+        if conn is None or not conn.alive:
+            raise RpcError(2, f"peer {peer_id} not connected")
+        with self._req_lock:
+            self._req_id += 1
+            req_id = self._req_id
+        event, out = threading.Event(), []
+        self._pending[req_id] = (event, out)
+        body = (
+            struct.pack("<IB", req_id, len(method))
+            + method.encode()
+            + payload
+        )
+        _send_frame(conn.sock, conn.lock, KIND_RPC_REQ, body)
+        if not event.wait(timeout):
+            self._pending.pop(req_id, None)
+            raise RpcError(2, f"rpc {method} timed out")
+        status, chunks = out[0]
+        if status != 0:
+            raise RpcError(status, chunks[0].decode() if chunks else "")
+        return chunks
+
+    def _serve_rpc(self, conn: _PeerConn, body: bytes):
+        (req_id,) = struct.unpack_from("<I", body)
+        mlen = body[4]
+        method = body[5 : 5 + mlen].decode()
+        payload = body[5 + mlen :]
+        try:
+            chunks = self._dispatch_rpc(conn.node_id, method, payload)
+            status = 0
+        except RpcError as e:
+            status, chunks = e.args[0] or 1, [str(e.args[1]).encode()]
+        except Exception as e:
+            status, chunks = 1, [str(e).encode()]
+        resp = struct.pack("<IB", req_id, status) + b"".join(
+            struct.pack("<I", len(c)) + c for c in chunks
+        )
+        try:
+            _send_frame(conn.sock, conn.lock, KIND_RPC_RSP, resp)
+        except OSError:
+            self._drop(conn)
+
+    def _dispatch_rpc(self, peer_id, method, payload):
+        srv = self.rpc_server
+        if srv is None:
+            raise RpcError(1, "no rpc server")
+        if method == "status":
+            return [frame_compress(srv.status(peer_id).to_bytes())]
+        if method == "ping":
+            data = Ping.decode(frame_decompress(payload)).data
+            return [
+                frame_compress(
+                    Ping(data=srv.ping(peer_id, data)).to_bytes()
+                )
+            ]
+        if method == "metadata":
+            return [frame_compress(srv.metadata(peer_id).to_bytes())]
+        if method == "blocks_by_range":
+            req = BlocksByRangeRequest.decode(frame_decompress(payload))
+            blocks = srv.blocks_by_range(peer_id, req)
+            return [self._encode_block(b) for b in blocks]
+        if method == "blocks_by_root":
+            raw = frame_decompress(payload)
+            roots = [raw[i : i + 32] for i in range(0, len(raw), 32)]
+            blocks = srv.blocks_by_root(peer_id, roots)
+            return [self._encode_block(b) for b in blocks]
+        raise RpcError(1, f"unknown method {method}")
+
+    def _encode_block(self, signed_block) -> bytes:
+        fork = self.spec.fork_name_at_epoch(
+            self.spec.slot_to_epoch(signed_block.message.slot)
+        )
+        return bytes([FORK_ORDER.index(fork)]) + frame_compress(
+            signed_block.to_bytes()
+        )
+
+    def _decode_block(self, chunk: bytes):
+        fork = FORK_ORDER[chunk[0]]
+        cls = self.t.signed_block_classes[fork]
+        return cls.decode(frame_decompress(chunk[1:]))
+
+    def _drop(self, conn: _PeerConn):
+        conn.close()
+        if conn.node_id and self.peers.get(conn.node_id) is conn:
+            del self.peers[conn.node_id]
+
+    # ---------------------------------------------------------- discovery
+
+    def _udp_loop(self):
+        while not self._stopping:
+            try:
+                data, addr = self._udp.recvfrom(65536)
+            except OSError:
+                return
+            try:
+                doc = json.loads(data)
+            except ValueError:
+                continue
+            if doc.get("op") == "ping":
+                # advertise peers by the LISTEN ports learned in HELLO
+                known = [
+                    [self.host, p] for p in self._known_listen_ports()
+                ]
+                pong = json.dumps(
+                    {
+                        "op": "pong",
+                        "node_id": self.node_id,
+                        "tcp_port": self.tcp_port,
+                        "known": known,
+                    }
+                ).encode()
+                try:
+                    self._udp.sendto(pong, addr)
+                except OSError:
+                    pass
+
+    def _known_listen_ports(self):
+        return [
+            c.listen_port
+            for c in self.peers.values()
+            if getattr(c, "listen_port", None)
+        ]

@@ -70,14 +70,38 @@ class BeaconNode:
         self.hub = hub
         if hub is not None:
             hub.join(node_id, self._deliver)
-            for name in (
-                "beacon_block",
-                "beacon_aggregate_and_proof",
-                "beacon_attestation_0",
-                "voluntary_exit",
-                "attester_slashing",
-            ):
+            for name in self._gossip_topics():
                 hub.subscribe(node_id, topic(self.fork_digest, name))
+
+    def _gossip_topics(self):
+        return (
+            "beacon_block",
+            "beacon_aggregate_and_proof",
+            "beacon_attestation_0",
+            "voluntary_exit",
+            "attester_slashing",
+        )
+
+    def attach_socket_net(self, host: str = "127.0.0.1"):
+        """Replace the in-process hub with a real TCP/UDP transport
+        (lighthouse_network's role): gossip + RPC cross OS sockets, and
+        every connected peer is registered with the sync manager."""
+        from lighthouse_tpu.network.socket_net import SocketNet
+
+        net = SocketNet(
+            self.node_id,
+            self.chain.t,
+            self.spec,
+            host=host,
+            rpc_server=self.rpc,
+            on_peer_connected=lambda pid: self.sync.add_peer(
+                pid, net.rpc_client(pid)
+            ),
+        )
+        self.hub = net.join(self.node_id, self._deliver)
+        for name in self._gossip_topics():
+            net.subscribe(self.node_id, topic(self.fork_digest, name))
+        return net
 
     # ---------------------------------------------------------- transport
 

@@ -116,6 +116,51 @@ def verify_signature_sets(
     return pairing.multi_pairing_is_one(g1_side, g2_side, pair_mask)
 
 
+def verify_signature_sets_individual(
+    msgs_g2_aff,
+    sigs_g2_aff,
+    pubkeys_g1_aff,
+    key_mask,
+    set_mask,
+):
+    """Per-set verdicts in ONE device call (the batch-failure fallback —
+    SURVEY §7 hard part 5, attestation batch.rs:115-131 semantics without
+    the per-set round trips): set i passes iff
+
+        e(agg_pk_i, H_i) * e(-G1, sig_i) == 1.
+
+    No RLC is needed — each set is its own independent pairing check; the
+    Miller loop runs over 2S pairs and the final exponentiation is
+    batched per set. Returns a (S,) bool array (padding lanes True)."""
+    S = set_mask.shape[0]
+    agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)
+    pk_x, pk_y, pk_inf = curve.PG1.to_affine(agg_pk)
+
+    neg_g1 = (
+        jnp.broadcast_to(jnp.asarray(NEG_G1_AFFINE[0]), pk_x.shape),
+        jnp.broadcast_to(jnp.asarray(NEG_G1_AFFINE[1]), pk_y.shape),
+    )
+    g1_side = (
+        jnp.concatenate([pk_x, neg_g1[0]], axis=0),
+        jnp.concatenate([pk_y, neg_g1[1]], axis=0),
+    )
+    g2_side = (
+        jnp.concatenate([msgs_g2_aff[0], sigs_g2_aff[0]], axis=0),
+        jnp.concatenate([msgs_g2_aff[1], sigs_g2_aff[1]], axis=0),
+    )
+    # e(inf, .) == 1 exactly; a masked padding lane contributes 1 to both
+    # of its pairs and trivially passes
+    pair_mask = jnp.concatenate(
+        [set_mask & ~pk_inf, set_mask], axis=0
+    )
+    f = pairing.miller_loop(g1_side, g2_side, valid_mask=pair_mask)
+    from lighthouse_tpu.ops import tower
+
+    f_set = tower.fp12_mul(f[:S], f[S:])
+    ok = tower.fp12_is_one(pairing.final_exponentiation(f_set))
+    return ok | ~set_mask
+
+
 def _pad_lanes_projective(pt_t, block_b: int, group):
     """Pad the lane axis of a transposed projective point to a block
     multiple with identity lanes."""

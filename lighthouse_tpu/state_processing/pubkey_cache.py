@@ -3,8 +3,9 @@
 Role of the reference's `ValidatorPubkeyCache`
 (beacon_node/beacon_chain/src/validator_pubkey_cache.rs:9-24): pubkey
 decompression is expensive; do it once per validator and reuse across every
-signature-set build. On the device path this is the host half of the
-device-resident pubkey table.
+signature-set build. Each cached key is tagged with its validator index and
+the owning cache, so the TPU backend can ship (table, indices) instead of
+points (the device half lives in bls/device_pubkey_table.py).
 """
 
 from lighthouse_tpu import bls
@@ -14,14 +15,29 @@ class PubkeyCache:
     def __init__(self):
         self._by_index: list[bls.PublicKey] = []
         self._by_bytes: dict[bytes, int] = {}
+        self._device_table = None  # built lazily; appended on import_new
 
     def import_new(self, state):
         """Pick up any validators appended since the last import."""
-        for i in range(len(self._by_index), len(state.validators)):
+        start = len(self._by_index)
+        for i in range(start, len(state.validators)):
             pk_bytes = bytes(state.validators[i].pubkey)
             pk = bls.PublicKey.from_bytes(pk_bytes)
+            pk.validator_index = i
+            pk.cache = self
             self._by_index.append(pk)
             self._by_bytes[pk_bytes] = i
+        if self._device_table is not None and len(self._by_index) > start:
+            self._device_table.append(self._by_index[start:])
+
+    def device_table(self):
+        """The device-resident limb table, synced to the cache."""
+        from lighthouse_tpu.bls.device_pubkey_table import DevicePubkeyTable
+
+        if self._device_table is None:
+            self._device_table = DevicePubkeyTable()
+            self._device_table.append(self._by_index)
+        return self._device_table
 
     def get(self, index: int) -> bls.PublicKey:
         return self._by_index[index]

@@ -175,3 +175,92 @@ def sync_aggregate_set(
         [pubkey_for_bytes(pk) for pk in participants],
         compute_signing_root(block_root, domain),
     )
+
+
+# ---- sync-committee gossip plane ------------------------------------------
+# Role of signature_sets.rs:563+ (sync_committee_message_set_from_pubkeys,
+# signed_sync_aggregate_selection_proof_signature_set,
+# signed_sync_aggregate_signature_set,
+# sync_committee_contribution_signature_set_from_pubkeys).
+
+
+def sync_committee_message_set(
+    state, message, pubkey_for, spec: Spec
+) -> bls.SignatureSet:
+    """A validator's per-slot sync vote: signs the head block root under
+    DOMAIN_SYNC_COMMITTEE at the message slot's epoch."""
+    domain = get_domain(
+        state,
+        spec.DOMAIN_SYNC_COMMITTEE,
+        spec.slot_to_epoch(message.slot),
+        spec,
+    )
+    return bls.SignatureSet(
+        bls.Signature.from_bytes(bytes(message.signature)),
+        [pubkey_for(message.validator_index)],
+        compute_signing_root(bytes(message.beacon_block_root), domain),
+    )
+
+
+def sync_selection_proof_set(
+    state, contribution_and_proof, pubkey_for, spec: Spec, types
+) -> bls.SignatureSet:
+    """Aggregator's selection proof signs SyncAggregatorSelectionData
+    (slot, subcommittee_index)."""
+    contribution = contribution_and_proof.contribution
+    domain = get_domain(
+        state,
+        spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+        spec.slot_to_epoch(contribution.slot),
+        spec,
+    )
+    selection_data = types.SyncAggregatorSelectionData(
+        slot=contribution.slot,
+        subcommittee_index=contribution.subcommittee_index,
+    )
+    return bls.SignatureSet(
+        bls.Signature.from_bytes(
+            bytes(contribution_and_proof.selection_proof)
+        ),
+        [pubkey_for(contribution_and_proof.aggregator_index)],
+        _signing_root(selection_data, domain),
+    )
+
+
+def signed_contribution_and_proof_set(
+    state, signed_cap, pubkey_for, spec: Spec
+) -> bls.SignatureSet:
+    """Outer signature over the ContributionAndProof container."""
+    msg = signed_cap.message
+    domain = get_domain(
+        state,
+        spec.DOMAIN_CONTRIBUTION_AND_PROOF,
+        spec.slot_to_epoch(msg.contribution.slot),
+        spec,
+    )
+    return bls.SignatureSet(
+        bls.Signature.from_bytes(bytes(signed_cap.signature)),
+        [pubkey_for(msg.aggregator_index)],
+        _signing_root(msg, domain),
+    )
+
+
+def sync_contribution_set(
+    state, contribution, participant_pubkeys, spec: Spec
+) -> bls.SignatureSet:
+    """Aggregated subcommittee signature over the contribution's block
+    root. `participant_pubkeys` are the decompressed pubkeys of the set
+    aggregation bits (caller slices the subcommittee)."""
+    if not participant_pubkeys:
+        raise SignatureSetError("contribution with no participants")
+    domain = get_domain(
+        state,
+        spec.DOMAIN_SYNC_COMMITTEE,
+        spec.slot_to_epoch(contribution.slot),
+        spec,
+    )
+    return bls.SignatureSet(
+        bls.Signature.from_bytes(bytes(contribution.signature)),
+        list(participant_pubkeys),
+        compute_signing_root(bytes(contribution.beacon_block_root), domain),
+    )

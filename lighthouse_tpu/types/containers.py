@@ -403,6 +403,13 @@ def types_for(spec: Spec) -> SimpleNamespace:
         message: ContributionAndProof
         signature: BLSSignature
 
+    class SyncAggregatorSelectionData(ssz.Container):
+        """Signed by a sync-committee aggregator's selection proof
+        (consensus/types/src/sync_selection_proof.rs)."""
+
+        slot: Slot
+        subcommittee_index: ssz.uint64
+
     class DepositEvent(ssz.Container):
         """Deposit log entry as cached by the eth1 service
         (reference beacon_node/eth1/src/deposit_cache.rs)."""

@@ -115,6 +115,12 @@ class Spec:
     PROPOSER_SCORE_BOOST: int
     TARGET_AGGREGATORS_PER_COMMITTEE: int
 
+    # sync-committee gossip plane (altair p2p spec; reference
+    # consensus/types/src/consts.rs SYNC_COMMITTEE_SUBNET_COUNT and
+    # sync_selection_proof.rs TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE)
+    SYNC_COMMITTEE_SUBNET_COUNT: int = 4
+    TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE: int = 16
+
     # bellatrix (merge) — execution payload sizes + penalty variants
     # (consensus/types/src/eth_spec.rs MaxBytesPerTransaction etc.,
     # chain_spec.rs *_bellatrix fields)

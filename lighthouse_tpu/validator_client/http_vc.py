@@ -1,0 +1,366 @@
+"""HTTP-only validator client: every BN interaction over the REST API.
+
+The reference invariant this enforces (SURVEY §1 L7): the VC talks to
+the beacon node EXCLUSIVELY through `BeaconNodeHttpClient`
+(common/eth2/src/lib.rs) — duties, attestation data, unsigned blocks,
+aggregates, sync-committee contributions, liveness — never through
+in-process state. Signing domains are derived client-side from the spec
+config + the genesis endpoint (validator_store.rs does the same with the
+genesis fork/validators-root it fetched at startup).
+
+Duty loop per slot (attestation_service.rs:281, block_service.rs:185,
+sync_committee_service.rs:142):
+  slot start  -> propose if one of our keys has the proposal
+  slot + 1/3  -> publish attestations + sync-committee messages
+  slot + 2/3  -> publish aggregates + signed contributions
+"""
+
+from lighthouse_tpu import bls, ssz
+from lighthouse_tpu.http_api.json_codec import from_json, to_json
+from lighthouse_tpu.state_processing.helpers import hash32
+from lighthouse_tpu.types.containers import types_for
+from lighthouse_tpu.types.helpers import (
+    compute_domain,
+    compute_signing_root,
+)
+from lighthouse_tpu.validator_client.slashing_protection import (
+    SlashingProtectionDB,
+)
+
+TARGET_AGGREGATORS_PER_COMMITTEE = 16
+
+
+class HttpValidatorClient:
+    def __init__(
+        self,
+        client,
+        keypairs,
+        spec,
+        slashing_db: SlashingProtectionDB | None = None,
+    ):
+        """`client` is a BeaconNodeHttpClient (or a BeaconNodeFallback
+        exposing the same surface); `keypairs` a list of bls Keypairs."""
+        self.client = client
+        self.spec = spec
+        self.t = types_for(spec)
+        self.keys_by_pubkey = {kp.pk.to_bytes(): kp for kp in keypairs}
+        self.slashing_db = slashing_db or SlashingProtectionDB()
+        genesis = client.get_genesis()
+        self.genesis_validators_root = bytes.fromhex(
+            genesis["genesis_validators_root"][2:]
+        )
+        self.indices: dict[int, bls.Keypair] = {}
+        self.metrics = {
+            "blocks_proposed": 0,
+            "attestations_published": 0,
+            "aggregates_published": 0,
+            "sync_messages_published": 0,
+            "contributions_published": 0,
+            "publish_errors": 0,
+        }
+        self._resolve_indices()
+
+    def _publish(self, post_fn, payload) -> int:
+        """Returns how many items the BN accepted. Per-item rejections
+        (duplicate aggregate — another aggregator won the race; message
+        already known) are normal operation: count them, keep the loop
+        alive (attestation_service.rs logs and continues)."""
+        from lighthouse_tpu.http_api.client import ApiClientError
+
+        try:
+            post_fn(payload)
+            return len(payload)
+        except ApiClientError as e:
+            failed = e.failure_indices()
+            self.metrics["publish_errors"] += (
+                len(failed) if failed is not None else 1
+            )
+            if failed is None:
+                return 0
+            return len(payload) - len(failed)
+
+    def _resolve_indices(self):
+        """Map managed pubkeys to validator indices via the validators
+        endpoint (duties_service.rs poll_validator_indices)."""
+        wanted = ["0x" + pk.hex() for pk in self.keys_by_pubkey]
+        for v in self.client.get_validators(ids=wanted):
+            pk = bytes.fromhex(v["validator"]["pubkey"][2:])
+            kp = self.keys_by_pubkey.get(pk)
+            if kp is not None:
+                self.indices[int(v["index"])] = kp
+
+    # -------------------------------------------------------------- domains
+
+    def _domain(self, domain_type: bytes, epoch: int) -> bytes:
+        spec = self.spec
+        if epoch >= spec.BELLATRIX_FORK_EPOCH:
+            version = spec.BELLATRIX_FORK_VERSION
+        elif epoch >= spec.ALTAIR_FORK_EPOCH:
+            version = spec.ALTAIR_FORK_VERSION
+        else:
+            version = spec.GENESIS_FORK_VERSION
+        return compute_domain(
+            domain_type, version, self.genesis_validators_root
+        )
+
+    def _sign(self, kp, domain_type: bytes, epoch: int, root: bytes):
+        signing_root = compute_signing_root(
+            root, self._domain(domain_type, epoch)
+        )
+        return kp.sk.sign(signing_root).to_bytes(), signing_root
+
+    # -------------------------------------------------------------- blocks
+
+    def propose(self, slot: int):
+        """block_service.rs:185 do_update: fetch unsigned block, sign,
+        publish. Returns the signed block or None (not our proposal)."""
+        epoch = self.spec.slot_to_epoch(slot)
+        duties = self.client.get_proposer_duties(epoch)
+        proposer = next(
+            (d for d in duties if int(d["slot"]) == slot), None
+        )
+        if proposer is None:
+            return None
+        kp = self.indices.get(int(proposer["validator_index"]))
+        if kp is None:
+            return None
+        reveal, _ = self._sign(
+            kp,
+            self.spec.DOMAIN_RANDAO,
+            epoch,
+            ssz.uint64.hash_tree_root(epoch),
+        )
+        resp = self.client.get_unsigned_block_json(slot, reveal)
+        block_cls = self.t.block_classes[resp["version"]]
+        block = from_json(block_cls, resp["data"])
+        root = block_cls.hash_tree_root(block)
+        sig, signing_root = self._sign(
+            kp, self.spec.DOMAIN_BEACON_PROPOSER, epoch, root
+        )
+        self.slashing_db.check_and_insert_block(
+            kp.pk.to_bytes(), slot, signing_root
+        )
+        signed_cls = self.t.signed_block_classes[resp["version"]]
+        signed = signed_cls(message=block, signature=sig)
+        self.client.post_block_json(to_json(signed_cls, signed))
+        self.metrics["blocks_proposed"] += 1
+        return signed
+
+    # -------------------------------------------------------- attestations
+
+    def _attester_duties(self, epoch: int):
+        return self.client.post_attester_duties(
+            epoch, sorted(self.indices)
+        )
+
+    def attest(self, slot: int):
+        """slot+1/3: one signed attestation per managed duty at `slot`,
+        with attestation data fetched from the BN."""
+        epoch = self.spec.slot_to_epoch(slot)
+        out = []
+        for duty in self._attester_duties(epoch):
+            if int(duty["slot"]) != slot:
+                continue
+            kp = self.indices[int(duty["validator_index"])]
+            data_json = self.client.get_attestation_data(
+                slot, int(duty["committee_index"])
+            )
+            data = from_json(self.t.AttestationData, data_json)
+            root = self.t.AttestationData.hash_tree_root(data)
+            sig, signing_root = self._sign(
+                kp, self.spec.DOMAIN_BEACON_ATTESTER, epoch, root
+            )
+            self.slashing_db.check_and_insert_attestation(
+                kp.pk.to_bytes(),
+                data.source.epoch,
+                data.target.epoch,
+                signing_root,
+            )
+            length = int(duty["committee_length"])
+            pos = int(duty["validator_committee_index"])
+            out.append(
+                self.t.Attestation(
+                    aggregation_bits=[i == pos for i in range(length)],
+                    data=data,
+                    signature=sig,
+                )
+            )
+        if out:
+            self.metrics["attestations_published"] += self._publish(
+                self.client.post_attestations_json,
+                [to_json(self.t.Attestation, a) for a in out],
+            )
+        return out
+
+    def _selection_proof(self, kp, slot: int):
+        epoch = self.spec.slot_to_epoch(slot)
+        proof, _ = self._sign(
+            kp,
+            self.spec.DOMAIN_SELECTION_PROOF,
+            epoch,
+            ssz.uint64.hash_tree_root(slot),
+        )
+        return proof
+
+    def aggregate(self, slot: int):
+        """slot+2/3: selected aggregators fetch the BN's aggregate for
+        their committee's data root and publish SignedAggregateAndProofs."""
+        epoch = self.spec.slot_to_epoch(slot)
+        out = []
+        for duty in self._attester_duties(epoch):
+            if int(duty["slot"]) != slot:
+                continue
+            kp = self.indices[int(duty["validator_index"])]
+            proof = self._selection_proof(kp, slot)
+            modulo = max(
+                1,
+                int(duty["committee_length"])
+                // TARGET_AGGREGATORS_PER_COMMITTEE,
+            )
+            if int.from_bytes(hash32(proof)[:8], "little") % modulo:
+                continue
+            data_json = self.client.get_attestation_data(
+                slot, int(duty["committee_index"])
+            )
+            data = from_json(self.t.AttestationData, data_json)
+            try:
+                agg_json = self.client.get_aggregate_attestation(
+                    slot, self.t.AttestationData.hash_tree_root(data)
+                )
+            except Exception:
+                continue  # nothing aggregated for this committee yet
+            msg = self.t.AggregateAndProof(
+                aggregator_index=int(duty["validator_index"]),
+                aggregate=from_json(self.t.Attestation, agg_json),
+                selection_proof=proof,
+            )
+            sig, _ = self._sign(
+                kp,
+                self.spec.DOMAIN_AGGREGATE_AND_PROOF,
+                epoch,
+                self.t.AggregateAndProof.hash_tree_root(msg),
+            )
+            out.append(
+                self.t.SignedAggregateAndProof(message=msg, signature=sig)
+            )
+        if out:
+            self.metrics["aggregates_published"] += self._publish(
+                self.client.post_aggregate_and_proofs_json,
+                [to_json(self.t.SignedAggregateAndProof, s) for s in out],
+            )
+        return out
+
+    # ------------------------------------------------------ sync committee
+
+    def _head_root(self) -> bytes:
+        return bytes.fromhex(self.client.get_header("head")["root"][2:])
+
+    def sync_messages(self, slot: int):
+        """slot+1/3: SyncCommitteeMessages voting on the BN's head."""
+        epoch = self.spec.slot_to_epoch(slot)
+        duties = self.client.post_sync_duties(
+            epoch, sorted(self.indices)
+        )
+        if not duties:
+            return []
+        head_root = self._head_root()
+        out = []
+        for duty in duties:
+            kp = self.indices[int(duty["validator_index"])]
+            sig, _ = self._sign(
+                kp, self.spec.DOMAIN_SYNC_COMMITTEE, epoch, head_root
+            )
+            out.append(
+                self.t.SyncCommitteeMessage(
+                    slot=slot,
+                    beacon_block_root=head_root,
+                    validator_index=int(duty["validator_index"]),
+                    signature=sig,
+                )
+            )
+        if out:
+            self.metrics["sync_messages_published"] += self._publish(
+                self.client.post_sync_committee_messages_json,
+                [to_json(self.t.SyncCommitteeMessage, m) for m in out],
+            )
+        return out
+
+    def sync_contributions(self, slot: int):
+        """slot+2/3: elected subcommittee aggregators fetch the BN's
+        contribution and publish SignedContributionAndProofs."""
+        from lighthouse_tpu.beacon_chain.sync_committee_verification import (
+            is_sync_aggregator,
+        )
+
+        epoch = self.spec.slot_to_epoch(slot)
+        duties = self.client.post_sync_duties(
+            epoch, sorted(self.indices)
+        )
+        if not duties:
+            return []
+        head_root = self._head_root()
+        size = max(
+            self.spec.SYNC_COMMITTEE_SIZE
+            // self.spec.SYNC_COMMITTEE_SUBNET_COUNT,
+            1,
+        )
+        out = []
+        for duty in duties:
+            index = int(duty["validator_index"])
+            kp = self.indices[index]
+            subnets = {
+                int(p) // size
+                for p in duty["validator_sync_committee_indices"]
+            }
+            for subcommittee in sorted(subnets):
+                sel = self.t.SyncAggregatorSelectionData(
+                    slot=slot, subcommittee_index=subcommittee
+                )
+                proof, _ = self._sign(
+                    kp,
+                    self.spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+                    epoch,
+                    self.t.SyncAggregatorSelectionData.hash_tree_root(sel),
+                )
+                if not is_sync_aggregator(proof, self.spec):
+                    continue
+                try:
+                    c_json = self.client.get_sync_committee_contribution(
+                        slot, subcommittee, head_root
+                    )
+                except Exception:
+                    continue
+                msg = self.t.ContributionAndProof(
+                    aggregator_index=index,
+                    contribution=from_json(
+                        self.t.SyncCommitteeContribution, c_json
+                    ),
+                    selection_proof=proof,
+                )
+                sig, _ = self._sign(
+                    kp,
+                    self.spec.DOMAIN_CONTRIBUTION_AND_PROOF,
+                    epoch,
+                    self.t.ContributionAndProof.hash_tree_root(msg),
+                )
+                out.append(
+                    self.t.SignedContributionAndProof(
+                        message=msg, signature=sig
+                    )
+                )
+        if out:
+            self.metrics["contributions_published"] += self._publish(
+                self.client.post_contribution_and_proofs_json,
+                [ to_json(self.t.SignedContributionAndProof, s) for s in out ],
+            )
+        return out
+
+    # ------------------------------------------------------------ duty loop
+
+    def run_slot(self, slot: int):
+        """One slot of the full duty loop (the per-slot timer body)."""
+        self.propose(slot)
+        self.attest(slot)
+        self.sync_messages(slot)
+        self.aggregate(slot)
+        self.sync_contributions(slot)

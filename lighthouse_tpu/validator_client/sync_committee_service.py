@@ -1,0 +1,150 @@
+"""VC sync-committee service: per-slot messages and contributions.
+
+Role of validator_client/src/sync_committee_service.rs (581 LoC): for
+every managed validator in the current sync committee, publish a
+SyncCommitteeMessage voting on the head block at slot+1/3; for validators
+whose selection proof elects them subcommittee aggregator, publish a
+SignedContributionAndProof wrapping the aggregated contribution at
+slot+2/3. Duties come from sync-committee membership of the head state
+(duties_service/sync.rs); signing goes through the same slashing-exempt
+path as the reference (sync messages are not slashable objects).
+"""
+
+from dataclasses import dataclass
+
+from lighthouse_tpu.beacon_chain.sync_committee_verification import (
+    is_sync_aggregator,
+    subnet_positions_for,
+)
+from lighthouse_tpu.state_processing.helpers import get_domain
+from lighthouse_tpu.types.helpers import compute_signing_root
+
+
+@dataclass
+class SyncDuty:
+    validator_index: int
+    # subcommittee index -> positions within the subcommittee
+    subnet_positions: dict
+
+
+class SyncCommitteeService:
+    def __init__(self, vc):
+        """`vc` is the ValidatorClient owning keys, chain access, and the
+        doppelganger signing gate."""
+        self.vc = vc
+        self.chain = vc.chain
+        self.spec = vc.spec
+        self.t = vc.t
+        self.metrics = {
+            "sync_messages_published": 0,
+            "contributions_published": 0,
+        }
+
+    # ------------------------------------------------------------- duties
+
+    def duties_for_slot(self, slot: int):
+        """Which managed validators sit in the current sync committee
+        (duties_service/sync.rs poll_sync_committee_duties)."""
+        state = self.chain.head_state
+        duties = []
+        for index in self.vc.keys:
+            positions = subnet_positions_for(
+                state, index, self.chain, self.spec
+            )
+            if positions:
+                duties.append(SyncDuty(index, positions))
+        return duties
+
+    # ----------------------------------------------------------- messages
+
+    def produce_messages(self, slot: int):
+        """slot+1/3: one SyncCommitteeMessage per duty validator, voting
+        on the current head root (sync_committee_service.rs:223)."""
+        epoch = self.spec.slot_to_epoch(slot)
+        if not self.vc.signing_enabled(epoch):
+            self.vc.metrics["signings_blocked"] += 1
+            return []
+        state = self.chain.head_state
+        head_root = self.chain.head_root
+        domain = get_domain(
+            state, self.spec.DOMAIN_SYNC_COMMITTEE, epoch, self.spec
+        )
+        signing_root = compute_signing_root(head_root, domain)
+        out = []
+        for duty in self.duties_for_slot(slot):
+            sig = self.vc.keys[duty.validator_index].sk.sign(signing_root)
+            out.append(
+                self.t.SyncCommitteeMessage(
+                    slot=slot,
+                    beacon_block_root=head_root,
+                    validator_index=duty.validator_index,
+                    signature=sig.to_bytes(),
+                )
+            )
+        self.metrics["sync_messages_published"] += len(out)
+        return out
+
+    # ------------------------------------------------------ contributions
+
+    def selection_proof(self, slot: int, subcommittee: int, index: int):
+        state = self.chain.head_state
+        domain = get_domain(
+            state,
+            self.spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+            self.spec.slot_to_epoch(slot),
+            self.spec,
+        )
+        data = self.t.SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee
+        )
+        root = compute_signing_root(
+            self.t.SyncAggregatorSelectionData.hash_tree_root(data), domain
+        )
+        return self.vc.keys[index].sk.sign(root).to_bytes()
+
+    def produce_contributions(self, slot: int):
+        """slot+2/3: elected aggregators wrap the pool's per-subcommittee
+        contribution in a SignedContributionAndProof
+        (sync_committee_service.rs:291-318)."""
+        epoch = self.spec.slot_to_epoch(slot)
+        if not self.vc.signing_enabled(epoch):
+            self.vc.metrics["signings_blocked"] += 1
+            return []
+        state = self.chain.head_state
+        head_root = self.chain.head_root
+        cap_domain = get_domain(
+            state,
+            self.spec.DOMAIN_CONTRIBUTION_AND_PROOF,
+            epoch,
+            self.spec,
+        )
+        out = []
+        for duty in self.duties_for_slot(slot):
+            for subcommittee in duty.subnet_positions:
+                proof = self.selection_proof(
+                    slot, subcommittee, duty.validator_index
+                )
+                if not is_sync_aggregator(proof, self.spec):
+                    continue
+                contribution = self.chain.sync_message_pool.get_contribution(
+                    slot, head_root, subcommittee
+                )
+                if contribution is None:
+                    continue
+                msg = self.t.ContributionAndProof(
+                    aggregator_index=duty.validator_index,
+                    contribution=contribution.copy(),
+                    selection_proof=proof,
+                )
+                root = compute_signing_root(
+                    self.t.ContributionAndProof.hash_tree_root(msg),
+                    cap_domain,
+                )
+                sig = self.vc.keys[duty.validator_index].sk.sign(root)
+                out.append(
+                    self.t.SignedContributionAndProof(
+                        message=msg, signature=sig.to_bytes()
+                    )
+                )
+        self.metrics["contributions_published"] += len(out)
+        return out

@@ -1,0 +1,112 @@
+"""Standalone beacon-node process for socket-transport tests.
+
+Two roles (driven by tests/test_socket_net.py over pipes):
+  producer — owns every interop key; each slot builds an attested block
+             via the harness, imports it, and gossips it over TCP.
+  follower — dials the producer via UDP discovery, imports gossip
+             blocks, range-syncs any gap via the socket RPC.
+
+Prints one JSON status line per slot on stdout:
+  {"slot": N, "head_slot": N, "finalized_epoch": N, "peers": N}
+
+The two-OS-process topology is the reference's
+lighthouse_network/tests/rpc_tests.rs / testing/simulator role, with
+real bytes on localhost sockets.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from lighthouse_tpu.harness import Harness  # noqa: E402
+from lighthouse_tpu.node import BeaconNode  # noqa: E402
+from lighthouse_tpu.types.spec import minimal_spec  # noqa: E402
+
+
+def main():
+    role = sys.argv[1]
+    n_validators = int(sys.argv[2])
+    n_slots = int(sys.argv[3])
+    boot_udp = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+    start_slot = int(sys.argv[5]) if len(sys.argv) > 5 else 1
+
+    spec = minimal_spec(ALTAIR_FORK_EPOCH=2**64 - 1)
+    h = Harness(spec, n_validators)
+    h.backend = "fake"
+    node = BeaconNode(
+        f"{role}-{os.getpid()}", h.state.copy(), spec, backend="fake"
+    )
+    net = node.attach_socket_net()
+    # announce our endpoints first so the parent can wire the topology
+    print(
+        json.dumps(
+            {"ready": True, "tcp": net.tcp_port, "udp": net.udp_port}
+        ),
+        flush=True,
+    )
+
+    if boot_udp:
+        net.discover("127.0.0.1", boot_udp)
+        node.sync.run_range_sync()
+
+    for slot in range(start_slot, start_slot + n_slots):
+        node.on_slot(slot)
+        if role == "producer":
+            block = h.advance_slot_with_block(slot)
+            node.chain.process_block(block)
+            node.publish_block(block)
+        else:
+            # follower: drain gossip, then close any gap over RPC
+            node.processor.process_pending()
+            if node.chain.head_state.slot < slot - 1 and net.peers:
+                node.sync.run_range_sync()
+        print(
+            json.dumps(
+                {
+                    "slot": slot,
+                    "head_slot": node.chain.head_state.slot,
+                    "finalized_epoch": (
+                        node.chain.head_state.finalized_checkpoint.epoch
+                    ),
+                    "peers": len(net.peers),
+                }
+            ),
+            flush=True,
+        )
+        # follower paces itself off stdin: the test feeds one line per
+        # slot so both processes stay in lockstep without a shared clock
+        if sys.stdin.isatty() is False:
+            line = sys.stdin.readline()
+            if not line:
+                break
+    # final drain so late gossip still lands before the report
+    node.processor.process_pending()
+    if role == "follower" and net.peers:
+        node.sync.run_range_sync()
+    print(
+        json.dumps(
+            {
+                "done": True,
+                "head_slot": node.chain.head_state.slot,
+                "head_root": node.chain.head_root.hex(),
+                "finalized_epoch": (
+                    node.chain.head_state.finalized_checkpoint.epoch
+                ),
+            }
+        ),
+        flush=True,
+    )
+    # linger serving gossip/RPC (a rejoining peer may still need to
+    # range-sync from us) until the driver closes stdin
+    if not sys.stdin.isatty():
+        sys.stdin.readline()
+    net.close()
+
+
+if __name__ == "__main__":
+    main()

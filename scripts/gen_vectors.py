@@ -1,0 +1,373 @@
+"""Generate the embedded conformance-vector tree under tests/vectors/.
+
+Role of the reference's `make make-ef-tests` + testing/ef_tests: the
+official consensus-spec-tests tarballs are not fetchable here (zero
+egress), so the tree is generated ONCE with the pure-reference backend
+and committed byte-pinned — any later regression in DST, domain
+constants, serialization flags, subgroup policy, or hash-to-curve
+internals changes bytes and fails the runner (handler.rs:10-76 analog in
+tests/test_conformance_vectors.py).
+
+Hand-pinned interop anchors (independent of this repo's code):
+  * sk=1 pubkey MUST equal the compressed BLS12-381 G1 generator.
+  * the signing DST MUST be the IETF ciphersuite string
+    BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_ (blst.rs:14).
+  * the infinity pubkey (0xc0 || 0..) MUST be rejected at
+    deserialization (blst.rs:126-136).
+
+Run: python scripts/gen_vectors.py   (rewrites tests/vectors/)
+"""
+
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu import bls  # noqa: E402
+from lighthouse_tpu.bls.hash_to_curve import hash_to_g2  # noqa: E402
+from lighthouse_tpu.crypto.constants import DST_G2  # noqa: E402
+from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP  # noqa: E402
+
+VECTOR_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests",
+    "vectors",
+)
+
+# The compressed BLS12-381 G1 generator — a public constant, NOT derived
+# from this repo's code. sk=1 must map to exactly these bytes.
+G1_GENERATOR_COMPRESSED = (
+    "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905"
+    "a14e3a3f171bac586c55e83ff97a1aeffb3af00adb22c6bb"
+)
+
+
+def write_case(runner: str, handler: str, name: str, obj: dict):
+    d = os.path.join(VECTOR_ROOT, runner, handler)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, name + ".json"), "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def hx(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def keypair(i: int) -> bls.Keypair:
+    return bls.Keypair(bls.SecretKey.from_bytes(i.to_bytes(32, "big")))
+
+
+def non_subgroup_signature() -> bytes:
+    """96 compressed bytes that decompress to an on-curve G2 point
+    OUTSIDE the r-torsion subgroup (must fail verification)."""
+    base = bytearray(keypair(7).sk.sign(b"seed").to_bytes())
+    for i in range(1, 256):
+        cand = bytes(base[:-1]) + bytes([base[-1] ^ i])
+        try:
+            sig = bls.Signature.from_bytes(cand)
+        except ValueError:
+            continue
+        if not sig.in_subgroup():
+            return cand
+    raise RuntimeError("no non-subgroup candidate found")
+
+
+def main():
+    shutil.rmtree(VECTOR_ROOT, ignore_errors=True)
+
+    # ---- bls/sign -------------------------------------------------------
+    messages = [b"", b"\x5a" * 32, b"lighthouse-tpu conformance", b"\xff"]
+    for i, msg in enumerate(messages):
+        kp = keypair(i + 1)
+        write_case(
+            "bls",
+            "sign",
+            f"sign_{i}",
+            {
+                "input": {
+                    "privkey": hx(kp.sk.to_bytes()),
+                    "message": hx(msg),
+                },
+                "output": hx(kp.sk.sign(msg).to_bytes()),
+            },
+        )
+
+    # ---- bls/verify (incl. adversarial edges) ---------------------------
+    kp = keypair(3)
+    msg = b"\x5a" * 32
+    sig = kp.sk.sign(msg).to_bytes()
+    flipped = bytearray(sig)
+    flipped[20] ^= 0x01
+    cases = [
+        ("valid", hx(kp.pk.to_bytes()), hx(msg), hx(sig), True),
+        (
+            "wrong_pubkey",
+            hx(keypair(4).pk.to_bytes()),
+            hx(msg),
+            hx(sig),
+            False,
+        ),
+        (
+            "tampered_sig",
+            hx(kp.pk.to_bytes()),
+            hx(msg),
+            hx(bytes(flipped)),
+            False,
+        ),
+        (
+            "infinity_pubkey",
+            hx(bls.INFINITY_PUBKEY_BYTES),
+            hx(msg),
+            hx(sig),
+            False,
+        ),
+        (
+            "infinity_signature",
+            hx(kp.pk.to_bytes()),
+            hx(msg),
+            hx(bls.INFINITY_SIGNATURE_BYTES),
+            False,
+        ),
+        (
+            "non_subgroup_sig",
+            hx(kp.pk.to_bytes()),
+            hx(msg),
+            hx(non_subgroup_signature()),
+            False,
+        ),
+        ("wrong_message", hx(kp.pk.to_bytes()), hx(b"\xa5" * 32), hx(sig), False),
+    ]
+    for name, pk, m, s, out in cases:
+        write_case(
+            "bls",
+            "verify",
+            f"verify_{name}",
+            {
+                "input": {"pubkey": pk, "message": m, "signature": s},
+                "output": out,
+            },
+        )
+
+    # ---- bls/aggregate --------------------------------------------------
+    sigs = [keypair(i + 1).sk.sign(b"agg").to_bytes() for i in range(3)]
+    agg = bls.aggregate_signatures(
+        [bls.Signature.from_bytes(s) for s in sigs]
+    )
+    write_case(
+        "bls",
+        "aggregate",
+        "aggregate_3",
+        {"input": [hx(s) for s in sigs], "output": hx(agg.to_bytes())},
+    )
+    write_case("bls", "aggregate", "aggregate_empty", {
+        "input": [], "output": None,
+    })
+
+    # ---- bls/fast_aggregate_verify -------------------------------------
+    kps = [keypair(i + 10) for i in range(4)]
+    msg = b"\x11" * 32
+    fagg = bls.aggregate_signatures([kp.sk.sign(msg) for kp in kps])
+    write_case(
+        "bls",
+        "fast_aggregate_verify",
+        "fav_valid",
+        {
+            "input": {
+                "pubkeys": [hx(kp.pk.to_bytes()) for kp in kps],
+                "message": hx(msg),
+                "signature": hx(fagg.to_bytes()),
+            },
+            "output": True,
+        },
+    )
+    write_case(
+        "bls",
+        "fast_aggregate_verify",
+        "fav_extra_pubkey",
+        {
+            "input": {
+                "pubkeys": [hx(kp.pk.to_bytes()) for kp in kps]
+                + [hx(keypair(99).pk.to_bytes())],
+                "message": hx(msg),
+                "signature": hx(fagg.to_bytes()),
+            },
+            "output": False,
+        },
+    )
+    write_case(
+        "bls",
+        "fast_aggregate_verify",
+        "fav_empty_pubkeys",
+        {
+            "input": {
+                "pubkeys": [],
+                "message": hx(msg),
+                "signature": hx(fagg.to_bytes()),
+            },
+            "output": False,
+        },
+    )
+
+    # ---- bls/eth_fast_aggregate_verify (altair variant) -----------------
+    write_case(
+        "bls",
+        "eth_fast_aggregate_verify",
+        "efav_empty_infinity",
+        {
+            "input": {
+                "pubkeys": [],
+                "message": hx(msg),
+                "signature": hx(bls.INFINITY_SIGNATURE_BYTES),
+            },
+            "output": True,
+        },
+    )
+    write_case(
+        "bls",
+        "eth_fast_aggregate_verify",
+        "efav_valid",
+        {
+            "input": {
+                "pubkeys": [hx(kp.pk.to_bytes()) for kp in kps],
+                "message": hx(msg),
+                "signature": hx(fagg.to_bytes()),
+            },
+            "output": True,
+        },
+    )
+
+    # ---- bls/aggregate_verify ------------------------------------------
+    pairs = [(keypair(i + 20), bytes([i]) * 32) for i in range(3)]
+    asig = bls.aggregate_signatures(
+        [kp.sk.sign(m) for kp, m in pairs]
+    )
+    write_case(
+        "bls",
+        "aggregate_verify",
+        "av_valid",
+        {
+            "input": {
+                "pubkeys": [hx(kp.pk.to_bytes()) for kp, _ in pairs],
+                "messages": [hx(m) for _, m in pairs],
+                "signature": hx(asig.to_bytes()),
+            },
+            "output": True,
+        },
+    )
+    write_case(
+        "bls",
+        "aggregate_verify",
+        "av_swapped_messages",
+        {
+            "input": {
+                "pubkeys": [hx(kp.pk.to_bytes()) for kp, _ in pairs],
+                "messages": [hx(m) for _, m in reversed(pairs)],
+                "signature": hx(asig.to_bytes()),
+            },
+            "output": False,
+        },
+    )
+
+    # ---- bls/eth_aggregate_pubkeys -------------------------------------
+    write_case(
+        "bls",
+        "eth_aggregate_pubkeys",
+        "eap_3",
+        {
+            "input": [hx(kp.pk.to_bytes()) for kp in kps[:3]],
+            "output": hx(
+                bls.aggregate_public_keys(
+                    [kp.pk for kp in kps[:3]]
+                ).to_bytes()
+            ),
+        },
+    )
+    write_case("bls", "eth_aggregate_pubkeys", "eap_empty", {
+        "input": [], "output": None,
+    })
+
+    # ---- hash_to_curve/g2 (byte-pinned internals + DST anchor) ----------
+    for i, m in enumerate([b"", b"abc", b"a" * 64]):
+        pt = hash_to_g2(m)
+        x, y = G2_GROUP.to_affine(pt)
+        write_case(
+            "hash_to_curve",
+            "g2",
+            f"h2c_{i}",
+            {
+                "input": {"msg": hx(m), "dst": DST_G2.decode()},
+                "output": {
+                    "x_re": hex(x[0]),
+                    "x_im": hex(x[1]),
+                    "y_re": hex(y[0]),
+                    "y_im": hex(y[1]),
+                },
+            },
+        )
+
+    # ---- serialization/pubkey ------------------------------------------
+    write_case(
+        "serialization",
+        "pubkey",
+        "sk1_is_g1_generator",
+        {
+            "input": {"privkey": hx((1).to_bytes(32, "big"))},
+            "output": "0x" + G1_GENERATOR_COMPRESSED,
+        },
+    )
+    bad_pubkeys = {
+        "infinity_with_x_bits": "0xc0" + "11" * 47,
+        "too_short": "0x" + "aa" * 40,
+        "no_compression_flag": "0x" + "00" * 48,
+        "x_ge_modulus": "0x9a" + "ff" * 47,
+        "infinity_point": hx(bls.INFINITY_PUBKEY_BYTES),
+    }
+    for name, b in bad_pubkeys.items():
+        write_case(
+            "serialization",
+            "pubkey",
+            f"invalid_{name}",
+            {"input": {"pubkey": b}, "output": False},
+        )
+    kp5 = keypair(5)
+    write_case(
+        "serialization",
+        "pubkey",
+        "roundtrip_valid",
+        {"input": {"pubkey": hx(kp5.pk.to_bytes())}, "output": True},
+    )
+
+    # ---- serialization/signature ---------------------------------------
+    write_case(
+        "serialization",
+        "signature",
+        "roundtrip_valid",
+        {
+            "input": {"signature": hx(kp5.sk.sign(b"x").to_bytes())},
+            "output": True,
+        },
+    )
+    write_case(
+        "serialization",
+        "signature",
+        "invalid_too_short",
+        {"input": {"signature": "0x" + "bb" * 90}, "output": False},
+    )
+
+    # ---- meta: the DST anchor (independent hand-pinned string) ----------
+    write_case(
+        "bls",
+        "meta",
+        "dst",
+        {"dst": "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"},
+    )
+
+    n = sum(len(fs) for _, _, fs in os.walk(VECTOR_ROOT))
+    print(f"wrote {n} vector files under {VECTOR_ROOT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -20,3 +20,9 @@ from lighthouse_tpu.backend import (  # noqa: E402
 
 enable_compile_cache()
 force_cpu_backend(8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration tests"
+    )

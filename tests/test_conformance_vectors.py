@@ -1,0 +1,221 @@
+"""Conformance-vector runner: the ef_tests analog.
+
+Role of testing/ef_tests/src/handler.rs:10-76: a generic handler walks
+the committed vector tree (tests/vectors/<runner>/<handler>/<case>.json),
+decodes each case, runs it against the implementation, and a final check
+asserts EVERY vector file was consumed (Makefile:105
+check_all_files_accessed.py). BLS signature handlers run on both real
+backends — "ref" (pure reference) and "tpu" (device batch path) — and are
+skipped for "fake" exactly as the reference feature-gates them
+(handler.rs:283 `cfg!(not(feature = "fake_crypto"))`); the fake backend
+gets its own accept-everything sanity case.
+"""
+
+import json
+import os
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.bls.hash_to_curve import hash_to_g2
+from lighthouse_tpu.crypto.constants import DST_G2
+from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
+
+VECTOR_ROOT = os.path.join(os.path.dirname(__file__), "vectors")
+
+CONSUMED: set = set()
+
+REAL_BACKENDS = ("ref", "tpu")
+
+
+def _load(runner, handler):
+    d = os.path.join(VECTOR_ROOT, runner, handler)
+    cases = []
+    for name in sorted(os.listdir(d)):
+        path = os.path.join(d, name)
+        with open(path) as f:
+            cases.append((name, json.load(f)))
+        CONSUMED.add(os.path.relpath(path, VECTOR_ROOT))
+    assert cases, f"empty handler dir {runner}/{handler}"
+    return cases
+
+
+def _unhex(s):
+    return bytes.fromhex(s[2:])
+
+
+def _try_verify(pk_hex, msg_hex, sig_hex, backend) -> bool:
+    """Deserialize-then-verify; any decode failure is a False verdict
+    (bls_verify_msg.rs unwrap_or(false))."""
+    try:
+        pk = bls.PublicKey.from_bytes(_unhex(pk_hex))
+        sig = bls.Signature.from_bytes(_unhex(sig_hex))
+        sset = bls.SignatureSet(sig, [pk], _unhex(msg_hex))
+        return bls.verify_signature_sets([sset], backend=backend)
+    except ValueError:
+        return False
+
+
+@pytest.mark.parametrize("backend", REAL_BACKENDS)
+def test_bls_sign(backend):
+    for name, case in _load("bls", "sign"):
+        sk = bls.SecretKey.from_bytes(_unhex(case["input"]["privkey"]))
+        sig = sk.sign(_unhex(case["input"]["message"]))
+        assert sig.to_bytes() == _unhex(case["output"]), name
+        # the signature must verify under the backend being conformed
+        assert _try_verify(
+            "0x" + sk.public_key().to_bytes().hex(),
+            case["input"]["message"],
+            case["output"],
+            backend,
+        ), name
+
+
+@pytest.mark.parametrize("backend", REAL_BACKENDS)
+def test_bls_verify(backend):
+    for name, case in _load("bls", "verify"):
+        got = _try_verify(
+            case["input"]["pubkey"],
+            case["input"]["message"],
+            case["input"]["signature"],
+            backend,
+        )
+        assert got == case["output"], f"{name} on {backend}"
+
+
+def test_bls_aggregate():
+    for name, case in _load("bls", "aggregate"):
+        sigs = [bls.Signature.from_bytes(_unhex(s)) for s in case["input"]]
+        if case["output"] is None:
+            with pytest.raises(Exception):
+                bls.aggregate_signatures(sigs)
+            continue
+        agg = bls.aggregate_signatures(sigs)
+        assert agg.to_bytes() == _unhex(case["output"]), name
+
+
+@pytest.mark.parametrize("backend", REAL_BACKENDS)
+def test_bls_fast_aggregate_verify(backend):
+    for name, case in _load("bls", "fast_aggregate_verify"):
+        inp = case["input"]
+        try:
+            pks = [bls.PublicKey.from_bytes(_unhex(p)) for p in inp["pubkeys"]]
+            sig = bls.Signature.from_bytes(_unhex(inp["signature"]))
+            if not pks:
+                got = False
+            else:
+                agg_pk = bls.aggregate_public_keys(pks)
+                sset = bls.SignatureSet(
+                    sig, [agg_pk], _unhex(inp["message"])
+                )
+                got = bls.verify_signature_sets([sset], backend=backend)
+        except ValueError:
+            got = False
+        assert got == case["output"], f"{name} on {backend}"
+
+
+def test_bls_eth_fast_aggregate_verify():
+    for name, case in _load("bls", "eth_fast_aggregate_verify"):
+        inp = case["input"]
+        pks = [bls.PublicKey.from_bytes(_unhex(p)) for p in inp["pubkeys"]]
+        sig = bls.Signature.from_bytes(_unhex(inp["signature"]))
+        got = bls.eth_fast_aggregate_verify(
+            pks, _unhex(inp["message"]), sig
+        )
+        assert got == case["output"], name
+
+
+def test_bls_aggregate_verify():
+    for name, case in _load("bls", "aggregate_verify"):
+        inp = case["input"]
+        pks = [bls.PublicKey.from_bytes(_unhex(p)) for p in inp["pubkeys"]]
+        sig = bls.Signature.from_bytes(_unhex(inp["signature"]))
+        got = bls.aggregate_verify(
+            pks, [_unhex(m) for m in inp["messages"]], sig
+        )
+        assert got == case["output"], name
+
+
+def test_bls_eth_aggregate_pubkeys():
+    for name, case in _load("bls", "eth_aggregate_pubkeys"):
+        pks = [bls.PublicKey.from_bytes(_unhex(p)) for p in case["input"]]
+        if case["output"] is None:
+            with pytest.raises(Exception):
+                bls.aggregate_public_keys(pks)
+            continue
+        agg = bls.aggregate_public_keys(pks)
+        assert agg.to_bytes() == _unhex(case["output"]), name
+
+
+def test_bls_dst_anchor():
+    """The ciphersuite string is hand-pinned, not generated: a DST typo
+    in the code cannot re-pin itself."""
+    (_, case), = _load("bls", "meta")
+    assert DST_G2.decode() == case["dst"]
+    assert (
+        case["dst"] == "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+    )
+
+
+def test_hash_to_curve_g2():
+    for name, case in _load("hash_to_curve", "g2"):
+        assert case["input"]["dst"] == DST_G2.decode(), name
+        pt = hash_to_g2(_unhex(case["input"]["msg"]))
+        x, y = G2_GROUP.to_affine(pt)
+        out = case["output"]
+        assert x[0] == int(out["x_re"], 16), name
+        assert x[1] == int(out["x_im"], 16), name
+        assert y[0] == int(out["y_re"], 16), name
+        assert y[1] == int(out["y_im"], 16), name
+
+
+def test_serialization_pubkey():
+    for name, case in _load("serialization", "pubkey"):
+        if "privkey" in case["input"]:
+            sk = bls.SecretKey.from_bytes(_unhex(case["input"]["privkey"]))
+            assert (
+                sk.public_key().to_bytes() == _unhex(case["output"])
+            ), name
+            continue
+        try:
+            bls.PublicKey.from_bytes(_unhex(case["input"]["pubkey"]))
+            ok = True
+        except ValueError:
+            ok = False
+        assert ok == case["output"], name
+
+
+def test_serialization_signature():
+    for name, case in _load("serialization", "signature"):
+        try:
+            bls.Signature.from_bytes(_unhex(case["input"]["signature"]))
+            ok = True
+        except ValueError:
+            ok = False
+        assert ok == case["output"], name
+
+
+def test_fake_backend_accepts_everything():
+    """fake_crypto semantics: structurally-sound sets always verify
+    (crypto/bls/src/impls/fake_crypto.rs)."""
+    kp = bls.Keypair(bls.SecretKey.from_bytes((9).to_bytes(32, "big")))
+    wrong = bls.Keypair(bls.SecretKey.from_bytes((10).to_bytes(32, "big")))
+    sset = bls.SignatureSet(
+        kp.sk.sign(b"m"), [wrong.pk], b"not the message"
+    )
+    assert bls.verify_signature_sets([sset], backend="fake")
+    assert not bls.verify_signature_sets([], backend="fake")
+
+
+def test_zz_all_vector_files_consumed():
+    """check_all_files_accessed.py analog (Makefile:105). Named zz_ so it
+    runs after every handler in this module."""
+    all_files = set()
+    for root, _, files in os.walk(VECTOR_ROOT):
+        for f in files:
+            all_files.add(
+                os.path.relpath(os.path.join(root, f), VECTOR_ROOT)
+            )
+    missed = all_files - CONSUMED
+    assert not missed, f"vector files never consumed: {sorted(missed)}"
+    assert len(all_files) >= 30
