@@ -27,12 +27,40 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
     import jax
 
     if cache_dir is None:
-        cache_dir = os.path.join(
+        # LIGHTHOUSE_TPU_CACHE_DIR lets the TPU watcher point hardware
+        # measurements at a throwaway cache: the persistent cache can serve
+        # pathologically slow executables (its key ignores input layouts),
+        # so perf numbers must come from fresh compiles — without wiping
+        # the main cache the driver's multi-chip dryrun relies on.
+        cache_dir = os.environ.get("LIGHTHOUSE_TPU_CACHE_DIR") or os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             ".jax_cache",
         )
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def tpu_probe_ok(timeout_s: float = 90.0) -> bool:
+    """Probe the tunneled TPU backend in a SUBPROCESS with a hard timeout.
+
+    The axon tunnel has two failure modes observed across rounds: fast
+    init errors (RuntimeError) and outright hangs where jax.devices()
+    never returns. Probing in-process would hang the caller with it, so a
+    throwaway subprocess takes the risk instead. Lives here (not bench.py)
+    so the round-long watcher daemon can import it without pulling jax
+    into its own process."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
 
 
 def force_cpu_backend(n_devices: int = 8) -> None:
