@@ -56,6 +56,21 @@ def _ensure_backend():
     possible flip — XLA_FLAGS is parsed once at first client creation."""
     import jax
 
+    # BENCH_SKIP_PROBE: the watcher probes the tunnel itself immediately
+    # before each sweep; re-probing per config would burn up to 90 s of
+    # the scarce tunnel-up window 4 times over (any in-process hang is
+    # contained by the watcher's per-config subprocess deadline).
+    if os.environ.get("BENCH_SKIP_PROBE") == "1":
+        try:
+            jax.devices()
+            return jax, jax.default_backend()
+        except RuntimeError as e:
+            print(f"bench: TPU backend unavailable ({e}); using CPU",
+                  file=sys.stderr)
+            from lighthouse_tpu.backend import force_cpu_backend
+
+            force_cpu_backend(1)
+            return jax, "cpu"
     if not _tpu_probe_ok():
         print("bench: TPU backend unavailable or hung; using CPU", file=sys.stderr)
         from lighthouse_tpu.backend import force_cpu_backend
@@ -131,8 +146,13 @@ def _run_cpu_fallback(allow_replay: bool = True):
             "metric": metric,
             "value": best["value"],
             "unit": best.get("unit", "sigs/sec"),
+            # only the sigsets metric is measured against the 150k north
+            # star; other configs must carry their own ratio
             "vs_baseline": best.get(
-                "vs_baseline", round(best["value"] / TARGET_SIGS_PER_SEC, 4)
+                "vs_baseline",
+                round(best["value"] / TARGET_SIGS_PER_SEC, 4)
+                if metric == "verify_signature_sets_throughput"
+                else 0.0,
             ),
             "platform": best.get("platform", "tpu"),
             "impl": best.get("impl", "xla"),

@@ -86,6 +86,12 @@ class SyncMessageAggregationPool:
             1,
         )
         outcome = InsertOutcome.ALREADY_KNOWN
+        # The signature must be aggregated ONCE PER SET BIT: sync
+        # committees sample with replacement, so one validator can hold
+        # several positions in a subcommittee, and verification pairs
+        # the pubkey once per bit (the reference loops `from_message`
+        # per position in add_to_naive_sync_aggregation_pool).
+        sig = bls.Signature.from_bytes(bytes(msg.signature))
         for subcommittee, positions in verified_msg.subnet_positions.items():
             key = (msg.slot, bytes(msg.beacon_block_root), subcommittee)
             existing = self._contributions.get(key)
@@ -98,21 +104,22 @@ class SyncMessageAggregationPool:
                     beacon_block_root=bytes(msg.beacon_block_root),
                     subcommittee_index=subcommittee,
                     aggregation_bits=bits,
-                    signature=bytes(msg.signature),
+                    signature=bls.aggregate_signatures(
+                        [sig] * len(positions)
+                    ).to_bytes(),
                 )
                 outcome = InsertOutcome.NEW
                 continue
             old_bits = list(existing.aggregation_bits)
-            if all(old_bits[p] for p in positions):
+            newly_set = [p for p in positions if not old_bits[p]]
+            if not newly_set:
                 continue
-            for p in positions:
+            for p in newly_set:
                 old_bits[p] = True
             existing.aggregation_bits = old_bits
             existing.signature = bls.aggregate_signatures(
-                [
-                    bls.Signature.from_bytes(bytes(existing.signature)),
-                    bls.Signature.from_bytes(bytes(msg.signature)),
-                ]
+                [bls.Signature.from_bytes(bytes(existing.signature))]
+                + [sig] * len(newly_set)
             ).to_bytes()
             outcome = InsertOutcome.AGGREGATED
         return outcome

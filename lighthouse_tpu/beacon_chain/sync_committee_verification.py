@@ -44,11 +44,16 @@ def sync_subcommittee_size(spec) -> int:
     return max(spec.SYNC_COMMITTEE_SIZE // spec.SYNC_COMMITTEE_SUBNET_COUNT, 1)
 
 
-def committee_positions(state, validator_index: int, chain) -> list[int]:
-    """All positions of `validator_index` in the current sync committee
-    (a validator can appear multiple times)."""
+def committee_positions(
+    state, validator_index: int, chain, committee=None
+) -> list[int]:
+    """All positions of `validator_index` in a sync committee (the
+    state's current one unless `committee` is given; a validator can
+    appear multiple times — sampling is with replacement)."""
+    if committee is None:
+        committee = state.current_sync_committee
     positions = []
-    for pos, pk in enumerate(state.current_sync_committee.pubkeys):
+    for pos, pk in enumerate(committee.pubkeys):
         idx = chain.pubkey_cache.index_of(bytes(pk))
         if idx == validator_index:
             positions.append(pos)
@@ -82,10 +87,12 @@ def is_sync_aggregator(selection_proof: bytes, spec) -> bool:
 
 def _check_slot_window(chain, slot: int, what: str):
     """verify_propagation_slot_range (sync_committee_verification.rs:519):
-    sync messages are only valid for the current slot (one slot of
-    clock-disparity tolerance on each side)."""
+    sync messages are only valid for the current slot, with one slot of
+    clock-disparity tolerance on each side (the reference permits
+    MAXIMUM_GOSSIP_CLOCK_DISPARITY futureward — a marginally-ahead peer
+    at a slot boundary must not be dropped)."""
     current = chain.current_slot()
-    if slot > current:
+    if slot > current + 1:
         raise SyncCommitteeError(f"future-slot {what}")
     if slot + 1 < current:
         raise SyncCommitteeError(f"past-slot {what}")

@@ -392,7 +392,7 @@ class BeaconApiServer:
             if parts[4] == "attester":
                 return self._attester_duties(int(parts[5]), indices)
             if parts[4] == "sync":
-                return self._sync_duties(indices)
+                return self._sync_duties(int(parts[5]), indices)
         raise ApiError(404, f"unknown route {path}")
 
     # ------------------------------------------------------------ helpers
@@ -453,18 +453,37 @@ class BeaconApiServer:
                         )
         return {"data": duties}
 
-    def _sync_duties(self, indices):
+    def _sync_duties(self, epoch: int, indices):
         """POST /eth/v1/validator/duties/sync/{epoch}: membership +
-        positions in the current sync committee."""
+        positions in the sync committee serving `epoch` — the head
+        state's current committee for the current period, its next
+        committee for the next period (the reference resolves duties by
+        the period containing the requested epoch); anything beyond the
+        next period is not derivable from the head state."""
         from lighthouse_tpu.beacon_chain.sync_committee_verification import (
             committee_positions,
         )
 
         chain = self.chain
         state = chain.head_state
+        spec = chain.spec
+        period = epoch // spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        head_period = spec.slot_to_epoch(
+            state.slot
+        ) // spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        if period == head_period:
+            committee = state.current_sync_committee
+        elif period == head_period + 1:
+            committee = state.next_sync_committee
+        else:
+            raise ApiError(
+                400,
+                f"epoch {epoch} is outside the current and next "
+                f"sync-committee periods",
+            )
         duties = []
         for v in indices:
-            positions = committee_positions(state, v, chain)
+            positions = committee_positions(state, v, chain, committee)
             if positions:
                 duties.append(
                     {

@@ -56,6 +56,11 @@ KIND_RPC_REQ = 3
 KIND_RPC_RSP = 4
 KIND_SUB = 5
 
+# Dedup-cache generation size: at mainnet gossip rates (~tens of msgs/s)
+# one generation covers several minutes — comfortably past the reference
+# duplicate-cache TTL — while bounding the cache at 2 generations.
+SEEN_CACHE_PER_GENERATION = 65_536
+
 FORK_ORDER = ["phase0", "altair", "bellatrix"]
 
 
@@ -150,7 +155,14 @@ class SocketNet:
         self.deliver = None  # set by join()
         self.local_topics: set[str] = set()
         self.peers: dict[str, _PeerConn] = {}
+        # Gossip message-id dedup: two rotating generations so the cache
+        # is bounded for the life of the process (the reference's
+        # gossipsub duplicate cache is time-bounded; size-bounded
+        # rotation gives the same no-leak property without a timer
+        # thread). Membership = either generation; rotation drops ids
+        # older than one full generation.
         self._seen: set[bytes] = set()
+        self._seen_prev: set[bytes] = set()
         self._seen_lock = threading.Lock()
         self._pending: dict[int, tuple[threading.Event, list]] = {}
         self._req_id = 0
@@ -194,11 +206,21 @@ class SocketNet:
         if len(data) > GOSSIP_MAX_SIZE:
             return 0
         mid = message_id(topic_str.encode() + data)
-        with self._seen_lock:
-            if mid in self._seen:
-                return 0
-            self._seen.add(mid)
+        if self._seen_check_and_add(mid):
+            return 0
         return self._fanout(topic_str, data, exclude=None)
+
+    def _seen_check_and_add(self, mid: bytes) -> bool:
+        """True if `mid` was already seen; otherwise records it and
+        rotates the generations when the current one fills."""
+        with self._seen_lock:
+            if mid in self._seen or mid in self._seen_prev:
+                return True
+            self._seen.add(mid)
+            if len(self._seen) >= SEEN_CACHE_PER_GENERATION:
+                self._seen_prev = self._seen
+                self._seen = set()
+            return False
 
     def report(self, peer_id: str, delta: float):
         conn = self.peers.get(peer_id)
@@ -344,10 +366,8 @@ class SocketNet:
             topic_str = body[2 : 2 + tlen].decode()
             payload = body[2 + tlen :]
             mid = message_id(topic_str.encode() + payload)
-            with self._seen_lock:
-                if mid in self._seen:
-                    return
-                self._seen.add(mid)
+            if self._seen_check_and_add(mid):
+                return
             if topic_str in self.local_topics and self.deliver is not None:
                 self.deliver(topic_str, payload, conn.node_id)
             # flood onward to other interested peers

@@ -27,9 +27,6 @@ from lighthouse_tpu.validator_client.slashing_protection import (
     SlashingProtectionDB,
 )
 
-TARGET_AGGREGATORS_PER_COMMITTEE = 16
-
-
 class HttpValidatorClient:
     def __init__(
         self,
@@ -215,7 +212,7 @@ class HttpValidatorClient:
             modulo = max(
                 1,
                 int(duty["committee_length"])
-                // TARGET_AGGREGATORS_PER_COMMITTEE,
+                // self.spec.TARGET_AGGREGATORS_PER_COMMITTEE,
             )
             if int.from_bytes(hash32(proof)[:8], "little") % modulo:
                 continue

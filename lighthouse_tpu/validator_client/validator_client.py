@@ -25,8 +25,6 @@ from lighthouse_tpu.validator_client.slashing_protection import (
     SlashingProtectionDB,
 )
 
-TARGET_AGGREGATORS_PER_COMMITTEE = 16
-
 
 @dataclass
 class AttesterDuty:
@@ -127,7 +125,8 @@ class ValidatorClient:
         duty.selection_proof = proof
         modulo = max(
             1,
-            duty.committee_length // TARGET_AGGREGATORS_PER_COMMITTEE,
+            duty.committee_length
+            // self.spec.TARGET_AGGREGATORS_PER_COMMITTEE,
         )
         duty.is_aggregator = (
             int.from_bytes(hash32(proof)[:8], "little") % modulo == 0

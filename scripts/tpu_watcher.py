@@ -73,6 +73,7 @@ def run_one(impl: str, n_sets: int, cache_dir: str):
         os.environ,
         BENCH_INNER="1",
         BENCH_REQUIRE_TPU="1",
+        BENCH_SKIP_PROBE="1",  # the watcher just probed; don't re-probe
         BENCH_IMPL=impl,
         BENCH_NSETS=str(n_sets),
         LIGHTHOUSE_TPU_CACHE_DIR=cache_dir,

@@ -105,6 +105,49 @@ def test_contribution_verification_and_forgery_rejection():
     assert isinstance(res[0], SyncCommitteeError)
 
 
+def test_multiposition_validator_contribution_signature_multiplicity():
+    """A validator holding SEVERAL positions in one subcommittee (sync
+    committees sample with replacement) must have its signature
+    aggregated once PER SET BIT — verification pairs the pubkey per bit,
+    so a single-copy aggregate would fail BLS verification and the
+    validator would lose sync rewards (reference:
+    add_to_naive_sync_aggregation_pool loops from_message per position)."""
+    from lighthouse_tpu import bls
+    from lighthouse_tpu.beacon_chain.naive_aggregation_pool import (
+        SyncMessageAggregationPool,
+    )
+
+    spec, h, chain, vc, svc = altair_setup()
+    chain.set_slot(0)
+    msgs = svc.produce_messages(0)
+    m0, m1 = msgs[0], msgs[1]
+
+    pool = SyncMessageAggregationPool(spec, chain.t)
+    pool.insert(VerifiedSyncMessage(message=m0, subnet_positions={0: [2, 5]}))
+    contrib = pool.get_contribution(0, bytes(m0.beacon_block_root), 0)
+    sig0 = bls.Signature.from_bytes(bytes(m0.signature))
+    assert bytes(contrib.signature) == bls.aggregate_signatures(
+        [sig0, sig0]
+    ).to_bytes()
+
+    # merge: a second validator with one new position and one overlap-free
+    # double position -> two more copies of ITS signature
+    pool.insert(VerifiedSyncMessage(message=m1, subnet_positions={0: [1, 6]}))
+    contrib = pool.get_contribution(0, bytes(m0.beacon_block_root), 0)
+    sig1 = bls.Signature.from_bytes(bytes(m1.signature))
+    assert bytes(contrib.signature) == bls.aggregate_signatures(
+        [sig0, sig0, sig1, sig1]
+    ).to_bytes()
+    assert list(contrib.aggregation_bits) == [
+        False, True, True, False, False, True, True, False,
+    ]
+
+    # re-inserting the same message adds nothing (all bits already set)
+    pool.insert(VerifiedSyncMessage(message=m1, subnet_positions={0: [1, 6]}))
+    contrib2 = pool.get_contribution(0, bytes(m0.beacon_block_root), 0)
+    assert bytes(contrib2.signature) == bytes(contrib.signature)
+
+
 def test_selection_proof_election_is_deterministic():
     spec, h, chain, vc, svc = altair_setup()
     proof = svc.selection_proof(0, 0, 0)
