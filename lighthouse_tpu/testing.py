@@ -33,6 +33,34 @@ def _pack_g2_affine(pts):
     return (fb.to_mont(xs), fb.to_mont(ys))
 
 
+def pack_sets_from_points(msgs, sigs, pk_rows, rand_scalars):
+    """Pack explicit affine points into the 6-tuple of device inputs for
+    `ops.batch_verify.verify_signature_sets`.
+
+    msgs/sigs: affine G2 points, one per set; pk_rows: per-set lists of
+    affine G1 points (ragged; padded with None to the widest row)."""
+    n_sets = len(msgs)
+    max_keys = max(len(r) for r in pk_rows)
+    padded = [list(r) + [None] * (max_keys - len(r)) for r in pk_rows]
+    mask_rows = [
+        [True] * len(r) + [False] * (max_keys - len(r)) for r in pk_rows
+    ]
+    flat_pks = [p for row in padded for p in row]
+    pk_x, pk_y = _pack_g1_affine(flat_pks)
+    pubkeys = (
+        np.asarray(pk_x).reshape(n_sets, max_keys, 1, fb.NB),
+        np.asarray(pk_y).reshape(n_sets, max_keys, 1, fb.NB),
+    )
+    return (
+        _pack_g2_affine(msgs),
+        _pack_g2_affine(sigs),
+        pubkeys,
+        np.array(mask_rows, dtype=bool),
+        curve.scalars_to_bits(rand_scalars, batch_verify.RAND_BITS),
+        np.ones(n_sets, dtype=bool),
+    )
+
+
 def make_signature_set_batch(
     n_sets: int,
     max_keys: int = 1,

@@ -207,6 +207,110 @@ def test_fake_backend_accepts_everything():
     assert not bls.verify_signature_sets([], backend="fake")
 
 
+# --------------------------------------------------------------- external
+# Anchors whose expected bytes come from PUBLISHED specifications (RFC
+# 9380 appendices K.1/J.10.1, the EIP-2333 test cases, the EIP-2335
+# official scrypt keystore) — NOT from scripts/gen_vectors.py. They break
+# the self-test circularity: a consistent sign+verify bug in the repo's
+# own reference backend cannot re-pin these.
+
+
+def test_external_expand_message_xmd():
+    """RFC 9380 K.1: expand_message_xmd / SHA-256, published uniform_bytes."""
+    from lighthouse_tpu.bls.hash_to_curve import expand_message_xmd
+
+    for name, case in _load("external", "rfc9380_expand_message_xmd"):
+        got = expand_message_xmd(
+            case["msg_ascii"].encode(),
+            case["dst"].encode(),
+            case["len_in_bytes"],
+        )
+        assert got.hex() == case["uniform_bytes"], name
+
+
+def test_external_rfc9380_g2_suite():
+    """RFC 9380 J.10.1: BLS12381G2_XMD:SHA-256_SSWU_RO_ full-pipeline
+    (expand -> hash_to_field -> SSWU -> isogeny -> cofactor) outputs."""
+    for name, case in _load("external", "rfc9380_g2_suite"):
+        pt = hash_to_g2(case["msg_ascii"].encode(), case["dst"].encode())
+        x, y = G2_GROUP.to_affine(pt)
+        P = case["P"]
+        assert x[0] == int(P["x_c0"], 16), name
+        assert x[1] == int(P["x_c1"], 16), name
+        assert y[0] == int(P["y_c0"], 16), name
+        assert y[1] == int(P["y_c1"], 16), name
+
+
+@pytest.mark.parametrize("backend", REAL_BACKENDS)
+def test_external_rfc9380_points_verify_on_backends(backend):
+    """Bridge the RFC-anchored G2 points into BOTH real verify planes:
+    with pk = sk*G1 and sig = sk*P_rfc, the pairing check e(pk, P) ==
+    e(G1, sig) must hold on the ref and tpu backends alike — the anchor
+    point, not a self-generated one, exercises the device path."""
+    from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
+
+    sk = 7919
+    for name, case in _load("external", "rfc9380_g2_suite"):
+        pt = hash_to_g2(case["msg_ascii"].encode(), case["dst"].encode())
+        msg_aff = G2_GROUP.to_affine(pt)
+        sig_aff = G2_GROUP.to_affine(G2_GROUP.mul_scalar(pt, sk))
+        pk_aff = G1_GROUP.to_affine(
+            G1_GROUP.mul_scalar(G1_GROUP.generator, sk)
+        )
+        if backend == "ref":
+            from lighthouse_tpu.crypto import ref_pairing
+
+            # e(pk, P) * e(-G1, sk*P) == 1
+            assert ref_pairing.pairing_check_points(
+                [
+                    G1_GROUP.mul_scalar(G1_GROUP.generator, sk),
+                    G1_GROUP.neg(G1_GROUP.generator),
+                ],
+                [pt, G2_GROUP.mul_scalar(pt, sk)],
+            ), name
+        else:
+            import numpy as np
+
+            from lighthouse_tpu import testing as td
+            from lighthouse_tpu.ops import batch_verify
+
+            args = td.pack_sets_from_points(
+                [msg_aff], [sig_aff], [[pk_aff]], [12345]
+            )
+            assert bool(
+                np.asarray(batch_verify.verify_signature_sets(*args))
+            ), name
+
+
+def test_external_eip2333():
+    """EIP-2333 published seed->master_SK (->child_SK) cases."""
+    from lighthouse_tpu.accounts.key_derivation import (
+        derive_child_sk,
+        derive_master_sk,
+    )
+
+    for name, case in _load("external", "eip2333"):
+        master = derive_master_sk(bytes.fromhex(case["seed"]))
+        assert master == int(case["master_SK"]), name
+        if "child_index" in case:
+            child = derive_child_sk(master, case["child_index"])
+            assert child == int(case["child_SK"]), name
+
+
+def test_external_eip2335_scrypt_keystore():
+    """EIP-2335 official scrypt vector: the published keystore JSON must
+    decrypt to the published secret under the published password (NFKD +
+    control-stripping normalization included), and reject a wrong one."""
+    from lighthouse_tpu.accounts.keystore import Keystore, KeystoreError
+
+    (_, case), = _load("external", "eip2335")
+    password = "".join(chr(c) for c in case["password_codepoints"])
+    ks = Keystore.from_json(json.dumps(case["keystore"]))
+    assert ks.decrypt(password).hex() == case["secret"]
+    with pytest.raises(KeystoreError):
+        ks.decrypt(password + "x")
+
+
 def test_zz_all_vector_files_consumed():
     """check_all_files_accessed.py analog (Makefile:105). Named zz_ so it
     runs after every handler in this module."""
