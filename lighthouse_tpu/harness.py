@@ -10,6 +10,10 @@ pools) builds on this.
 """
 
 from lighthouse_tpu import bls
+from lighthouse_tpu.ssz.cached_hash import (
+    cached_state_root,
+    carry_tree_cache,
+)
 from lighthouse_tpu.ssz.hashing import ZERO_BYTES32
 from lighthouse_tpu.state_processing.helpers import (
     CommitteeCache,
@@ -60,11 +64,27 @@ class Harness:
     def _sign(self, sk, obj_root: bytes, domain: bytes) -> bytes:
         return sk.sign(compute_signing_root(obj_root, domain)).to_bytes()
 
+    def randao_reveal(self, slot: int) -> bytes:
+        """The proposer's RANDAO reveal for `slot` on the current state —
+        for tests that drive a chain's production path directly."""
+        spec = self.spec
+        state = self.state.copy()
+        if state.slot < slot:
+            state = process_slots(state, slot, spec)
+        proposer = get_beacon_proposer_index(state, spec)
+        epoch = get_current_epoch(state, spec)
+        domain = get_domain(state, spec.DOMAIN_RANDAO, epoch, spec)
+        return self._sign(
+            self.keypairs[proposer].sk,
+            ssz.uint64.hash_tree_root(epoch),
+            domain,
+        )
+
     def head_block_root(self, state) -> bytes:
         header = state.latest_block_header
         if bytes(header.state_root) == ZERO_BYTES32:
             header = header.copy()
-            header.state_root = type(state).hash_tree_root(state)
+            header.state_root = cached_state_root(state)
         return type(header).hash_tree_root(header)
 
     # ----------------------------------------------------- attestations
@@ -151,6 +171,7 @@ class Harness:
         spec = self.spec
         t = self.t
         state = self.state.copy()
+        carry_tree_cache(state, self.state)
         state = process_slots(state, slot, spec)
         fork_name = spec.fork_name_at_epoch(get_current_epoch(state, spec))
 
@@ -203,6 +224,7 @@ class Harness:
 
         # compute post-state root with signatures skipped
         trial = state.copy()
+        carry_tree_cache(trial, state)
         signed_cls = t.signed_block_classes[fork_name]
         trial_signed = signed_cls(message=block, signature=b"\x00" * 96)
         per_block_processing(
@@ -212,7 +234,7 @@ class Harness:
             BlockSignatureStrategy.NO_VERIFICATION,
             self.pubkey_cache,
         )
-        block.state_root = type(trial).hash_tree_root(trial)
+        block.state_root = cached_state_root(trial)
 
         proposal_domain = get_domain(
             state,
@@ -232,6 +254,7 @@ class Harness:
     def import_block(self, signed_block, strategy=None):
         spec = self.spec
         state = self.state.copy()
+        carry_tree_cache(state, self.state)
         state = process_slots(state, signed_block.message.slot, spec)
         per_block_processing(
             state,
@@ -245,7 +268,7 @@ class Harness:
             seed=int(signed_block.message.slot) + 1,
         )
         # verify the block's claimed post-state root
-        post_root = type(state).hash_tree_root(state)
+        post_root = cached_state_root(state)
         assert bytes(signed_block.message.state_root) == post_root, (
             "state root mismatch"
         )

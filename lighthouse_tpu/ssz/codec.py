@@ -469,6 +469,14 @@ class Container(SSZType, metaclass=_ContainerMeta):
         if kwargs:
             raise TypeError(f"unknown fields: {sorted(kwargs)}")
 
+    def __setattr__(self, name, value):
+        """Every direct field write bumps a mutation counter — the hook
+        the incremental tree-hash cache (ssz/cached_hash.py) uses to
+        detect changed elements without shadow-comparing values."""
+        d = self.__dict__
+        d[name] = value
+        d["_muts"] = d.get("_muts", 0) + 1
+
     # --- descriptor protocol (class-level) ---
 
     @classmethod

@@ -12,7 +12,13 @@ from lighthouse_tpu.types.spec import Spec
 
 
 def state_root(state) -> bytes:
-    return type(state).hash_tree_root(state)
+    """Incremental state root (ssz/cached_hash.py) — the per-slot root in
+    process_slot is the hottest hash site in the client; the cache makes
+    it O(changes · log n) instead of a full-state rehash
+    (consensus/cached_tree_hash/src/cache.rs role)."""
+    from lighthouse_tpu.ssz.cached_hash import cached_state_root
+
+    return cached_state_root(state)
 
 
 def process_slot(state, spec: Spec):

@@ -1,0 +1,205 @@
+"""Incremental tree hashing (ssz/cached_hash.py) — correctness against
+the full recompute and the O(changes · log n) hash-work bound.
+
+Role of the reference's cached_tree_hash tests
+(consensus/cached_tree_hash/src/impls.rs tests + beacon_state tree-hash
+cache tests): every mutation class the state transition performs must be
+caught by the cache's dirty detection, and hash work must scale with the
+number of changes, not the state size.
+"""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.harness import Harness
+from lighthouse_tpu.ssz import cached_hash
+from lighthouse_tpu.ssz.cached_hash import (
+    CachedChunkTree,
+    cached_state_root,
+    carry_tree_cache,
+)
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+def altair_state(n=32):
+    spec = minimal_spec(ALTAIR_FORK_EPOCH=0)
+    return Harness(spec, n).state, spec
+
+
+def assert_matches_full(state):
+    assert cached_state_root(state) == type(state).hash_tree_root(state)
+
+
+def test_chunk_tree_matches_merkleize():
+    from lighthouse_tpu.ssz.merkle import merkleize_chunks
+
+    rnd = random.Random(1)
+    for count, limit in [(0, 8), (1, 8), (5, 8), (8, 8), (3, 1024)]:
+        chunks = [rnd.randbytes(32) for _ in range(count)]
+        tree = CachedChunkTree(list(chunks), limit)
+        assert tree.root() == merkleize_chunks(chunks, limit=limit)
+        # point update
+        if count:
+            chunks[count // 2] = rnd.randbytes(32)
+            tree.set_leaves({count // 2: chunks[count // 2]})
+            assert tree.root() == merkleize_chunks(chunks, limit=limit)
+        # append
+        if count < limit:
+            chunks.append(rnd.randbytes(32))
+            tree.set_leaves({count: chunks[-1]})
+            assert tree.root() == merkleize_chunks(chunks, limit=limit)
+
+
+def test_every_mutation_class_detected():
+    """One of each kind of write the state transition performs."""
+    state, spec = altair_state()
+    assert_matches_full(state)
+
+    # packed uint leaves
+    state.balances[3] += 17
+    assert_matches_full(state)
+    state.current_epoch_participation[2] = 7
+    assert_matches_full(state)
+    state.inactivity_scores[1] = 4
+    assert_matches_full(state)
+    # flat-container list element mutation
+    state.validators[5].slashed = True
+    state.validators[5].withdrawable_epoch = 8192
+    assert_matches_full(state)
+    # registry growth (deposit)
+    v = state.validators[0].copy()
+    v.pubkey = b"\x11" * 48
+    state.validators.append(v)
+    state.balances.append(32_000_000_000)
+    state.current_epoch_participation.append(0)
+    state.previous_epoch_participation.append(0)
+    state.inactivity_scores.append(0)
+    assert_matches_full(state)
+    # bytes32 vectors
+    state.randao_mixes[0] = b"\x42" * 32
+    state.block_roots[7] = b"\x43" * 32
+    state.state_roots[7] = b"\x44" * 32
+    assert_matches_full(state)
+    # bytes32 list append
+    state.historical_roots.append(b"\x45" * 32)
+    assert_matches_full(state)
+    # memo fields: in-place header write + wholesale committee swap
+    state.latest_block_header.state_root = b"\x46" * 32
+    assert_matches_full(state)
+    state.current_sync_committee = state.next_sync_committee.copy()
+    assert_matches_full(state)
+    # list shrink (epoch rotation resets vote lists)
+    state.eth1_data_votes.append(state.eth1_data.copy())
+    assert_matches_full(state)
+    state.eth1_data_votes = []
+    assert_matches_full(state)
+    # participation rotation: previous <- current, current <- zeros
+    state.previous_epoch_participation = list(
+        state.current_epoch_participation
+    )
+    state.current_epoch_participation = [0] * len(state.validators)
+    assert_matches_full(state)
+    # small scalar / checkpoint fields (recompute strategies)
+    state.slot += 1
+    state.finalized_checkpoint.epoch = 3
+    state.justification_bits[0] = True
+    assert_matches_full(state)
+
+
+def test_hash_work_proportional_to_changes(monkeypatch):
+    """Mutating k of n validators must cost O(k · log n) pair-hashes, not
+    a full-registry rehash (cache.rs's whole reason to exist)."""
+    from lighthouse_tpu import native
+    from lighthouse_tpu.ssz import hashing
+
+    state, spec = altair_state(n=256)
+    cached_state_root(state)  # build
+
+    counter = {"pairs": 0}
+    real_hash_pairs = native.hash_pairs
+    real_hash_concat = hashing.hash_concat
+
+    def counting_pairs(data):
+        counter["pairs"] += len(data) // 64
+        return real_hash_pairs(data)
+
+    def counting_concat(a, b):
+        counter["pairs"] += 1
+        return real_hash_concat(a, b)
+
+    monkeypatch.setattr(native, "hash_pairs", counting_pairs)
+    monkeypatch.setattr(cached_hash, "hash_concat", counting_concat)
+    monkeypatch.setattr(
+        cached_hash,
+        "hash32_many",
+        lambda pairs: [counting_concat(p[:32], p[32:]) for p in pairs],
+    )
+
+    # no-change root: bounded overhead (field roots + mix-ins only)
+    counter["pairs"] = 0
+    cached_state_root(state)
+    noop_cost = counter["pairs"]
+    assert noop_cost < 200, noop_cost
+
+    # k validator+balance mutations
+    k = 8
+    for i in random.Random(7).sample(range(256), k):
+        state.validators[i].effective_balance += 1
+        state.balances[i] += 1
+    counter["pairs"] = 0
+    cached_state_root(state)
+    k_cost = counter["pairs"] - noop_cost
+    # per changed validator: ~8 hashes for the element root + a
+    # depth-(~40) path in the registry tree + the balances chunk path
+    assert k_cost < k * 120, k_cost
+
+    # and a full rebuild costs vastly more than the k-update
+    counter["pairs"] = 0
+    fresh = cached_hash.StateTreeCache(type(state))
+    fresh.root(state)
+    rebuild_cost = counter["pairs"]
+    assert rebuild_cost > 10 * (k_cost + noop_cost), (
+        rebuild_cost,
+        k_cost,
+        noop_cost,
+    )
+
+
+def test_carry_across_copy_does_no_element_rehash(monkeypatch):
+    state, spec = altair_state(n=128)
+    cached_state_root(state)
+
+    calls = {"elem": 0}
+    real = type(state.validators[0]).hash_tree_root
+
+    def counting(v=None):
+        calls["elem"] += 1
+        return real(v)
+
+    child = state.copy()
+    carry_tree_cache(child, state)
+    expected = type(child).hash_tree_root(child)
+    monkeypatch.setattr(
+        type(state.validators[0]), "hash_tree_root", counting
+    )
+    assert cached_state_root(child) == expected
+    assert calls["elem"] == 0, "carried cache re-hashed validators"
+
+    # and the two caches are independent
+    child.balances[0] += 1
+    assert cached_state_root(child) == type(child).hash_tree_root(child)
+    assert cached_state_root(state) == type(state).hash_tree_root(state)
+
+
+@pytest.mark.slow
+def test_harness_finality_with_verified_cached_roots(monkeypatch):
+    """End-to-end: the harness runs a chain to finality with EVERY cached
+    root cross-checked against the full recompute (epoch transitions,
+    fork-version state, registry writes — everything the transition
+    does)."""
+    monkeypatch.setattr(cached_hash, "_VERIFY", True)
+    spec = minimal_spec(ALTAIR_FORK_EPOCH=0)
+    h = Harness(spec, 16)
+    h.run_slots(4 * spec.SLOTS_PER_EPOCH)
+    assert h.finalized_epoch > 0
