@@ -30,6 +30,10 @@ from lighthouse_tpu.beacon_chain.observed import (
 )
 from lighthouse_tpu.beacon_chain.operation_pool import OperationPool
 from lighthouse_tpu.fork_choice import ForkChoice
+from lighthouse_tpu.ssz.cached_hash import (
+    cached_state_root,
+    carry_tree_cache,
+)
 from lighthouse_tpu.ssz.hashing import ZERO_BYTES32
 from lighthouse_tpu.state_processing.helpers import (
     CommitteeCache,
@@ -138,6 +142,28 @@ class BeaconChain:
         ]
         self.metrics = {"blocks_imported": 0, "attestations_processed": 0}
 
+        # attestation-production caches (attester_cache.rs,
+        # early_attester_cache.rs, beacon_proposer_cache.rs)
+        from lighthouse_tpu.beacon_chain.attester_cache import (
+            AttesterCache,
+            BeaconProposerCache,
+            EarlyAttesterCache,
+        )
+
+        self.attester_cache = AttesterCache()
+        self.early_attester_cache = EarlyAttesterCache()
+        self.proposer_cache = BeaconProposerCache()
+
+        # builder/blinded flow (execution_layer/src/lib.rs builder path):
+        # an optional BuilderHttpClient, plus a cache of locally-built
+        # payloads keyed by block_hash so a blinded block produced from
+        # the LOCAL fallback payload can be unblinded without the builder
+        # (the reference's payload cache).
+        self.builder = None
+        self._local_payloads: dict[bytes, object] = {}
+        self._local_payload_order: list[bytes] = []
+        self.validator_registrations: dict[bytes, object] = {}
+
         from lighthouse_tpu.beacon_chain.events import EventBus
         from lighthouse_tpu.beacon_chain.validator_monitor import (
             ValidatorMonitor,
@@ -179,11 +205,19 @@ class BeaconChain:
 
     # ------------------------------------------------------------ helpers
 
+    @staticmethod
+    def _copy_state(state):
+        """state.copy() with the incremental tree-hash cache carried, so
+        the copy's first root costs O(changes) instead of a full rehash."""
+        out = state.copy()
+        carry_tree_cache(out, state)
+        return out
+
     def _header_root(self, state) -> bytes:
         header = state.latest_block_header
         if bytes(header.state_root) == ZERO_BYTES32:
             header = header.copy()
-            header.state_root = type(state).hash_tree_root(state)
+            header.state_root = cached_state_root(state)
         return type(header).hash_tree_root(header)
 
     def current_slot(self) -> int:
@@ -193,6 +227,7 @@ class BeaconChain:
 
     def set_slot(self, slot: int):
         self.fork_choice.set_slot(slot)
+        self.attester_cache.prune(self.finalized_checkpoint.epoch)
         self.naive_pool.prune(slot)
         self.observed_aggregates.prune(slot)
         self.sync_message_pool.prune(slot)
@@ -223,7 +258,9 @@ class BeaconChain:
         state = self.head_state
         target_slot = self.spec.epoch_start_slot(epoch)
         if state.slot < target_slot:
-            state = process_slots(state.copy(), target_slot, self.spec)
+            state = process_slots(
+                self._copy_state(state), target_slot, self.spec
+            )
         return state
 
     # ----------------------------------------------------- block pipeline
@@ -258,7 +295,7 @@ class BeaconChain:
             if parent_state is None:
                 raise BlockError("parent state unavailable")
 
-        state = parent_state.copy()
+        state = self._copy_state(parent_state)
         t0 = time.perf_counter()
         state = process_slots(state, block.slot, spec)
         engine = _EngineAdapter(self.execution_layer)
@@ -274,11 +311,18 @@ class BeaconChain:
             )
         except BlockProcessingError as e:
             raise BlockError(str(e)) from e
-        post_root = type(state).hash_tree_root(state)
+        post_root = cached_state_root(state)
         if bytes(block.state_root) != post_root:
             raise BlockError("state root mismatch")
         self.metrics["block_processing_seconds"] = (
             time.perf_counter() - t0
+        )
+
+        # make the block attestable BEFORE the store/head work — the
+        # 1/3-slot attestation deadline must not wait for it
+        # (early_attester_cache.rs add_head_block)
+        self.early_attester_cache.add_head_block(
+            block_root, signed_block, state, spec
         )
 
         # store + fork choice
@@ -428,7 +472,9 @@ class BeaconChain:
         parent_state = self._snapshots.get(parent_root)
         if parent_state is None:
             raise BlockError("unknown parent")
-        state = process_slots(parent_state.copy(), block.slot, spec)
+        state = process_slots(
+            self._copy_state(parent_state), block.slot, spec
+        )
         engine = _EngineAdapter(self.execution_layer)
         per_block_processing(
             state,
@@ -438,7 +484,7 @@ class BeaconChain:
             self.pubkey_cache,
             execution_engine=engine,
         )
-        if bytes(block.state_root) != type(state).hash_tree_root(state):
+        if bytes(block.state_root) != cached_state_root(state):
             raise BlockError("state root mismatch")
         self.store.put_block(block_root, signed_block)
         self.store.put_hot_state(state)
@@ -632,18 +678,33 @@ class BeaconChain:
 
     # ---------------------------------------------------------- production
 
-    def produce_attestation_data(self, slot: int, committee_index: int):
-        """AttestationData for (slot, committee) on the canonical head —
-        the BN half of the VC attestation flow (served over GET
-        /eth/v1/validator/attestation_data; the reference answers this
-        from attester/early-attester caches)."""
+    def _attestation_parts_from_state(self, epoch: int):
+        """(justified, committees_per_slot, target_root) for the head —
+        reuses the just-imported block's early-attester item when it
+        matches (block import already paid the O(V) active scan there);
+        otherwise reads the head state. Either way primes the attester
+        cache."""
         from lighthouse_tpu.state_processing.helpers import (
+            get_active_validator_indices,
             get_block_root_at_slot,
+            get_committee_count_per_slot,
         )
 
         spec = self.spec
+        early = self.early_attester_cache._item
+        if (
+            early is not None
+            and early.epoch == epoch
+            and early.beacon_block_root == self.head_root
+        ):
+            justified = early.source.copy()
+            cps = early.committees_per_slot
+            target_root = early.target[1]
+            self.attester_cache.prime(
+                epoch, self.head_root, justified, cps, target_root
+            )
+            return justified, cps, target_root
         state = self.head_state
-        epoch = spec.slot_to_epoch(slot)
         start_slot = spec.epoch_start_slot(epoch)
         if state.slot > start_slot:
             target_root = bytes(
@@ -651,13 +712,139 @@ class BeaconChain:
             )
         else:
             target_root = self.head_root
+        justified = state.current_justified_checkpoint.copy()
+        cps = get_committee_count_per_slot(
+            len(get_active_validator_indices(state, epoch)), spec
+        )
+        self.attester_cache.prime(
+            epoch, self.head_root, justified, cps, target_root
+        )
+        return justified, cps, target_root
+
+    def produce_attestation_data(self, slot: int, committee_index: int):
+        """AttestationData for (slot, committee) on the canonical head,
+        served WITHOUT touching the head state on the hot path: the
+        early-attester cache answers for a just-imported block, the
+        attester cache answers per (epoch, head root); only a cache miss
+        reads the state (and re-primes). Matches attester_cache.rs +
+        early_attester_cache.rs."""
+        spec = self.spec
+        epoch = spec.slot_to_epoch(slot)
+
+        early = self.early_attester_cache.try_attest(slot, spec)
+        if early is not None and early.beacon_block_root == self.head_root:
+            if committee_index >= early.committees_per_slot:
+                raise attn.AttestationError(
+                    "committee index out of range"
+                )
+            t_epoch, t_root = early.target
+            return self.t.AttestationData(
+                slot=slot,
+                index=committee_index,
+                beacon_block_root=early.beacon_block_root,
+                source=early.source,
+                target=self.t.Checkpoint(epoch=t_epoch, root=t_root),
+            )
+
+        cached = self.attester_cache.get(epoch, self.head_root)
+        if cached is not None:
+            justified, cps, target_root = (
+                cached.justified_checkpoint,
+                cached.committees_per_slot,
+                cached.target_root,
+            )
+        else:
+            justified, cps, target_root = (
+                self._attestation_parts_from_state(epoch)
+            )
+        if committee_index >= cps:
+            raise attn.AttestationError("committee index out of range")
         return self.t.AttestationData(
             slot=slot,
             index=committee_index,
             beacon_block_root=self.head_root,
-            source=state.current_justified_checkpoint,
+            source=justified,
             target=self.t.Checkpoint(epoch=epoch, root=target_root),
         )
+
+    def proposers_for_epoch(self, epoch: int):
+        """Proposer index per slot of `epoch`, via the LRU proposer cache
+        (beacon_proposer_cache.rs): keyed by (epoch, decision root); a
+        miss computes the whole epoch from one state — never a per-slot
+        state advance."""
+        from lighthouse_tpu.beacon_chain.attester_cache import (
+            compute_epoch_proposers,
+        )
+
+        spec = self.spec
+        end_prev = spec.epoch_start_slot(epoch) - 1
+        decision_root = None
+        if end_prev >= 0:
+            decision_root = self.store.get_canonical_block_root(end_prev)
+        if decision_root is None:
+            decision_root = self.head_root
+        cached = self.proposer_cache.get_epoch(epoch, decision_root)
+        if cached is not None:
+            return cached
+        state = self.state_for_epoch(epoch)
+        proposers = compute_epoch_proposers(state, epoch, spec)
+        self.proposer_cache.insert(epoch, decision_root, proposers)
+        return proposers
+
+    def _open_production(self, slot: int):
+        """Advance a cache-carried head-state copy to `slot` and resolve
+        fork/proposer — shared by full and blinded production."""
+        from lighthouse_tpu.state_processing.helpers import (
+            get_beacon_proposer_index,
+        )
+
+        spec = self.spec
+        state = self._copy_state(self.head_state)
+        if state.slot > slot:
+            raise ValueError(f"head already past slot {slot}")
+        state = process_slots(state, slot, spec)
+        fork_name = spec.fork_name_at_epoch(get_current_epoch(state, spec))
+        proposer = get_beacon_proposer_index(state, spec)
+        return state, fork_name, proposer
+
+    def _packed_body_fields(
+        self, state, slot, fork_name, randao_reveal, graffiti
+    ) -> dict:
+        """Operation-pool packing shared by full and blinded bodies."""
+        spec = self.spec
+        attestations = self.op_pool.get_attestations(
+            state, spec.MAX_ATTESTATIONS
+        )
+        proposer_slashings, attester_slashings, exits = (
+            self.op_pool.get_slashings_and_exits(state)
+        )
+        fields = dict(
+            randao_reveal=bytes(randao_reveal),
+            eth1_data=state.eth1_data,
+            graffiti=bytes(graffiti),
+            attestations=attestations,
+            deposits=[],
+            voluntary_exits=exits,
+            proposer_slashings=proposer_slashings,
+            attester_slashings=attester_slashings,
+        )
+        if fork_name != "phase0":
+            fields["sync_aggregate"] = self.produce_sync_aggregate(slot)
+        return fields
+
+    def _seal_block(self, state, block, signed_cls):
+        """Trial-run the block (signatures skipped) on a cache-carried
+        copy and stamp its post-state root."""
+        trial = self._copy_state(state)
+        per_block_processing(
+            trial,
+            signed_cls(message=block, signature=b"\x00" * 96),
+            self.spec,
+            BlockSignatureStrategy.NO_VERIFICATION,
+            self.pubkey_cache,
+        )
+        block.state_root = cached_state_root(trial)
+        return block
 
     def produce_block_unsigned(
         self, slot: int, randao_reveal: bytes, graffiti: bytes = b"\x00" * 32
@@ -669,62 +856,167 @@ class BeaconChain:
         operation pool by greedy max-cover, slashings/exits from the pool,
         the sync aggregate from pooled contributions, and the post-state
         root computed with signatures skipped."""
-        from lighthouse_tpu.state_processing.helpers import (
-            get_beacon_proposer_index,
+        state, fork_name, proposer = self._open_production(slot)
+        body = self.t.block_body_classes[fork_name](
+            **self._packed_body_fields(
+                state, slot, fork_name, randao_reveal, graffiti
+            )
         )
-
-        spec = self.spec
-        state = self.head_state.copy()
-        if state.slot > slot:
-            raise ValueError(f"head already past slot {slot}")
-        state = process_slots(state, slot, spec)
-        fork_name = spec.fork_name_at_epoch(get_current_epoch(state, spec))
-        proposer = get_beacon_proposer_index(state, spec)
-
-        attestations = self.op_pool.get_attestations(
-            state, spec.MAX_ATTESTATIONS
-        )
-        slashings_exits = self.op_pool.get_slashings_and_exits(state)
-        proposer_slashings, attester_slashings, exits = slashings_exits
-
-        body_cls = self.t.block_body_classes[fork_name]
-        body = body_cls(
-            randao_reveal=bytes(randao_reveal),
-            eth1_data=state.eth1_data,
-            graffiti=bytes(graffiti),
-            attestations=attestations,
-            deposits=[],
-            voluntary_exits=exits,
-            proposer_slashings=proposer_slashings,
-            attester_slashings=attester_slashings,
-        )
-        parent_root = self.head_root
-        if fork_name != "phase0":
-            body.sync_aggregate = self.produce_sync_aggregate(slot)
         if fork_name == "bellatrix":
             builder = getattr(self, "payload_builder", None)
             if builder is not None:
                 body.execution_payload = builder(state)
-
-        block_cls = self.t.block_classes[fork_name]
-        block = block_cls(
+        block = self.t.block_classes[fork_name](
             slot=slot,
             proposer_index=proposer,
-            parent_root=parent_root,
+            parent_root=self.head_root,
             state_root=ZERO_BYTES32,
             body=body,
         )
-        trial = state.copy()
-        signed_cls = self.t.signed_block_classes[fork_name]
-        per_block_processing(
-            trial,
-            signed_cls(message=block, signature=b"\x00" * 96),
-            spec,
-            BlockSignatureStrategy.NO_VERIFICATION,
-            self.pubkey_cache,
+        return self._seal_block(
+            state, block, self.t.signed_block_classes[fork_name]
         )
-        block.state_root = type(trial).hash_tree_root(trial)
-        return block
+
+    # ------------------------------------------------- builder / blinded
+
+    def _cache_local_payload(self, payload) -> None:
+        h = bytes(payload.block_hash)
+        if h not in self._local_payloads:
+            self._local_payload_order.append(h)
+            if len(self._local_payload_order) > 8:
+                old = self._local_payload_order.pop(0)
+                self._local_payloads.pop(old, None)
+        self._local_payloads[h] = payload
+
+    def produce_blinded_block_unsigned(
+        self, slot: int, randao_reveal: bytes, graffiti: bytes = b"\x00" * 32
+    ):
+        """Blinded block for the builder flow (GET
+        /eth/v1/validator/blinded_blocks/{slot};
+        beacon_chain.rs produce_block with BlindedPayload +
+        execution_layer's builder bid path): take the builder's header bid
+        when a builder is configured, healthy, and its bid is valid —
+        otherwise fall back to the LOCAL payload, cache it, and serve its
+        header so unblinding needs no builder."""
+        from lighthouse_tpu.execution_layer.builder_client import (
+            BuilderError,
+            verify_bid_signature,
+        )
+        from lighthouse_tpu.state_processing.helpers import (
+            get_beacon_proposer_index,
+        )
+        from lighthouse_tpu.state_processing.per_block import (
+            execution_payload_to_header,
+        )
+
+        spec = self.spec
+        state, fork_name, proposer = self._open_production(slot)
+        if fork_name not in self.t.blinded_block_classes:
+            raise BlockError("no blinded block shape before bellatrix")
+
+        header = None
+        if self.builder is not None:
+            parent_hash = bytes(
+                state.latest_execution_payload_header.block_hash
+            )
+            pubkey = bytes(state.validators[proposer].pubkey)
+            try:
+                bid = self.builder.get_header(slot, parent_hash, pubkey)
+                if not verify_bid_signature(bid, spec):
+                    raise BuilderError("bad bid signature")
+                if bytes(bid.message.header.parent_hash) != parent_hash:
+                    raise BuilderError("bid parent_hash mismatch")
+                header = bid.message.header
+            except BuilderError as e:
+                self.metrics["builder_faults"] = (
+                    self.metrics.get("builder_faults", 0) + 1
+                )
+                header = None  # fall back to the local payload
+        if header is None:
+            builder_fn = getattr(self, "payload_builder", None)
+            if builder_fn is None:
+                raise BlockError("no builder and no local payload source")
+            payload = builder_fn(state)
+            self._cache_local_payload(payload)
+            header = execution_payload_to_header(payload, self.t, spec)
+
+        body = self.t.blinded_body_classes[fork_name](
+            execution_payload_header=header,
+            **self._packed_body_fields(
+                state, slot, fork_name, randao_reveal, graffiti
+            ),
+        )
+        block = self.t.blinded_block_classes[fork_name](
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=self.head_root,
+            state_root=ZERO_BYTES32,
+            body=body,
+        )
+        return self._seal_block(
+            state, block, self.t.signed_blinded_block_classes[fork_name]
+        )
+
+    def import_blinded_block(self, signed_blinded):
+        """Unblind and import (POST /eth/v1/beacon/blinded_blocks):
+        recover the full payload — locally-built payloads from the cache,
+        builder payloads via POST /eth/v1/builder/blinded_blocks — check
+        it against the committed header, substitute, and run the normal
+        import pipeline. The proposer's signature carries over because a
+        blinded block's hash_tree_root equals the full block's."""
+        from lighthouse_tpu.execution_layer.builder_client import (
+            BuilderError,
+        )
+        from lighthouse_tpu.state_processing.per_block import (
+            execution_payload_to_header,
+        )
+
+        blinded = signed_blinded.message
+        header = blinded.body.execution_payload_header
+        block_hash = bytes(header.block_hash)
+
+        payload = self._local_payloads.get(block_hash)
+        if payload is None:
+            if self.builder is None:
+                raise BlockError("unknown payload and no builder")
+            try:
+                payload = self.builder.submit_blinded_block(signed_blinded)
+            except BuilderError as e:
+                raise BlockError(f"builder failed to reveal: {e}") from e
+        got = execution_payload_to_header(payload, self.t, self.spec)
+        if type(got).hash_tree_root(got) != type(header).hash_tree_root(
+            header
+        ):
+            raise BlockError("revealed payload does not match header")
+
+        fork_name = self.spec.fork_name_at_epoch(
+            self.spec.slot_to_epoch(blinded.slot)
+        )
+        bb = blinded.body
+        full_body = self.t.block_body_classes[fork_name](
+            randao_reveal=bytes(bb.randao_reveal),
+            eth1_data=bb.eth1_data,
+            graffiti=bytes(bb.graffiti),
+            attestations=list(bb.attestations),
+            deposits=list(bb.deposits),
+            voluntary_exits=list(bb.voluntary_exits),
+            proposer_slashings=list(bb.proposer_slashings),
+            attester_slashings=list(bb.attester_slashings),
+            sync_aggregate=bb.sync_aggregate,
+            execution_payload=payload,
+        )
+        full_block = self.t.block_classes[fork_name](
+            slot=blinded.slot,
+            proposer_index=blinded.proposer_index,
+            parent_root=bytes(blinded.parent_root),
+            state_root=bytes(blinded.state_root),
+            body=full_body,
+        )
+        signed_full = self.t.signed_block_classes[fork_name](
+            message=full_block,
+            signature=bytes(signed_blinded.signature),
+        )
+        return self.process_block(signed_full)
 
     # --------------------------------------------------------------- head
 
@@ -753,6 +1045,12 @@ class BeaconChain:
                     st = self.store.state_at_slot(blk.message.slot)
                     if st is not None:
                         self.head_state = st
+            # prime the attester cache for the new head so the 1/3-slot
+            # attestation_data path never reads the state
+            # (attester_cache.rs is primed at head recompute)
+            self._attestation_parts_from_state(
+                self.spec.slot_to_epoch(self.head_state.slot)
+            )
         return self.head_root
 
     @property

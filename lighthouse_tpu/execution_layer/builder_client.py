@@ -1,0 +1,131 @@
+"""External block-builder (MEV relay) HTTP client.
+
+Role of /root/reference/beacon_node/builder_client/src/lib.rs:1-192: a
+thin typed client for the builder API —
+
+  GET  /eth/v1/builder/status
+  POST /eth/v1/builder/validators          (signed registrations)
+  GET  /eth/v1/builder/header/{slot}/{parent_hash}/{pubkey}  -> signed bid
+  POST /eth/v1/builder/blinded_blocks      -> full ExecutionPayload
+
+The get_header timeout defaults to 500 ms like the reference
+(DEFAULT_GET_HEADER_TIMEOUT_MILLIS): a slow relay must not eat the
+proposal deadline — callers fall back to the local payload on any
+BuilderError.
+"""
+
+import json
+import urllib.request
+from urllib.error import HTTPError, URLError
+
+from lighthouse_tpu.http_api.json_codec import from_json, to_json
+from lighthouse_tpu.types.helpers import compute_domain, compute_signing_root
+
+DEFAULT_GET_HEADER_TIMEOUT = 0.5  # seconds (builder_client/src/lib.rs:15)
+
+
+class BuilderError(Exception):
+    pass
+
+
+def builder_domain(spec) -> bytes:
+    """compute_builder_domain: DOMAIN_APPLICATION_BUILDER over the genesis
+    fork version with a zero genesis_validators_root."""
+    return compute_domain(
+        spec.DOMAIN_APPLICATION_BUILDER,
+        spec.GENESIS_FORK_VERSION,
+        b"\x00" * 32,
+    )
+
+
+def verify_bid_signature(signed_bid, spec) -> bool:
+    from lighthouse_tpu import bls
+
+    bid = signed_bid.message
+    root = compute_signing_root(
+        type(bid).hash_tree_root(bid), builder_domain(spec)
+    )
+    try:
+        pk = bls.PublicKey.from_bytes(bytes(bid.pubkey))
+        sig = bls.Signature.from_bytes(bytes(signed_bid.signature))
+    except ValueError:
+        return False
+    return bls.verify(pk, root, sig)
+
+
+class BuilderHttpClient:
+    def __init__(
+        self,
+        base_url: str,
+        types,
+        timeout: float = 10.0,
+        get_header_timeout: float = DEFAULT_GET_HEADER_TIMEOUT,
+    ):
+        self.base = base_url.rstrip("/")
+        self.t = types
+        self.timeout = timeout
+        self.get_header_timeout = get_header_timeout
+
+    def _get(self, path: str, timeout: float):
+        try:
+            with urllib.request.urlopen(
+                self.base + path, timeout=timeout
+            ) as r:
+                body = r.read()
+                return json.loads(body) if body else None
+        except (HTTPError, URLError, TimeoutError, OSError) as e:
+            raise BuilderError(f"GET {path}: {e}") from e
+
+    def _post(self, path: str, payload, timeout: float):
+        req = urllib.request.Request(
+            self.base + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                body = r.read()
+                return json.loads(body) if body else None
+        except (HTTPError, URLError, TimeoutError, OSError) as e:
+            raise BuilderError(f"POST {path}: {e}") from e
+
+    # ------------------------------------------------------------- routes
+
+    def status(self) -> None:
+        """GET /eth/v1/builder/status — raises BuilderError when down."""
+        self._get("/eth/v1/builder/status", self.timeout)
+
+    def register_validators(self, signed_registrations) -> None:
+        """POST /eth/v1/builder/validators."""
+        self._post(
+            "/eth/v1/builder/validators",
+            [
+                to_json(type(r), r)
+                for r in signed_registrations
+            ],
+            self.timeout,
+        )
+
+    def get_header(self, slot: int, parent_hash: bytes, pubkey: bytes):
+        """GET /eth/v1/builder/header/... -> SignedBuilderBid (with the
+        reference's tight 500 ms deadline)."""
+        doc = self._get(
+            f"/eth/v1/builder/header/{slot}/0x{bytes(parent_hash).hex()}"
+            f"/0x{bytes(pubkey).hex()}",
+            self.get_header_timeout,
+        )
+        if doc is None or "data" not in doc:
+            raise BuilderError("builder returned no bid")
+        return from_json(self.t.SignedBuilderBid, doc["data"])
+
+    def submit_blinded_block(self, signed_blinded_block):
+        """POST /eth/v1/builder/blinded_blocks -> ExecutionPayload."""
+        doc = self._post(
+            "/eth/v1/builder/blinded_blocks",
+            to_json(type(signed_blinded_block), signed_blinded_block),
+            self.timeout,
+        )
+        if doc is None or "data" not in doc:
+            raise BuilderError("builder returned no payload")
+        return from_json(self.t.ExecutionPayload, doc["data"])

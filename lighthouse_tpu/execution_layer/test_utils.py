@@ -125,6 +125,157 @@ class ExecutionBlockGenerator:
         return self.pending_payloads.pop(payload_id, None)
 
 
+class MockBuilder:
+    """In-process builder-API HTTP server (test_utils/mock_builder.rs):
+    serves signed header bids built from a caller-supplied payload source,
+    reveals the payload on POST blinded_blocks, records validator
+    registrations, and has fault knobs for the VC-fallback tests."""
+
+    def __init__(self, spec, types, payload_source):
+        """`payload_source(slot, parent_hash) -> ExecutionPayload`."""
+        from lighthouse_tpu import bls
+        from lighthouse_tpu.execution_layer.builder_client import (
+            builder_domain,
+        )
+        from lighthouse_tpu.http_api.json_codec import from_json, to_json
+        from lighthouse_tpu.state_processing.per_block import (
+            execution_payload_to_header,
+        )
+        from lighthouse_tpu.types.helpers import compute_signing_root
+
+        self.spec = spec
+        self.t = types
+        self.payload_source = payload_source
+        self.keypair = bls.Keypair(
+            bls.SecretKey.from_bytes((424242).to_bytes(32, "big"))
+        )
+        self.registrations = []
+        self.payloads = {}  # block_hash -> ExecutionPayload
+        # fault knobs
+        self.down = False
+        self.refuse_reveal = False
+        self.bid_value_wei = 10**18
+
+        builder = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code, doc=None):
+                data = json.dumps(doc).encode() if doc is not None else b""
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if builder.down:
+                    self._reply(500, {"message": "builder down"})
+                    return
+                parts = self.path.strip("/").split("/")
+                if parts[:3] == ["eth", "v1", "builder"]:
+                    if parts[3:] == ["status"]:
+                        self._reply(200, {})
+                        return
+                    if len(parts) == 7 and parts[3] == "header":
+                        slot = int(parts[4])
+                        parent_hash = bytes.fromhex(parts[5][2:])
+                        payload = builder.payload_source(slot, parent_hash)
+                        builder.payloads[bytes(payload.block_hash)] = payload
+                        bid = builder.t.BuilderBid(
+                            header=execution_payload_to_header(
+                                payload, builder.t, builder.spec
+                            ),
+                            value=builder.bid_value_wei,
+                            pubkey=builder.keypair.pk.to_bytes(),
+                        )
+                        root = compute_signing_root(
+                            type(bid).hash_tree_root(bid),
+                            builder_domain(builder.spec),
+                        )
+                        signed = builder.t.SignedBuilderBid(
+                            message=bid,
+                            signature=builder.keypair.sk.sign(
+                                root
+                            ).to_bytes(),
+                        )
+                        self._reply(
+                            200,
+                            {"data": to_json(type(signed), signed)},
+                        )
+                        return
+                self._reply(404, {"message": "unknown route"})
+
+            def do_POST(self):
+                if builder.down:
+                    self._reply(500, {"message": "builder down"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(length) or b"null")
+                parts = self.path.strip("/").split("/")
+                if parts[:3] != ["eth", "v1", "builder"]:
+                    self._reply(404, {"message": "unknown route"})
+                    return
+                if parts[3:] == ["validators"]:
+                    regs = [
+                        from_json(
+                            builder.t.SignedValidatorRegistrationData, r
+                        )
+                        for r in doc
+                    ]
+                    builder.registrations.extend(regs)
+                    self._reply(200, {})
+                    return
+                if parts[3:] == ["blinded_blocks"]:
+                    if builder.refuse_reveal:
+                        self._reply(500, {"message": "reveal refused"})
+                        return
+                    signed = from_json(
+                        builder.t.signed_blinded_block_classes[
+                            "bellatrix"
+                        ],
+                        doc,
+                    )
+                    h = bytes(
+                        signed.message.body
+                        .execution_payload_header.block_hash
+                    )
+                    payload = builder.payloads.get(h)
+                    if payload is None:
+                        self._reply(400, {"message": "unknown payload"})
+                        return
+                    self._reply(
+                        200,
+                        {"data": to_json(type(payload), payload)},
+                    )
+                    return
+                self._reply(404, {"message": "unknown route"})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def client(self):
+        from lighthouse_tpu.execution_layer.builder_client import (
+            BuilderHttpClient,
+        )
+
+        return BuilderHttpClient(self.url, self.t)
+
+    def shutdown(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
 class MockExecutionLayer:
     """In-process engine-API HTTP server over an ExecutionBlockGenerator,
     with JWT auth checking (test_utils/mod.rs MockServer)."""

@@ -163,6 +163,28 @@ class BeaconNodeHttpClient:
             q += f"&graffiti=0x{bytes(graffiti).hex()}"
         return self._get(f"/eth/v2/validator/blocks/{slot}{q}")
 
+    def get_unsigned_blinded_block_json(
+        self,
+        slot: int,
+        randao_reveal: bytes,
+        graffiti: bytes | None = None,
+    ):
+        """GET /eth/v1/validator/blinded_blocks/{slot} (builder flow)."""
+        q = f"?randao_reveal=0x{bytes(randao_reveal).hex()}"
+        if graffiti is not None:
+            q += f"&graffiti=0x{bytes(graffiti).hex()}"
+        return self._get(f"/eth/v1/validator/blinded_blocks/{slot}{q}")
+
+    def post_blinded_block_json(self, block_json):
+        """POST /eth/v1/beacon/blinded_blocks (unblind + import)."""
+        return self._post("/eth/v1/beacon/blinded_blocks", block_json)
+
+    def post_validator_registrations_json(self, regs_json):
+        """POST /eth/v1/validator/register_validator."""
+        return self._post(
+            "/eth/v1/validator/register_validator", regs_json
+        )
+
     def post_sync_committee_messages_json(self, msgs_json):
         return self._post(
             "/eth/v1/beacon/pool/sync_committees", msgs_json

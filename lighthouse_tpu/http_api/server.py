@@ -21,6 +21,9 @@ Endpoints (the operative subset):
   POST /eth/v1/validator/duties/attester/{epoch}
   POST /eth/v1/validator/duties/sync/{epoch}
   GET  /eth/v2/validator/blocks/{slot}?randao_reveal=...&graffiti=...
+  GET  /eth/v1/validator/blinded_blocks/{slot}?randao_reveal=...
+  POST /eth/v1/beacon/blinded_blocks
+  POST /eth/v1/validator/register_validator
   GET  /eth/v1/validator/attestation_data?slot=...&committee_index=...
   GET  /eth/v1/validator/aggregate_attestation?slot=...&attestation_data_root=...
   POST /eth/v1/validator/aggregate_and_proofs
@@ -168,6 +171,19 @@ class BeaconApiServer:
         chain = self.chain
         parts = [p for p in path.split("?")[0].split("/") if p]
         if path == "/metrics":
+            # refresh the attestation-cache gauges at scrape time
+            for name, value in (
+                ("attester_cache_hits", chain.attester_cache.hits),
+                ("attester_cache_misses", chain.attester_cache.misses),
+                ("early_attester_cache_hits",
+                 chain.early_attester_cache.hits),
+                ("proposer_cache_hits", chain.proposer_cache.hits),
+                ("proposer_cache_misses", chain.proposer_cache.misses),
+            ):
+                REGISTRY.gauge(
+                    f"lighthouse_tpu_{name}",
+                    "attestation-production cache statistics",
+                ).set(value)
             return (REGISTRY.render().encode(), "text/plain; version=0.0.4")
         if parts[:3] == ["eth", "v1", "node"]:
             if parts[3] == "version":
@@ -304,6 +320,26 @@ class BeaconApiServer:
                 if c is None:
                     raise ApiError(404, "no contribution known")
                 return {"data": to_json(type(c), c)}
+        if (
+            parts[:3] == ["eth", "v1", "validator"]
+            and len(parts) >= 5
+            and parts[3] == "blinded_blocks"
+        ):
+            # builder flow (http_api/src/lib.rs blinded-block production)
+            q = self._query(path)
+            block = chain.produce_blinded_block_unsigned(
+                int(parts[4]),
+                bytes.fromhex(q["randao_reveal"][2:]),
+                bytes.fromhex(q["graffiti"][2:])
+                if "graffiti" in q
+                else b"\x00" * 32,
+            )
+            return {
+                "version": chain.spec.fork_name_at_epoch(
+                    chain.spec.slot_to_epoch(block.slot)
+                ),
+                "data": to_json(type(block), block),
+            }
         if parts[:3] == ["eth", "v2", "validator"]:
             if parts[3] == "blocks" and len(parts) >= 5:
                 q = self._query(path)
@@ -354,6 +390,26 @@ class BeaconApiServer:
             cls = chain.t.signed_block_classes[fork]
             block = from_json(cls, doc)
             chain.process_block(block)
+            return {}
+        if path == "/eth/v1/beacon/blinded_blocks":
+            # unblind via the payload cache / builder reveal, then import
+            doc = json.loads(body)
+            slot = int(doc["message"]["slot"])
+            fork = chain.spec.fork_name_at_epoch(
+                chain.spec.slot_to_epoch(slot)
+            )
+            cls = chain.t.signed_blinded_block_classes[fork]
+            chain.import_blinded_block(from_json(cls, doc))
+            return {}
+        if path == "/eth/v1/validator/register_validator":
+            regs = [
+                from_json(chain.t.SignedValidatorRegistrationData, d)
+                for d in json.loads(body)
+            ]
+            for r in regs:
+                chain.validator_registrations[bytes(r.message.pubkey)] = r
+            if chain.builder is not None:
+                chain.builder.register_validators(regs)
             return {}
         if path == "/eth/v1/beacon/pool/attestations":
             docs = json.loads(body)
@@ -543,31 +599,24 @@ class BeaconApiServer:
         }
 
     def _proposer_duties(self, epoch: int):
-        from lighthouse_tpu.state_processing.helpers import (
-            get_beacon_proposer_index,
-        )
-        from lighthouse_tpu.state_processing.per_slot import process_slots
-
+        """Served from the chain's proposer cache — one whole-epoch
+        computation per (epoch, decision root), never a per-slot state
+        advance (beacon_proposer_cache.rs)."""
         chain = self.chain
-        state = chain.state_for_epoch(epoch)
-        duties = []
-        for slot in range(
-            chain.spec.epoch_start_slot(epoch),
-            chain.spec.epoch_start_slot(epoch + 1),
-        ):
-            st = state
-            if st.slot < slot:
-                st = process_slots(state.copy(), slot, chain.spec)
-            idx = get_beacon_proposer_index(st, chain.spec)
-            duties.append(
+        proposers = chain.proposers_for_epoch(epoch)
+        validators = chain.head_state.validators
+        start = chain.spec.epoch_start_slot(epoch)
+        return {
+            "data": [
                 {
                     "pubkey": "0x"
-                    + bytes(st.validators[idx].pubkey).hex(),
+                    + bytes(validators[idx].pubkey).hex(),
                     "validator_index": str(idx),
-                    "slot": str(slot),
+                    "slot": str(start + i),
                 }
-            )
-        return {"data": duties}
+                for i, idx in enumerate(proposers)
+            ]
+        }
 
     # ----------------------------------------------------------- lifecycle
 

@@ -145,9 +145,17 @@ def per_block_processing(
 
     process_block_header(state, block, spec)
     if fork == "bellatrix" and is_execution_enabled(state, block.body):
-        process_execution_payload(
-            state, block.body.execution_payload, execution_engine, spec
-        )
+        if hasattr(block.body, "execution_payload"):
+            process_execution_payload(
+                state, block.body.execution_payload, execution_engine, spec
+            )
+        else:
+            # blinded body (builder flow): the payload is known only by
+            # its header; same state checks, no engine verdict here (the
+            # unblinding importer runs the engine on the full payload)
+            process_execution_payload_header(
+                state, block.body.execution_payload_header, spec
+            )
     process_randao(state, block, pk, spec, collector)
     process_eth1_data(state, block.body, spec)
     process_operations(
@@ -179,17 +187,26 @@ def is_merge_transition_complete(state) -> bool:
     return cls.encode(state.latest_execution_payload_header) != empty
 
 
+def _body_block_hash(body) -> bytes:
+    """block_hash of the body's payload, full or blinded
+    (ExecPayload::block_hash over FullPayload/BlindedPayload)."""
+    payload = getattr(body, "execution_payload", None)
+    if payload is None:
+        payload = body.execution_payload_header
+    return payload.block_hash
+
+
 def is_merge_transition_block(state, body) -> bool:
     return (
         not is_merge_transition_complete(state)
-        and body.execution_payload.block_hash != b"\x00" * 32
+        and _body_block_hash(body) != b"\x00" * 32
     )
 
 
 def is_execution_enabled(state, body) -> bool:
     if is_merge_transition_complete(state):
         return True
-    return body.execution_payload.block_hash != b"\x00" * 32
+    return _body_block_hash(body) != b"\x00" * 32
 
 
 def compute_timestamp_at_slot(state, slot: int, spec: Spec) -> int:
@@ -202,6 +219,30 @@ class AlwaysValidExecutionEngine:
 
     def notify_new_payload(self, payload) -> bool:
         return True
+
+
+def process_execution_payload_header(state, header, spec: Spec):
+    """Blinded-body variant of process_execution_payload: identical
+    consistency checks, then roll the header forward verbatim (spec
+    process_execution_payload over a BlindedPayload; the engine verdict
+    happens at unblinding time on the full payload)."""
+    from lighthouse_tpu.state_processing.helpers import get_randao_mix
+
+    if is_merge_transition_complete(state):
+        if (
+            header.parent_hash
+            != state.latest_execution_payload_header.block_hash
+        ):
+            raise BlockProcessingError("payload parent mismatch")
+    if header.prev_randao != get_randao_mix(
+        state, get_current_epoch(state, spec), spec
+    ):
+        raise BlockProcessingError("payload prev_randao mismatch")
+    if header.timestamp != compute_timestamp_at_slot(
+        state, state.slot, spec
+    ):
+        raise BlockProcessingError("payload timestamp mismatch")
+    state.latest_execution_payload_header = header.copy()
 
 
 def process_execution_payload(state, payload, execution_engine, spec: Spec):
@@ -230,8 +271,17 @@ def process_execution_payload(state, payload, execution_engine, spec: Spec):
         raise BlockProcessingError("execution engine rejected payload")
 
     t = types_for(spec)
+    state.latest_execution_payload_header = execution_payload_to_header(
+        payload, t, spec
+    )
+
+
+def execution_payload_to_header(payload, t, spec: Spec):
+    """ExecutionPayloadHeader::from(ExecutionPayload): same fields with
+    the transactions list replaced by its hash_tree_root — which is why a
+    blinded block's root equals the full block's."""
     tx_list_type = _tx_list_type(t, spec)
-    state.latest_execution_payload_header = t.ExecutionPayloadHeader(
+    return t.ExecutionPayloadHeader(
         parent_hash=payload.parent_hash,
         fee_recipient=payload.fee_recipient,
         state_root=payload.state_root,

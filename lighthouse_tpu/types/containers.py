@@ -243,6 +243,54 @@ def types_for(spec: Spec) -> SimpleNamespace:
         sync_aggregate: SyncAggregate
         execution_payload: ExecutionPayload
 
+    class BlindedBeaconBlockBodyBellatrix(ssz.Container):
+        """Bellatrix body with the payload replaced by its header — the
+        builder flow's block shape (reference BlindedPayload,
+        consensus/types/src/payload.rs + builder_client/src/lib.rs)."""
+
+        randao_reveal: BLSSignature
+        eth1_data: Eth1Data
+        graffiti: ssz.bytes32
+        proposer_slashings: ssz.List(
+            ProposerSlashing, spec.MAX_PROPOSER_SLASHINGS
+        )
+        attester_slashings: ssz.List(
+            AttesterSlashing, spec.MAX_ATTESTER_SLASHINGS
+        )
+        attestations: ssz.List(Attestation, spec.MAX_ATTESTATIONS)
+        deposits: ssz.List(Deposit, spec.MAX_DEPOSITS)
+        voluntary_exits: ssz.List(
+            SignedVoluntaryExit, spec.MAX_VOLUNTARY_EXITS
+        )
+        sync_aggregate: SyncAggregate
+        execution_payload_header: ExecutionPayloadHeader
+
+    # ------------------------------------------------------- builder types
+
+    class BuilderBid(ssz.Container):
+        """eth2::types::builder_bid::BuilderBid."""
+
+        header: ExecutionPayloadHeader
+        value: ssz.uint256
+        pubkey: BLSPubkey
+
+    class SignedBuilderBid(ssz.Container):
+        message: BuilderBid
+        signature: BLSSignature
+
+    class ValidatorRegistrationData(ssz.Container):
+        """SignedValidatorRegistrationData message
+        (consensus/types/src/validator_registration_data.rs)."""
+
+        fee_recipient: ssz.bytes20
+        gas_limit: ssz.uint64
+        timestamp: ssz.uint64
+        pubkey: BLSPubkey
+
+    class SignedValidatorRegistrationData(ssz.Container):
+        message: ValidatorRegistrationData
+        signature: BLSSignature
+
     def _make_block(body_cls, name):
         cls = type(
             name,
@@ -285,6 +333,12 @@ def types_for(spec: Spec) -> SimpleNamespace:
     )
     SignedBeaconBlockBellatrix = _make_signed(
         BeaconBlockBellatrix, "SignedBeaconBlockBellatrix"
+    )
+    BlindedBeaconBlockBellatrix = _make_block(
+        BlindedBeaconBlockBodyBellatrix, "BlindedBeaconBlockBellatrix"
+    )
+    SignedBlindedBeaconBlockBellatrix = _make_signed(
+        BlindedBeaconBlockBellatrix, "SignedBlindedBeaconBlockBellatrix"
     )
 
     # --------------------------------------------------------------- state
@@ -445,6 +499,16 @@ def types_for(spec: Spec) -> SimpleNamespace:
         "phase0": BeaconStatePhase0,
         "altair": BeaconStateAltair,
         "bellatrix": BeaconStateBellatrix,
+    }
+    # builder/blinded flow (bellatrix onward)
+    ns.blinded_body_classes = {
+        "bellatrix": BlindedBeaconBlockBodyBellatrix,
+    }
+    ns.blinded_block_classes = {
+        "bellatrix": BlindedBeaconBlockBellatrix,
+    }
+    ns.signed_blinded_block_classes = {
+        "bellatrix": SignedBlindedBeaconBlockBellatrix,
     }
 
     _CACHE[spec.name] = ns

@@ -146,6 +146,11 @@ class Spec:
     DOMAIN_SYNC_COMMITTEE: bytes = b"\x07\x00\x00\x00"
     DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF: bytes = b"\x08\x00\x00\x00"
     DOMAIN_CONTRIBUTION_AND_PROOF: bytes = b"\x09\x00\x00\x00"
+    # builder specs (not an in-protocol domain): signs BuilderBid and
+    # ValidatorRegistrationData against the GENESIS fork version with a
+    # zero genesis_validators_root (compute_builder_domain in the
+    # reference, consensus/types/src/chain_spec.rs)
+    DOMAIN_APPLICATION_BUILDER: bytes = b"\x00\x00\x00\x01"
 
     # ---- derived helpers ----
 

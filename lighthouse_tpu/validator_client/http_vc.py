@@ -16,6 +16,7 @@ sync_committee_service.rs:142):
 """
 
 from lighthouse_tpu import bls, ssz
+from lighthouse_tpu.http_api.client import ApiClientError
 from lighthouse_tpu.http_api.json_codec import from_json, to_json
 from lighthouse_tpu.state_processing.helpers import hash32
 from lighthouse_tpu.types.containers import types_for
@@ -34,10 +35,15 @@ class HttpValidatorClient:
         keypairs,
         spec,
         slashing_db: SlashingProtectionDB | None = None,
+        use_builder: bool = False,
     ):
         """`client` is a BeaconNodeHttpClient (or a BeaconNodeFallback
-        exposing the same surface); `keypairs` a list of bls Keypairs."""
+        exposing the same surface); `keypairs` a list of bls Keypairs.
+        `use_builder` routes proposals through the blinded-block flow
+        with automatic fallback to local full blocks on builder/BN
+        faults (block_service.rs builder-proposal path)."""
         self.client = client
+        self.use_builder = use_builder
         self.spec = spec
         self.t = types_for(spec)
         self.keys_by_pubkey = {kp.pk.to_bytes(): kp for kp in keypairs}
@@ -127,8 +133,32 @@ class HttpValidatorClient:
             epoch,
             ssz.uint64.hash_tree_root(epoch),
         )
-        resp = self.client.get_unsigned_block_json(slot, reveal)
-        block_cls = self.t.block_classes[resp["version"]]
+        blinded = False
+        if self.use_builder:
+            try:
+                resp = self.client.get_unsigned_blinded_block_json(
+                    slot, reveal
+                )
+                blinded = True
+            except ApiClientError:
+                # builder flow unavailable at the BN: fall back to a
+                # locally-built full block (block_service.rs falls back
+                # on any builder-path error)
+                self.metrics["builder_fallbacks"] = (
+                    self.metrics.get("builder_fallbacks", 0) + 1
+                )
+                resp = self.client.get_unsigned_block_json(slot, reveal)
+        else:
+            resp = self.client.get_unsigned_block_json(slot, reveal)
+        classes = (
+            (
+                self.t.blinded_block_classes,
+                self.t.signed_blinded_block_classes,
+            )
+            if blinded
+            else (self.t.block_classes, self.t.signed_block_classes)
+        )
+        block_cls = classes[0][resp["version"]]
         block = from_json(block_cls, resp["data"])
         root = block_cls.hash_tree_root(block)
         sig, signing_root = self._sign(
@@ -137,11 +167,47 @@ class HttpValidatorClient:
         self.slashing_db.check_and_insert_block(
             kp.pk.to_bytes(), slot, signing_root
         )
-        signed_cls = self.t.signed_block_classes[resp["version"]]
+        signed_cls = classes[1][resp["version"]]
         signed = signed_cls(message=block, signature=sig)
-        self.client.post_block_json(to_json(signed_cls, signed))
+        if blinded:
+            self.client.post_blinded_block_json(
+                to_json(signed_cls, signed)
+            )
+        else:
+            self.client.post_block_json(to_json(signed_cls, signed))
         self.metrics["blocks_proposed"] += 1
         return signed
+
+    def register_validators(
+        self, fee_recipient: bytes = b"\x00" * 20, gas_limit: int = 30_000_000
+    ):
+        """Builder-spec validator registration: sign
+        ValidatorRegistrationData for every managed key against the
+        builder domain and POST to the BN (preparation_service.rs)."""
+        from lighthouse_tpu.execution_layer.builder_client import (
+            builder_domain,
+        )
+
+        regs = []
+        for pk_bytes, kp in self.keys_by_pubkey.items():
+            msg = self.t.ValidatorRegistrationData(
+                fee_recipient=fee_recipient,
+                gas_limit=gas_limit,
+                timestamp=0,
+                pubkey=pk_bytes,
+            )
+            root = compute_signing_root(
+                type(msg).hash_tree_root(msg), builder_domain(self.spec)
+            )
+            regs.append(
+                self.t.SignedValidatorRegistrationData(
+                    message=msg, signature=kp.sk.sign(root).to_bytes()
+                )
+            )
+        self.client.post_validator_registrations_json(
+            [to_json(type(r), r) for r in regs]
+        )
+        return regs
 
     # -------------------------------------------------------- attestations
 

@@ -1,0 +1,242 @@
+"""Builder / blinded-block flow: header bid -> blinded production ->
+signed submit -> unblind -> import, plus every fault-fallback path.
+
+Mirrors /root/reference/beacon_node/builder_client/src/lib.rs (client),
+execution_layer's builder bid path, and block_service.rs's
+builder-with-local-fallback proposal logic.
+"""
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.beacon_chain.chain import BlockError
+from lighthouse_tpu.execution_layer import ExecutionLayer
+from lighthouse_tpu.execution_layer.builder_client import (
+    BuilderError,
+    verify_bid_signature,
+)
+from lighthouse_tpu.execution_layer.test_utils import (
+    MockBuilder,
+    MockExecutionLayer,
+)
+from lighthouse_tpu.harness import Harness
+from lighthouse_tpu.http_api.client import BeaconNodeHttpClient
+from lighthouse_tpu.http_api.server import BeaconApiServer
+from lighthouse_tpu.state_processing.helpers import get_domain
+from lighthouse_tpu.state_processing.per_slot import process_slots
+from lighthouse_tpu.types.containers import types_for
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.validator_client.http_vc import HttpValidatorClient
+
+from tests.test_bellatrix import _payload_for
+
+N = 32
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec(
+        name="minimal-builder",
+        ALTAIR_FORK_EPOCH=0,
+        BELLATRIX_FORK_EPOCH=1,
+    )
+
+
+def merged_chain(spec):
+    """A chain + harness advanced past the merge transition with local
+    payloads, ready for builder proposals."""
+    t = types_for(spec)
+    mock_el = MockExecutionLayer()
+    h = Harness(spec, N)
+    h.payload_builder = lambda state: _payload_for(
+        state, mock_el.generator, spec, t
+    )
+    el = ExecutionLayer([mock_el.client()])
+    chain = BeaconChain(
+        h.state.copy(), spec, backend="ref", execution_layer=el
+    )
+    chain.payload_builder = h.payload_builder
+    for slot in range(1, spec.SLOTS_PER_EPOCH + 3):
+        chain.process_block(h.advance_slot_with_block(slot))
+        chain.set_slot(slot)
+    return t, mock_el, h, chain
+
+
+def make_builder(spec, t, chain):
+    def payload_source(slot, parent_hash):
+        state = process_slots(
+            chain._copy_state(chain.head_state), slot, spec
+        )
+        return _payload_for(state, None, spec, t)
+
+    return MockBuilder(spec, t, payload_source)
+
+
+def sign_blinded(h, chain, spec, blinded):
+    state = chain.head_state
+    root = type(blinded).hash_tree_root(blinded)
+    domain = get_domain(
+        state,
+        spec.DOMAIN_BEACON_PROPOSER,
+        spec.slot_to_epoch(blinded.slot),
+        spec,
+    )
+    sig = h._sign(h.keypairs[blinded.proposer_index].sk, root, domain)
+    return chain.t.signed_blinded_block_classes["bellatrix"](
+        message=blinded, signature=sig
+    )
+
+
+def test_builder_block_end_to_end(spec):
+    """Bid -> blinded block -> sign -> unblind via builder reveal ->
+    imported as the canonical head, carrying the BUILDER's payload."""
+    t, mock_el, h, chain = merged_chain(spec)
+    builder = make_builder(spec, t, chain)
+    try:
+        chain.builder = builder.client()
+        slot = chain.head_state.slot + 1
+        chain.set_slot(slot)
+        reveal = h.randao_reveal(slot)
+        blinded = chain.produce_blinded_block_unsigned(slot, reveal)
+        header = blinded.body.execution_payload_header
+        assert bytes(header.block_hash) in builder.payloads
+        assert chain.metrics.get("builder_faults", 0) == 0
+
+        signed = sign_blinded(h, chain, spec, blinded)
+        root = chain.import_blinded_block(signed)
+        assert chain.head_root == root
+        assert (
+            chain.head_state.latest_execution_payload_header.block_hash
+            == header.block_hash
+        )
+    finally:
+        builder.shutdown()
+        mock_el.shutdown()
+
+
+def test_builder_fault_falls_back_to_local_payload(spec):
+    """A dead builder must not stop proposals: the BN falls back to the
+    local payload, and unblinding succeeds from the payload cache without
+    ever reaching the builder."""
+    t, mock_el, h, chain = merged_chain(spec)
+    builder = make_builder(spec, t, chain)
+    try:
+        builder.down = True
+        chain.builder = builder.client()
+        slot = chain.head_state.slot + 1
+        chain.set_slot(slot)
+        blinded = chain.produce_blinded_block_unsigned(
+            slot, h.randao_reveal(slot)
+        )
+        assert chain.metrics["builder_faults"] == 1
+        h_hash = bytes(blinded.body.execution_payload_header.block_hash)
+        assert h_hash in chain._local_payloads
+
+        signed = sign_blinded(h, chain, spec, blinded)
+        root = chain.import_blinded_block(signed)  # no builder touch
+        assert chain.head_root == root
+    finally:
+        builder.shutdown()
+        mock_el.shutdown()
+
+
+def test_reveal_refusal_rejects_import(spec):
+    """If the builder took the bid but refuses to reveal the payload, the
+    blinded block cannot be imported (the reference surfaces this as a
+    builder fault; the slot is lost, equivocation is not attempted)."""
+    t, mock_el, h, chain = merged_chain(spec)
+    builder = make_builder(spec, t, chain)
+    try:
+        chain.builder = builder.client()
+        slot = chain.head_state.slot + 1
+        chain.set_slot(slot)
+        blinded = chain.produce_blinded_block_unsigned(
+            slot, h.randao_reveal(slot)
+        )
+        builder.refuse_reveal = True
+        signed = sign_blinded(h, chain, spec, blinded)
+        with pytest.raises(BlockError, match="reveal"):
+            chain.import_blinded_block(signed)
+    finally:
+        builder.shutdown()
+        mock_el.shutdown()
+
+
+def test_bid_signature_verification(spec):
+    t, mock_el, h, chain = merged_chain(spec)
+    builder = make_builder(spec, t, chain)
+    try:
+        client = builder.client()
+        slot = chain.head_state.slot + 1
+        parent = bytes(
+            chain.head_state.latest_execution_payload_header.block_hash
+        )
+        bid = client.get_header(slot, parent, b"\x11" * 48)
+        assert verify_bid_signature(bid, spec)
+        tampered = type(bid).decode(type(bid).encode(bid))
+        tampered.message.value += 1
+        assert not verify_bid_signature(tampered, spec)
+
+        builder.down = True
+        with pytest.raises(BuilderError):
+            client.get_header(slot, parent, b"\x11" * 48)
+        with pytest.raises(BuilderError):
+            client.status()
+    finally:
+        builder.shutdown()
+        mock_el.shutdown()
+
+
+def test_http_vc_builder_proposal_and_registration(spec):
+    """The REST-only VC drives the whole builder flow over HTTP: register
+    validators, fetch a blinded block, sign, publish — and falls back to
+    a full block when the BN has no blinded path for the slot."""
+    t, mock_el, h, chain = merged_chain(spec)
+    builder = make_builder(spec, t, chain)
+    srv = BeaconApiServer(chain)
+    srv.start()
+    try:
+        chain.builder = builder.client()
+        vc = HttpValidatorClient(
+            BeaconNodeHttpClient(f"http://127.0.0.1:{srv.port}"),
+            h.keypairs,
+            spec,
+            use_builder=True,
+        )
+        regs = vc.register_validators(fee_recipient=b"\x22" * 20)
+        assert len(builder.registrations) == len(regs) == N
+        assert bytes(regs[0].message.fee_recipient) == b"\x22" * 20
+
+        slot = chain.head_state.slot + 1
+        chain.set_slot(slot)
+        signed = vc.propose(slot)
+        assert signed is not None
+        assert "BlindedBeaconBlock" in type(signed.message).__name__
+        assert chain.head_state.slot == slot  # imported via unblinding
+
+        # VC-side fallback: BN's blinded route faults entirely
+        builder.down = True
+        chain.payload_builder = None  # local fallback gone too
+        slot2 = chain.head_state.slot + 1
+        chain.set_slot(slot2)
+        import lighthouse_tpu.beacon_chain.chain as chain_mod
+
+        orig = chain_mod.BeaconChain.produce_blinded_block_unsigned
+        chain_mod.BeaconChain.produce_blinded_block_unsigned = (
+            lambda self, *a, **k: (_ for _ in ()).throw(
+                BlockError("no builder and no local payload source")
+            )
+        )
+        chain.payload_builder = h.payload_builder  # full path still works
+        try:
+            signed2 = vc.propose(slot2)
+        finally:
+            chain_mod.BeaconChain.produce_blinded_block_unsigned = orig
+        assert signed2 is not None
+        assert vc.metrics.get("builder_fallbacks", 0) == 1
+        assert "Blinded" not in type(signed2.message).__name__
+        assert chain.head_state.slot == slot2
+    finally:
+        srv.stop()
+        builder.shutdown()
+        mock_el.shutdown()
