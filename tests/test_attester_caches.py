@@ -1,0 +1,128 @@
+"""Attestation-production caches: attester cache, early-attester cache,
+and the beacon-proposer cache.
+
+Mirrors beacon_chain/src/attester_cache.rs, early_attester_cache.rs, and
+beacon_proposer_cache.rs: `attestation_data` and proposer duties must be
+served without touching (or advancing) the head state on the hot path,
+and the answers must equal the state-derived ground truth.
+"""
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.beacon_chain.attestation_verification import (
+    AttestationError,
+)
+from lighthouse_tpu.harness import Harness
+from lighthouse_tpu.state_processing.helpers import (
+    get_beacon_proposer_index,
+    get_block_root_at_slot,
+)
+from lighthouse_tpu.state_processing.per_slot import process_slots
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+@pytest.fixture(scope="module")
+def setup():
+    spec = minimal_spec()
+    h = Harness(spec, 32)
+    chain = BeaconChain(h.state.copy(), spec, backend="ref")
+    for slot in range(1, spec.SLOTS_PER_EPOCH + 3):
+        chain.process_block(h.advance_slot_with_block(slot))
+        chain.set_slot(slot)
+    return spec, h, chain
+
+
+def test_attestation_data_served_without_state_reads(setup, monkeypatch):
+    spec, h, chain = setup
+    slot = chain.head_state.slot
+
+    # ground truth from the state, computed the pre-cache way
+    state = chain.head_state
+    epoch = spec.slot_to_epoch(slot)
+    start_slot = spec.epoch_start_slot(epoch)
+    expected_target = (
+        bytes(get_block_root_at_slot(state, start_slot, spec))
+        if state.slot > start_slot
+        else chain.head_root
+    )
+    expected_source = state.current_justified_checkpoint
+
+    # forbid the fallback: after import+recompute_head the caches must
+    # answer on their own
+    def boom(e):
+        raise AssertionError("attestation_data read the head state")
+
+    monkeypatch.setattr(chain, "_attestation_parts_from_state", boom)
+    data = chain.produce_attestation_data(slot, 0)
+    assert bytes(data.beacon_block_root) == chain.head_root
+    assert bytes(data.target.root) == expected_target
+    assert data.target.epoch == epoch
+    assert data.source.epoch == expected_source.epoch
+    assert bytes(data.source.root) == bytes(expected_source.root)
+
+    # committee bound comes from the cache too
+    with pytest.raises(AttestationError):
+        chain.produce_attestation_data(slot, 10_000)
+
+
+def test_early_attester_cache_serves_fresh_block(setup):
+    spec, h, chain = setup
+    slot = chain.head_state.slot + 1
+    block = h.advance_slot_with_block(slot)
+    root = chain.process_block(block)
+    chain.set_slot(slot)
+
+    hits0 = chain.early_attester_cache.hits
+    data = chain.produce_attestation_data(slot, 0)
+    assert bytes(data.beacon_block_root) == root
+    assert chain.early_attester_cache.hits == hits0 + 1
+
+    # the just-imported block is servable by root (RPC-before-DB path)
+    got = chain.early_attester_cache.get_block(root)
+    assert got is not None
+    assert type(got.message).hash_tree_root(got.message) == root
+    assert chain.early_attester_cache.get_block(b"\x00" * 32) is None
+
+
+def test_proposer_cache_matches_state_advance(setup):
+    spec, h, chain = setup
+    epoch = spec.slot_to_epoch(chain.head_state.slot)
+
+    proposers = chain.proposers_for_epoch(epoch)
+    assert len(proposers) == spec.SLOTS_PER_EPOCH
+
+    # ground truth, slot by slot: past slots are pinned by the ACTUAL
+    # imported blocks' proposer_index (the transition verified them);
+    # future slots by a per-slot state advance
+    head_slot = chain.head_state.slot
+    state = chain.state_for_epoch(epoch)
+    for i, slot in enumerate(
+        range(
+            spec.epoch_start_slot(epoch), spec.epoch_start_slot(epoch + 1)
+        )
+    ):
+        if slot <= head_slot:
+            root = chain.store.get_canonical_block_root(slot)
+            if root is None:
+                continue  # empty slot: no block to pin against
+            block = chain.store.get_block(root)
+            assert proposers[i] == block.message.proposer_index, slot
+        else:
+            st = process_slots(state.copy(), slot, spec)
+            assert proposers[i] == get_beacon_proposer_index(st, spec), slot
+
+    # second call is a pure cache hit
+    hits0 = chain.proposer_cache.hits
+    assert chain.proposers_for_epoch(epoch) == proposers
+    assert chain.proposer_cache.hits == hits0 + 1
+
+
+def test_attester_cache_pruned_on_finality(setup):
+    spec, h, chain = setup
+    chain.attester_cache.prime(
+        0, b"\x01" * 32, chain.head_state.finalized_checkpoint, 1,
+        b"\x02" * 32,
+    )
+    chain.attester_cache.prune(finalized_epoch=1)
+    assert chain.attester_cache.get(0, b"\x01" * 32) is None
