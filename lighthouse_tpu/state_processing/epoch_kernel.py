@@ -1,0 +1,209 @@
+"""Device epoch-processing kernel: altair inactivity updates + rewards
+and penalties as one jitted elementwise pass over (V,) arrays.
+
+Role of the reference's participation-cache single pass
+(consensus/state_processing/src/per_epoch_processing/altair/
+participation_cache.rs + rewards_and_penalties.rs): the per-validator
+epoch math is pure gather/arithmetic — at 500k validators the Python
+dict/list loops in per_epoch.py cost seconds per epoch, while the same
+math is microseconds of VPU work.
+
+Exactness: everything is int64 with floor division — bit-identical to
+the Python path (proven by randomized equivalence tests). The kernel
+runs under `jax.enable_x64` (the crypto plane is int32-limb and does not
+use x64, so the flag is scoped to these calls). Host-side bound checks
+fall back to the Python path in the (astronomically unlikely) regime
+where `effective_balance * inactivity_score` could exceed int64.
+
+The two stages are fused IN ORDER: the spec applies
+process_inactivity_updates BEFORE process_rewards_and_penalties, and the
+inactivity penalty reads the UPDATED scores.
+"""
+
+import os
+
+import numpy as np
+
+# participation flag weights (altair spec): (flag_index, weight)
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+PARTICIPATION_FLAG_WEIGHTS = (14, 26, 14)  # source, target, head
+WEIGHT_DENOMINATOR = 64
+
+_JITTED = {}
+
+
+def _kernel(jnp):
+    def run(
+        eff,            # (V,) int64 effective balances
+        prev_part,      # (V,) int64 previous-epoch participation flags
+        scores,         # (V,) int64 inactivity scores
+        balances,       # (V,) int64
+        active_prev,    # (V,) bool  active in previous epoch
+        slashed,        # (V,) bool
+        eligible,       # (V,) bool
+        base_per_inc,   # scalar int64: get_base_reward_per_increment
+        increment,      # scalar int64
+        active_increments,   # scalar int64
+        leak,           # scalar bool
+        score_bias,     # scalar int64
+        score_recovery, # scalar int64
+        inactivity_denominator,  # scalar int64: bias * quotient
+    ):
+        unslashed = active_prev & ~slashed
+        base = (eff // increment) * base_per_inc
+
+        # ---- process_inactivity_updates (uses OLD participation) ----
+        target_part = unslashed & (
+            (prev_part >> TIMELY_TARGET_FLAG_INDEX) & 1
+        ).astype(bool)
+        new_scores = jnp.where(
+            target_part,
+            scores - jnp.minimum(1, scores),
+            scores + score_bias,
+        )
+        new_scores = jnp.where(
+            leak,
+            new_scores,
+            new_scores - jnp.minimum(score_recovery, new_scores),
+        )
+        new_scores = jnp.where(eligible, new_scores, scores)
+
+        # ---- process_rewards_and_penalties ----
+        rewards = jnp.zeros_like(balances)
+        penalties = jnp.zeros_like(balances)
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            part = unslashed & (
+                (prev_part >> flag_index) & 1
+            ).astype(bool)
+            part_balance = jnp.maximum(
+                increment, jnp.sum(jnp.where(part, eff, 0))
+            )
+            part_increments = part_balance // increment
+            flag_reward = (base * weight * part_increments) // (
+                active_increments * WEIGHT_DENOMINATOR
+            )
+            rewards = rewards + jnp.where(
+                eligible & part & ~leak, flag_reward, 0
+            )
+            if flag_index != TIMELY_HEAD_FLAG_INDEX:
+                penalties = penalties + jnp.where(
+                    eligible & ~part, (base * weight) // WEIGHT_DENOMINATOR, 0
+                )
+        # inactivity penalty: UPDATED scores, non-target-participating
+        penalties = penalties + jnp.where(
+            eligible & ~target_part,
+            (eff * new_scores) // inactivity_denominator,
+            0,
+        )
+        new_balances = jnp.maximum(0, balances + rewards - penalties)
+        return new_balances, new_scores
+
+    return run
+
+
+def _get_jitted():
+    import jax
+
+    fn = _JITTED.get("fn")
+    if fn is None:
+        import jax.numpy as jnp
+
+        fn = jax.jit(_kernel(jnp))
+        _JITTED["fn"] = fn
+    return fn
+
+
+def epoch_kernel_enabled() -> bool:
+    return os.environ.get("LIGHTHOUSE_TPU_EPOCH_KERNEL", "1") != "0"
+
+
+def run_inactivity_and_rewards(state, spec, ctx) -> bool:
+    """Fused device pass replacing process_inactivity_updates +
+    process_rewards_and_penalties_altair. Returns False when the inputs
+    fall outside the kernel's exactness envelope (caller then uses the
+    Python path)."""
+    import jax
+
+    from lighthouse_tpu.state_processing.helpers import (
+        get_total_active_balance,
+        integer_squareroot,
+    )
+    from lighthouse_tpu.state_processing.per_epoch import (
+        fork_of,
+        is_in_inactivity_leak,
+    )
+
+    V = len(state.validators)
+    if V == 0:
+        return True
+    eff = np.fromiter(
+        (v.effective_balance for v in state.validators),
+        dtype=np.int64,
+        count=V,
+    )
+    scores = np.asarray(state.inactivity_scores, dtype=np.int64)
+    # int64 envelope: eff * (score + bias) must not overflow
+    max_eff = int(eff.max()) if V else 0
+    max_score = int(scores.max()) + spec.INACTIVITY_SCORE_BIAS if V else 0
+    if max_eff * max_score >= 2**62:
+        return False
+
+    prev = ctx.prev_epoch
+    # FAR_FUTURE_EPOCH (2^64-1) does not fit int64; clamp to a sentinel
+    # far beyond any reachable epoch (comparisons are unaffected)
+    activation = np.fromiter(
+        (min(v.activation_epoch, 2**62) for v in state.validators),
+        dtype=np.int64, count=V,
+    )
+    exit_ep = np.fromiter(
+        (min(v.exit_epoch, 2**62) for v in state.validators),
+        dtype=np.int64, count=V,
+    )
+    withdrawable = np.fromiter(
+        (min(v.withdrawable_epoch, 2**62) for v in state.validators),
+        dtype=np.int64, count=V,
+    )
+    slashed = np.fromiter(
+        (v.slashed for v in state.validators), dtype=bool, count=V
+    )
+    active_prev = (activation <= prev) & (prev < exit_ep)
+    eligible = active_prev | (slashed & (prev + 1 < withdrawable))
+    prev_part = np.asarray(state.previous_epoch_participation, np.int64)
+    balances = np.asarray(state.balances, dtype=np.int64)
+
+    total = get_total_active_balance(state, spec)
+    increment = spec.EFFECTIVE_BALANCE_INCREMENT
+    base_per_inc = (
+        increment * spec.BASE_REWARD_FACTOR // integer_squareroot(total)
+    )
+    inactivity_denominator = (
+        spec.INACTIVITY_SCORE_BIAS
+        * spec.inactivity_penalty_quotient_for(fork_of(state, spec))
+    )
+
+    fn = _get_jitted()
+    with jax.enable_x64(True):
+        new_balances, new_scores = fn(
+            eff,
+            prev_part,
+            scores,
+            balances,
+            active_prev,
+            slashed,
+            eligible,
+            np.int64(base_per_inc),
+            np.int64(increment),
+            np.int64(total // increment),
+            np.bool_(is_in_inactivity_leak(state, spec)),
+            np.int64(spec.INACTIVITY_SCORE_BIAS),
+            np.int64(spec.INACTIVITY_SCORE_RECOVERY_RATE),
+            np.int64(inactivity_denominator),
+        )
+        new_balances = np.asarray(new_balances)
+        new_scores = np.asarray(new_scores)
+
+    state.balances = [int(b) for b in new_balances]
+    state.inactivity_scores = [int(s) for s in new_scores]
+    return True

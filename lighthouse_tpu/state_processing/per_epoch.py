@@ -57,8 +57,20 @@ def process_epoch(state, spec: Spec):
     else:
         ctx = _AltairContext(state, spec)
         process_justification_and_finalization_altair(state, spec, ctx)
-        process_inactivity_updates(state, spec, ctx)
-        process_rewards_and_penalties_altair(state, spec, ctx)
+        done = False
+        if get_current_epoch(state, spec) != GENESIS_EPOCH:
+            from lighthouse_tpu.state_processing import epoch_kernel
+
+            if epoch_kernel.epoch_kernel_enabled():
+                # fused device pass over (V,) arrays — bit-identical to
+                # the two Python passes below (epoch_kernel.py); falls
+                # back host-side outside its int64 envelope
+                done = epoch_kernel.run_inactivity_and_rewards(
+                    state, spec, ctx
+                )
+        if not done and get_current_epoch(state, spec) != GENESIS_EPOCH:
+            process_inactivity_updates(state, spec, ctx)
+            process_rewards_and_penalties_altair(state, spec, ctx)
         process_registry_updates(state, spec)
         process_slashings(state, spec, fork)
         _process_final_updates(state, spec, fork)
