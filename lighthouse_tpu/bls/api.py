@@ -282,6 +282,27 @@ def verify_signature_sets(
     raise BlsError(f"unknown BLS backend {backend!r}")
 
 
+def verify_signature_set_batches(
+    batches, backend: str | None = None, seed: int | None = None
+) -> list:
+    """Verify several batches with host/device overlap: on the tpu
+    backend batch N+1 marshals while batch N verifies on device
+    (double-buffered dispatch, SURVEY §2.6 pipeline row). Returns one
+    bool per batch; empty batches are False."""
+    batches = [list(b) for b in batches]
+    backend = backend or _DEFAULT_BACKEND
+    if backend == "tpu":
+        from lighthouse_tpu.bls.tpu_backend import (
+            verify_signature_set_batches_tpu,
+        )
+
+        return verify_signature_set_batches_tpu(batches, seed=seed)
+    return [
+        verify_signature_sets(b, backend=backend) if b else False
+        for b in batches
+    ]
+
+
 def verify_signature_sets_individually(
     sets, backend: str | None = None
 ) -> list:

@@ -126,3 +126,35 @@ def test_secret_key_bounds():
         bls.SecretKey(R)
     sk = bls.SecretKey.from_bytes((1).to_bytes(32, "big"))
     assert sk.public_key() is not None
+
+
+def test_verify_signature_set_batches_streaming():
+    """Double-buffered multi-batch dispatch (tpu_backend
+    verify_signature_set_batches_tpu): per-batch verdicts must equal the
+    single-batch API on every backend, including bad and empty batches."""
+    from lighthouse_tpu.bls import tpu_backend
+
+    pairs = bls.interop_keypairs(4)
+    msgs = [bytes([40 + i]) * 32 for i in range(4)]
+    good = [
+        bls.SignatureSet(p.sk.sign(m), [p.pk], m)
+        for p, m in zip(pairs, msgs)
+    ]
+    bad = [
+        bls.SignatureSet(good[0].signature, [pairs[1].pk], msgs[1]),
+        good[2],
+    ]
+    batches = [good[:2], bad, [], good[2:]]
+
+    expected = [True, False, False, True]
+    for backend in ("ref", "tpu"):
+        assert (
+            bls.verify_signature_set_batches(batches, backend=backend)
+            == expected
+        ), backend
+    stats = tpu_backend.LAST_STREAM_STATS
+    assert stats["batches"] == 4
+    # the empty batch never dispatches; the bad batch carries
+    # subgroup-valid signatures, so its reject is a device verdict
+    assert stats["dispatched"] == 3
+    assert stats["host_marshal_ms"] > 0
