@@ -278,8 +278,12 @@ def _measure_sigsets(jax, platform):
     )
     args = jax.device_put(args)
 
-    # BENCH_IMPL=pallas runs the Miller loop as the fused VMEM kernel
+    # BENCH_IMPL=pallas runs the Miller loop as the fused VMEM kernel;
+    # BENCH_IMPL=mxu routes the limb-product contractions through int8
+    # MXU matmuls (fieldb._conv_contract) on the XLA path
     impl = os.environ.get("BENCH_IMPL", "xla")
+    if impl == "mxu":
+        os.environ["LIGHTHOUSE_TPU_MXU_CONV"] = "1"
     if impl == "pallas":
         import functools
 

@@ -25,10 +25,13 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from lighthouse_tpu.crypto.constants import G1_X, G1_Y, P
+from lighthouse_tpu.crypto.constants import G1_X, G1_Y, P, R
 from lighthouse_tpu.ops import curve, fieldb as fb, pairing
 
 NB = fb.NB
+
+# group order bits (LSB-first) for the device subgroup check
+_R_BITS = curve.scalars_to_bits([R], R.bit_length())
 
 
 def _mont1(v: int) -> np.ndarray:
@@ -159,6 +162,48 @@ def verify_signature_sets_individual(
     f_set = tower.fp12_mul(f[:S], f[S:])
     ok = tower.fp12_is_one(pairing.final_exponentiation(f_set))
     return ok | ~set_mask
+
+
+def g2_points_in_subgroup(points_g2_aff, mask):
+    """(S,) bool — [r]·P == identity per lane, the batched device form of
+    the host-side signature subgroup check (blst.rs:72-81 policy;
+    ref_curve.in_subgroup is the ground truth).
+
+    Runs a fully-general double-add ladder on the UNIFIED Jacobian
+    plane: the inputs are by definition UNCHECKED points. Neither the
+    RCB complete formulas (complete only on the odd-order r-torsion) nor
+    the lean `add_nonexceptional` ladder (whose no-collision argument
+    assumes the base has order r — an adversarial small-order twist
+    point breaks it) may be used here; `JacobianGroup.add` handles every
+    exceptional case. Masked lanes pass."""
+    import jax
+
+    G = curve.G2
+    x, y = points_g2_aff
+    F = G.F
+    one = jnp.broadcast_to(jnp.asarray(F.ONE), x.shape)
+    zero = jnp.zeros_like(x)
+    m = mask
+    # affine -> Jacobian (z = 1); masked lanes to infinity (z = 0)
+    pt = (
+        F.select(m, x, zero),
+        F.select(m, y, one),
+        F.select(m, one, zero),
+    )
+    batch = pt[0].shape[:-2]
+    bits_seq = jnp.asarray(_R_BITS[0], dtype=jnp.int32)  # (255,) LSB-first
+
+    def step(carry, bit):
+        acc, addend = carry
+        added = G.add(acc, addend)
+        use = jnp.broadcast_to(bit == 1, batch)
+        acc = G.select(use, added, acc)
+        addend = G.double(addend)
+        return (acc, addend), None
+
+    init = (G.infinity_like(pt), pt)
+    (acc, _), _ = jax.lax.scan(step, init, bits_seq)
+    return G.is_infinity(acc) | ~mask
 
 
 def _pad_lanes_projective(pt_t, block_b: int, group):

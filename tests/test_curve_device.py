@@ -2,6 +2,8 @@
 
 import random
 
+import pytest
+
 import jax
 import numpy as np
 
@@ -114,3 +116,35 @@ def test_g1_sum_and_masked_sum():
         if m:
             ref_msum = ref_curve.G1.add(ref_msum, p)
     assert ref_curve.G1.eq(curve.g1_unpack(msum)[0], ref_msum)
+
+
+@pytest.mark.slow
+def test_g2_subgroup_check_device():
+    """Batched [r]P == inf subgroup check (general-add ladder): accepts
+    r-torsion points, rejects on-curve pre-cofactor-clear points, and
+    passes masked lanes (device form of blst.rs:72-81 policy)."""
+    import jax
+
+    from lighthouse_tpu.bls.hash_to_curve import (
+        hash_to_field_fp2,
+        iso_map,
+        map_to_curve_sswu,
+    )
+    from lighthouse_tpu.crypto.ref_curve import G2 as RG2
+    from lighthouse_tpu.ops import batch_verify, fieldb as fb, fp2
+
+    good = [RG2.to_affine(RG2.mul_scalar(RG2.generator, k)) for k in (5, 9)]
+    u = hash_to_field_fp2(b"probe", 2)
+    bad = [iso_map(map_to_curve_sswu(ui)) for ui in u]
+    for p in bad:
+        assert not RG2.in_subgroup(RG2.from_affine(p))
+    pts = good + bad
+    xs = fb.to_mont(fp2.pack([p[0] for p in pts]))
+    ys = fb.to_mont(fp2.pack([p[1] for p in pts]))
+    fn = jax.jit(batch_verify.g2_points_in_subgroup)
+    out = np.asarray(fn((xs, ys), np.array([True] * 4)))
+    assert out.tolist() == [True, True, False, False]
+    out2 = np.asarray(
+        fn((xs, ys), np.array([True, True, False, False]))
+    )
+    assert out2.tolist() == [True, True, True, True]
