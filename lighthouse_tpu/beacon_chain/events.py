@@ -8,6 +8,8 @@ route, the validator client, tests) consume bounded queues.
 import queue
 import threading
 
+from lighthouse_tpu.common.locks import TimedLock
+
 TOPICS = (
     "head",
     "block",
@@ -20,7 +22,7 @@ TOPICS = (
 class EventBus:
     def __init__(self, capacity: int = 1024):
         self._subs: dict[str, list] = {t: [] for t in TOPICS}
-        self._lock = threading.Lock()
+        self._lock = TimedLock("events.subscribers")
         self.capacity = capacity
 
     def subscribe(self, topics):

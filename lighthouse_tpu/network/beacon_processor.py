@@ -15,6 +15,8 @@ preserved because they shape the TPU data plane:
 
 import heapq
 import threading
+
+from lighthouse_tpu.common.locks import TimedLock
 import time
 from dataclasses import dataclass, field
 
@@ -66,7 +68,7 @@ class BeaconProcessor:
             self.bounds.update(bounds)
         self._queues: dict[str, list] = {k: [] for k in PRIORITIES}
         self._dropped: dict[str, int] = {k: 0 for k in PRIORITIES}
-        self._lock = threading.Lock()
+        self._lock = TimedLock("beacon_processor.queues")
         self._work_available = threading.Condition(self._lock)
         self._seq = 0
         self._workers = []

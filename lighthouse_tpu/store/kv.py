@@ -11,6 +11,8 @@ interface leaves room for an LMDB/LevelDB-style C++ backend later.
 import sqlite3
 import threading
 
+from lighthouse_tpu.common.locks import TimedLock
+
 
 class KVStore:
     """Column-family byte KV interface."""
@@ -40,7 +42,7 @@ class KVStore:
 class MemoryStore(KVStore):
     def __init__(self):
         self._data: dict[bytes, dict[bytes, bytes]] = {}
-        self._lock = threading.Lock()
+        self._lock = TimedLock("kv.store")
 
     def get(self, column, key):
         with self._lock:
@@ -65,7 +67,7 @@ class SqliteStore(KVStore):
 
     def __init__(self, path: str):
         self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.Lock()
+        self._lock = TimedLock("kv.store")
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.execute(

@@ -16,6 +16,8 @@ import json
 import sqlite3
 import threading
 
+from lighthouse_tpu.common.locks import TimedLock
+
 
 class SlashingError(Exception):
     pass
@@ -24,7 +26,7 @@ class SlashingError(Exception):
 class SlashingProtectionDB:
     def __init__(self, path: str = ":memory:"):
         self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.Lock()
+        self._lock = TimedLock("slashing_protection.db")
         with self._lock:
             c = self._conn
             c.execute(
