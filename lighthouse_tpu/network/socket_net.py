@@ -17,21 +17,30 @@ Wire format (all little-endian):
   RPC_RSP (4): [u32 req_id][u8 status][chunks]  — chunk := [u32 len][data]
   SUB     (5): JSON {topics}                    — interest update
 
-Gossip propagation floods to all interested peers with message-id dedup
-(gossipsub's mesh degenerates to flood at the handful-of-peers scale the
-tests run); scores accumulate per peer and a banned peer's connection is
-dropped (peer_manager ban semantics).
+Gossip propagation is MESH-based (gossipsub's GRAFT/PRUNE control plane,
+behaviour/mod.rs:148): a per-topic mesh of target degree D is maintained
+by a heartbeat (graft under-degree, prune over-degree), messages forward
+to mesh peers only once the mesh has formed (flood-to-interested is the
+bootstrap fallback below D_lo so delivery never stalls), with message-id
+dedup; scores accumulate per peer and a banned peer's connection is
+dropped and un-meshed (peer_manager ban semantics).
 
-UDP discovery: a one-datagram PING {node_id, tcp_port} answered by PONG
-{node_id, tcp_port, known: [[host, port], ...]} — the discv5
-FINDNODE/NODES exchange collapsed to one hop (discovery/mod.rs's role:
-learn dialable peers from a bootstrap address).
+UDP discovery: PING {node_id, tcp_port} answered by PONG {node_id,
+tcp_port, known: [[host, tcp, udp], ...]} — and `discover` walks the
+known-lists breadth-first over MULTIPLE hops (the discv5
+FINDNODE/NODES iteration, discovery/mod.rs), so a node knowing only a
+bootstrap address learns the whole reachable topology.
 """
+
+import random
 
 import json
 import socket
 import struct
 import threading
+import time
+
+from lighthouse_tpu.common.locks import TimedLock
 
 from lighthouse_tpu.network.gossip import (
     BAN_THRESHOLD,
@@ -55,6 +64,14 @@ KIND_GOSSIP = 2
 KIND_RPC_REQ = 3
 KIND_RPC_RSP = 4
 KIND_SUB = 5
+KIND_GRAFT = 6
+KIND_PRUNE = 7
+
+# gossipsub mesh parameters (behaviour/mod.rs:148 config: D/D_lo/D_hi)
+MESH_D = 4
+MESH_D_LO = 2
+MESH_D_HI = 8
+HEARTBEAT_INTERVAL = 1.0
 
 # Dedup-cache generation size: at mainnet gossip rates (~tens of msgs/s)
 # one generation covers several minutes — comfortably past the reference
@@ -86,9 +103,10 @@ class _PeerConn:
         self.node_id = node_id
         self.topics: set[str] = set()
         self.score = 0.0
-        self.lock = threading.Lock()
+        self.lock = TimedLock("socket_net.peer_send")
         self.alive = True
         self.listen_port = None
+        self.udp_port = None
 
     def close(self):
         self.alive = False
@@ -163,11 +181,15 @@ class SocketNet:
         # older than one full generation.
         self._seen: set[bytes] = set()
         self._seen_prev: set[bytes] = set()
-        self._seen_lock = threading.Lock()
+        self._seen_lock = TimedLock("socket_net.seen")
         self._pending: dict[int, tuple[threading.Event, list]] = {}
         self._req_id = 0
-        self._req_lock = threading.Lock()
+        self._req_lock = TimedLock("socket_net.rpc_req")
         self._stopping = False
+        # per-topic gossip mesh (gossipsub GRAFT/PRUNE control plane)
+        self._mesh: dict[str, set[str]] = {}
+        self._mesh_lock = TimedLock("socket_net.mesh")
+        self._rng = random.Random(node_id)
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(
@@ -183,6 +205,7 @@ class SocketNet:
         self._udp.bind((host, 0))
         self.udp_port = self._udp.getsockname()[1]
         threading.Thread(target=self._udp_loop, daemon=True).start()
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
 
     # -------------------------------------------------- GossipHub surface
 
@@ -245,14 +268,14 @@ class SocketNet:
     def rpc_client(self, peer_id: str) -> RpcClientProxy:
         return RpcClientProxy(self, peer_id)
 
-    def discover(self, host: str, udp_port: int):
-        """UDP ping a bootstrap node; connect to it and every peer it
-        knows (one-hop discv5)."""
+    def _udp_ping(self, host: str, udp_port: int):
+        """One PING/PONG exchange; returns the parsed pong or None."""
         ping = json.dumps(
             {
                 "op": "ping",
                 "node_id": self.node_id,
                 "tcp_port": self.tcp_port,
+                "udp_port": self.udp_port,
             }
         ).encode()
         # a throwaway socket: the bound listener's recvfrom loop would
@@ -262,22 +285,62 @@ class SocketNet:
         try:
             probe.sendto(ping, (host, udp_port))
             data, _addr = probe.recvfrom(65536)
-            pong = json.loads(data)
+            return json.loads(data)
         except (OSError, ValueError):
-            return []
+            return None
         finally:
             probe.close()
+
+    def discover(
+        self, host: str, udp_port: int, max_hops: int = 3,
+        max_peers: int = 32,
+    ):
+        """Breadth-first multi-hop discovery from a bootstrap address
+        (discv5's iterative FINDNODE/NODES, discovery/mod.rs): ping the
+        frontier, learn each pong's known peers, dial every new TCP
+        listener, and keep walking until the topology is exhausted,
+        `max_hops` rings out, or `max_peers` connections."""
         connected = []
-        for peer_host, tcp_port in [
-            [host, pong.get("tcp_port")]
-        ] + pong.get("known", []):
-            if tcp_port is None:
-                continue
-            try:
-                pid = self.connect(peer_host, tcp_port)
-                connected.append(pid)
-            except OSError:
-                continue
+        seen_udp = {(host, udp_port)}
+        frontier = [(host, udp_port)]
+        # never re-dial peers we already hold a connection to: a
+        # duplicate HELLO would replace the peers entry and orphan the
+        # old socket + its reader thread
+        dialed_tcp = {
+            (self.host, c.listen_port)
+            for c in list(self.peers.values())
+            if c.alive and c.listen_port
+        }
+        for _hop in range(max_hops):
+            if not frontier or len(connected) >= max_peers:
+                break
+            next_frontier = []
+            for ping_host, ping_udp in frontier:
+                pong = self._udp_ping(ping_host, ping_udp)
+                if pong is None:
+                    continue
+                entries = [[ping_host, pong.get("tcp_port"), None]]
+                for entry in pong.get("known", []):
+                    # tolerate both [host, tcp] and [host, tcp, udp]
+                    e = list(entry) + [None] * (3 - len(entry))
+                    entries.append(e[:3])
+                for peer_host, tcp_port, peer_udp in entries:
+                    if tcp_port is None:
+                        continue
+                    key = (peer_host, tcp_port)
+                    if key not in dialed_tcp and tcp_port != self.tcp_port:
+                        dialed_tcp.add(key)
+                        if len(connected) < max_peers:
+                            try:
+                                connected.append(
+                                    self.connect(peer_host, tcp_port)
+                                )
+                            except OSError:
+                                pass
+                    if peer_udp and (peer_host, peer_udp) not in seen_udp:
+                        seen_udp.add((peer_host, peer_udp))
+                        next_frontier.append((peer_host, peer_udp))
+            frontier = next_frontier
         return connected
 
     def close(self):
@@ -298,6 +361,7 @@ class SocketNet:
                 "node_id": self.node_id,
                 "topics": sorted(self.local_topics),
                 "tcp_port": self.tcp_port,
+                "udp_port": self.udp_port,
             }
         ).encode()
 
@@ -314,6 +378,7 @@ class SocketNet:
         conn.node_id = doc["node_id"]
         conn.topics.update(doc.get("topics", []))
         conn.listen_port = doc.get("tcp_port")
+        conn.udp_port = doc.get("udp_port")
         self.peers[conn.node_id] = conn
         if self.on_peer_connected is not None:
             self.on_peer_connected(conn.node_id)
@@ -374,6 +439,10 @@ class SocketNet:
             self._fanout(topic_str, payload, exclude=conn.node_id)
         elif kind == KIND_SUB:
             conn.topics.update(json.loads(body).get("topics", []))
+        elif kind == KIND_GRAFT:
+            self._handle_graft(conn, json.loads(body).get("topics", []))
+        elif kind == KIND_PRUNE:
+            self._handle_prune(conn, json.loads(body).get("topics", []))
         elif kind == KIND_RPC_REQ:
             threading.Thread(
                 target=self._serve_rpc,
@@ -400,11 +469,20 @@ class SocketNet:
             + topic_str.encode()
             + payload
         )
+        with self._mesh_lock:
+            mesh = set(self._mesh.get(topic_str, ()))
+        mesh.discard(exclude)
+        use_mesh = len(mesh) >= MESH_D_LO
         sent = 0
         for conn in list(self.peers.values()):
             if not conn.alive or conn.node_id == exclude:
                 continue
             if topic_str not in conn.topics:
+                continue
+            # mesh-formed: forward along mesh links only; pre-mesh
+            # bootstrap: flood to every interested peer so delivery
+            # never stalls while grafting catches up
+            if use_mesh and conn.node_id not in mesh:
                 continue
             try:
                 _send_frame(conn.sock, conn.lock, KIND_GOSSIP, body)
@@ -412,6 +490,83 @@ class SocketNet:
             except OSError:
                 self._drop(conn)
         return sent
+
+    # ------------------------------------------------------------ mesh
+
+    def mesh_peers(self, topic_str: str) -> set:
+        with self._mesh_lock:
+            return set(self._mesh.get(topic_str, ()))
+
+    def _heartbeat_loop(self):
+        while not self._stopping:
+            time.sleep(HEARTBEAT_INTERVAL)
+            try:
+                self._maintain_mesh()
+            except Exception:
+                pass  # the heartbeat must survive transient peer churn
+
+    def _maintain_mesh(self):
+        """Gossipsub heartbeat: graft under-degree topics up toward D,
+        prune over-degree ones down from D_hi."""
+        for topic in list(self.local_topics):
+            interested = {
+                pid
+                for pid, c in list(self.peers.items())
+                if c.alive and topic in c.topics
+            }
+            graft_to, prune_from = [], []
+            with self._mesh_lock:
+                mesh = self._mesh.setdefault(topic, set())
+                mesh &= interested  # forget dead/unsubscribed peers
+                if len(mesh) < MESH_D:
+                    candidates = list(interested - mesh)
+                    self._rng.shuffle(candidates)
+                    take = candidates[: MESH_D - len(mesh)]
+                    mesh.update(take)
+                    graft_to = take
+                elif len(mesh) > MESH_D_HI:
+                    extras = list(mesh)
+                    self._rng.shuffle(extras)
+                    prune_from = extras[: len(mesh) - MESH_D]
+                    mesh.difference_update(prune_from)
+            for pid in graft_to:
+                self._send_control(pid, KIND_GRAFT, topic)
+            for pid in prune_from:
+                self._send_control(pid, KIND_PRUNE, topic)
+
+    def _send_control(self, peer_id: str, kind: int, topic: str):
+        conn = self.peers.get(peer_id)
+        if conn is None or not conn.alive:
+            return
+        try:
+            _send_frame(
+                conn.sock,
+                conn.lock,
+                kind,
+                json.dumps({"topics": [topic]}).encode(),
+            )
+        except OSError:
+            self._drop(conn)
+
+    def _handle_graft(self, conn: _PeerConn, topics):
+        for topic in topics:
+            if topic not in self.local_topics:
+                self._send_control(conn.node_id, KIND_PRUNE, topic)
+                continue
+            with self._mesh_lock:
+                mesh = self._mesh.setdefault(topic, set())
+                if len(mesh) >= MESH_D_HI and conn.node_id not in mesh:
+                    over = True
+                else:
+                    mesh.add(conn.node_id)
+                    over = False
+            if over:
+                self._send_control(conn.node_id, KIND_PRUNE, topic)
+
+    def _handle_prune(self, conn: _PeerConn, topics):
+        with self._mesh_lock:
+            for topic in topics:
+                self._mesh.get(topic, set()).discard(conn.node_id)
 
     # ---------------------------------------------------------------- rpc
 
@@ -501,6 +656,9 @@ class SocketNet:
         conn.close()
         if conn.node_id and self.peers.get(conn.node_id) is conn:
             del self.peers[conn.node_id]
+            with self._mesh_lock:
+                for mesh in self._mesh.values():
+                    mesh.discard(conn.node_id)
 
     # ---------------------------------------------------------- discovery
 
@@ -516,8 +674,11 @@ class SocketNet:
                 continue
             if doc.get("op") == "ping":
                 # advertise peers by the LISTEN ports learned in HELLO
+                # ([host, tcp, udp] — udp lets the pinger keep walking)
                 known = [
-                    [self.host, p] for p in self._known_listen_ports()
+                    [self.host, c.listen_port, c.udp_port]
+                    for c in list(self.peers.values())
+                    if c.alive and c.listen_port
                 ]
                 pong = json.dumps(
                     {
@@ -532,9 +693,3 @@ class SocketNet:
                 except OSError:
                     pass
 
-    def _known_listen_ports(self):
-        return [
-            c.listen_port
-            for c in self.peers.values()
-            if getattr(c, "listen_port", None)
-        ]

@@ -74,6 +74,13 @@ def main():
                         node.chain.head_state.finalized_checkpoint.epoch
                     ),
                     "peers": len(net.peers),
+                    "mesh": max(
+                        (
+                            len(net.mesh_peers(t))
+                            for t in net.local_topics
+                        ),
+                        default=0,
+                    ),
                 }
             ),
             flush=True,
@@ -96,6 +103,11 @@ def main():
                 "head_root": node.chain.head_root.hex(),
                 "finalized_epoch": (
                     node.chain.head_state.finalized_checkpoint.epoch
+                ),
+                "peers": len(net.peers),
+                "mesh": max(
+                    (len(net.mesh_peers(t)) for t in net.local_topics),
+                    default=0,
                 ),
             }
         ),

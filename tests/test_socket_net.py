@@ -208,3 +208,85 @@ def test_follower_kill_and_rejoin_resync():
         rejoin.kill()
     finally:
         producer.kill()
+
+
+def test_multihop_discovery_and_mesh_in_process():
+    """Unit layer: C knows only B's UDP; B knows A. C's breadth-first
+    discovery walks B -> A (2 hops) and connects both; heartbeats graft a
+    mesh on the shared topic (GRAFT/PRUNE control plane)."""
+    from lighthouse_tpu.network.socket_net import SocketNet
+    from lighthouse_tpu.types.containers import types_for
+
+    spec = minimal_spec()
+    t = types_for(spec)
+    topic = "/eth2/00000000/beacon_block/ssz_snappy"
+    nets = [SocketNet(n, t, spec) for n in ("A", "B", "C")]
+    a, b, c = nets
+    try:
+        for n in nets:
+            n.join(n.node_id, lambda *args: None)
+            n.subscribe(n.node_id, topic)
+        b.connect("127.0.0.1", a.tcp_port)
+        time.sleep(0.2)
+        # C only knows B's UDP endpoint
+        connected = c.discover("127.0.0.1", b.udp_port)
+        assert len(connected) == 2, connected  # B at hop 1, A at hop 2
+        assert set(c.peers) == {"A", "B"}
+
+        # heartbeats graft everyone into everyone's mesh (N-1 < D)
+        deadline = time.time() + 8
+        while time.time() < deadline and not all(
+            len(n.mesh_peers(topic)) == 2 for n in nets
+        ):
+            time.sleep(0.1)
+        for n in nets:
+            assert len(n.mesh_peers(topic)) == 2, (
+                n.node_id,
+                n.mesh_peers(topic),
+            )
+
+        # a banned peer is dropped AND un-meshed
+        a.report("B", -1000.0)
+        assert "B" not in a.peers
+        assert "B" not in a.mesh_peers(topic)
+    finally:
+        for n in nets:
+            n.close()
+
+
+@pytest.mark.slow
+def test_five_process_bootstrap_chain_finalizes_with_mesh():
+    """Five OS processes in a discovery CHAIN (each new node knows only
+    the previous node's UDP endpoint — reaching the producer requires
+    multi-hop walking): all finalize the same head with >= 3 mesh
+    peers each (behaviour/mod.rs:148 mesh + discovery/mod.rs role)."""
+    n_slots = 5 * 8
+    producer = _spawn("producer", 16, n_slots)
+    ready = [_read_json(producer)]
+    procs = [producer]
+    try:
+        for i in range(4):
+            f = _spawn(
+                "follower", 16, n_slots, boot_udp=ready[-1]["udp"]
+            )
+            ready.append(_read_json(f))
+            procs.append(f)
+        for _ in range(n_slots):
+            statuses = []
+            for p in procs:
+                p.stdin.write("\n")
+                p.stdin.flush()
+                statuses.append(_read_json(p))
+        dones = []
+        for p in procs:
+            dones.append(_read_json(p))
+        head_roots = {d["head_root"] for d in dones}
+        assert len(head_roots) == 1, dones
+        for d in dones:
+            assert d["done"]
+            assert d["finalized_epoch"] >= 1, dones
+            assert d["peers"] == 4, dones
+            assert d["mesh"] >= 3, dones
+    finally:
+        for p in procs:
+            p.kill()
