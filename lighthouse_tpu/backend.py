@@ -35,9 +35,36 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         cache_dir = os.environ.get("LIGHTHOUSE_TPU_CACHE_DIR") or os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             ".jax_cache",
+            _host_fingerprint(),
         )
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def _host_fingerprint() -> str:
+    """Per-host cache subdirectory key. The jax CPU AOT cache key does
+    NOT fully capture the host's CPU features: an entry compiled on a
+    machine with different vector extensions SIGSEGVs on load here
+    (observed: a cache populated on an amx/avx10-capable builder crashed
+    pytest on this host inside get_executable_and_time). Keying the
+    directory by the CPU-flag set makes entries from other machines
+    invisible instead of fatal."""
+    import hashlib
+    import platform
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    digest = hashlib.sha256(
+        (platform.machine() + "|" + flags).encode()
+    ).hexdigest()[:16]
+    return f"host_{digest}"
 
 
 def tpu_probe_ok(timeout_s: float = 90.0) -> bool:

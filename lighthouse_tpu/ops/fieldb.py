@@ -234,31 +234,67 @@ def canon(x):
 # ------------------------------------------------------------- multiplies
 
 
+def use_mxu_conv() -> bool:
+    """Route the limb-product contractions through int8 MXU matmuls
+    (LIGHTHOUSE_TPU_MXU_CONV=1). Read at trace time — build fresh jitted
+    functions after flipping it."""
+    import os
+
+    return os.environ.get("LIGHTHOUSE_TPU_MXU_CONV") == "1"
+
+
+def _conv_contract(prod, conv_tensor):
+    """Contract per-limb products (..., I, J) int32 against a 0/1
+    convolution indicator (I, J, K) -> (..., K).
+
+    Default: one int32 einsum (VPU). MXU path: the products are
+    NON-NEGATIVE and < 2^28, so they decompose EXACTLY into four base-128
+    digits that fit int8; each digit is contracted against the (flattened)
+    indicator with an int8 x int8 -> int32 matmul — the op shape the MXU
+    runs at ~394 TOPS on v5e vs ~2T int32 op/s on the VPU (PERF_NOTES
+    plan item 2). Column sums stay < 2^31, so the recombination
+    sum(part_n << 7n) is exact in int32 and the result is bit-identical
+    to the VPU path (the relaxed-limb bound proofs are untouched)."""
+    conv = np.asarray(conv_tensor)
+    if not use_mxu_conv():
+        return jnp.einsum("...ij,ijk->...k", prod, jnp.asarray(conv))
+    flat = prod.reshape(prod.shape[:-2] + (-1,))
+    mat = jnp.asarray(
+        conv.reshape(-1, conv.shape[-1]).astype(np.int8)
+    )
+    out = None
+    x = flat
+    for n in range(4):  # 4 * 7 = 28 bits covers max product 4097^2
+        piece = (x & 127).astype(jnp.int8)
+        x = x >> 7
+        part = jnp.einsum(
+            "...x,xk->...k",
+            piece,
+            mat,
+            preferred_element_type=jnp.int32,
+        )
+        out = part if out is None else out + (part << (7 * n))
+    return out
+
+
 def mul_lazy(a, b):
     """Stacked Montgomery product over the slot axis: (..., S, NB) x
     (..., S, NB) -> (..., S, NB); inputs < 2.2p relaxed, output < 1.5p,
     limbs <= LIMB_RELAX."""
     t = _relax(
-        jnp.einsum(
-            "...ij,ijk->...k",
-            a[..., :, None] * b[..., None, :],
-            jnp.asarray(_CONV_FULL),
-        ),
+        _conv_contract(a[..., :, None] * b[..., None, :], _CONV_FULL),
         2 * NB,
     )
     t_low = t[..., :NLIMBS]
     m = _relax(
-        jnp.einsum(
-            "...ij,ijk->...k",
+        _conv_contract(
             t_low[..., :, None] * jnp.asarray(NPRIME_LIMBS)[None, :],
-            jnp.asarray(_CONV_LOW32),
+            _CONV_LOW32,
         ),
         NLIMBS,
     )
-    mp = jnp.einsum(
-        "...ij,ijk->...k",
-        m[..., :, None] * jnp.asarray(P_LIMBS32)[None, :],
-        jnp.asarray(_CONV_MP),
+    mp = _conv_contract(
+        m[..., :, None] * jnp.asarray(P_LIMBS32)[None, :], _CONV_MP
     )
     full = _relax(t + _pad_last(mp, 2 * NB - mp.shape[-1]), 2 * NB)
     # REDC carry across the R boundary: value(low 32 limbs) is exactly 0 or

@@ -46,10 +46,13 @@ SWEEP_COOLDOWN = 1800      # seconds after a successful sweep
 PROBE_TIMEOUT = 90
 MEASURE_TIMEOUT = 1500     # per-config deadline (fresh compile included)
 
-# (impl, n_sets) sweep — the Pallas/XLA A/B the verdict asks for.
+# (impl, n_sets) sweep — the Pallas/XLA A/B the verdict asks for, plus
+# the int8-MXU contraction variant.
 SWEEP = [
     ("xla", 1024),
     ("xla", 4096),
+    ("mxu", 1024),
+    ("mxu", 4096),
     ("pallas", 1024),
     ("pallas", 4096),
 ]

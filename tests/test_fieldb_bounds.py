@@ -135,3 +135,53 @@ def test_inv_and_pow(rng):
     prod = fb.mul_lazy(a_mont, ainv)
     got = fb.unpack_ints(fb.from_mont(prod))
     assert got == [1] * 4
+
+
+def test_mxu_conv_path_bit_identical(rng, monkeypatch):
+    """The int8-MXU contraction (LIGHTHOUSE_TPU_MXU_CONV=1) decomposes
+    the limb products into base-128 digits EXACTLY, so mul_lazy must be
+    bit-identical to the VPU einsum on adversarial near-bound inputs —
+    and the relaxed-limb invariant proofs carry over unchanged."""
+    vals = [rng.randrange(P) for _ in range(4)]
+    vals += [P - 1, 1, int(2.19 * P) - 7]
+    a = jnp.asarray(np.stack([relaxed_rep(v, rng) for v in vals]))
+    b = jnp.asarray(
+        np.stack([relaxed_rep(v, rng) for v in reversed(vals)])
+    )
+    # max-relaxed worst case: every limb at LIMB_RELAX (value > 2.2p is
+    # not a legal INPUT, but the contraction itself must stay exact
+    # through the largest possible products)
+    worst = np.full((1, fb.NB), fb.LIMB_RELAX, dtype=np.int32)
+
+    monkeypatch.delenv("LIGHTHOUSE_TPU_MXU_CONV", raising=False)
+    vpu = np.asarray(fb.mul_lazy(a, b))
+    vpu_t = np.asarray(fb._conv_contract(
+        jnp.asarray(worst)[..., :, None] * jnp.asarray(worst)[..., None, :],
+        fb._CONV_FULL,
+    ))
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU_CONV", "1")
+    mxu = np.asarray(fb.mul_lazy(a, b))
+    mxu_t = np.asarray(fb._conv_contract(
+        jnp.asarray(worst)[..., :, None] * jnp.asarray(worst)[..., None, :],
+        fb._CONV_FULL,
+    ))
+    assert (vpu == mxu).all()
+    assert (vpu_t == mxu_t).all()
+    check_invariant(mxu, "mxu mul output")
+
+
+def test_mxu_full_verify_path(rng, monkeypatch):
+    """End-to-end: a small verify_signature_sets batch under the MXU
+    contraction flag returns the same verdicts."""
+    import jax
+
+    from lighthouse_tpu import testing as td
+    from lighthouse_tpu.ops import batch_verify
+
+    args = td.make_signature_set_batch(4, max_keys=2, seed=3)
+    bad = td.make_signature_set_batch(4, max_keys=2, seed=3,
+                                      corrupt_indices=(2,))
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU_CONV", "1")
+    fn = jax.jit(batch_verify.verify_signature_sets)
+    assert bool(np.asarray(fn(*args)))
+    assert not bool(np.asarray(fn(*bad)))
