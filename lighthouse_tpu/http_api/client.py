@@ -163,6 +163,48 @@ class BeaconNodeHttpClient:
             q += f"&graffiti=0x{bytes(graffiti).hex()}"
         return self._get(f"/eth/v2/validator/blocks/{slot}{q}")
 
+    def get_committees(self, state_id="head", epoch=None, index=None,
+                       slot=None):
+        q = "&".join(
+            f"{k}={v}"
+            for k, v in (("epoch", epoch), ("index", index), ("slot", slot))
+            if v is not None
+        )
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/committees"
+            + (f"?{q}" if q else "")
+        )["data"]
+
+    def get_validator_balances(self, state_id="head", ids=None):
+        q = f"?id={','.join(str(i) for i in ids)}" if ids else ""
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/validator_balances{q}"
+        )["data"]
+
+    def get_fork(self, state_id="head"):
+        return self._get(f"/eth/v1/beacon/states/{state_id}/fork")["data"]
+
+    def get_spec(self):
+        return self._get("/eth/v1/config/spec")["data"]
+
+    def get_fork_schedule(self):
+        return self._get("/eth/v1/config/fork_schedule")["data"]
+
+    def get_block_root(self, block_id="head") -> bytes:
+        doc = self._get(f"/eth/v1/beacon/blocks/{block_id}/root")
+        return bytes.fromhex(doc["data"]["root"][2:])
+
+    def get_block_attestations(self, block_id="head"):
+        return self._get(
+            f"/eth/v1/beacon/blocks/{block_id}/attestations"
+        )["data"]
+
+    def get_node_identity(self):
+        return self._get("/eth/v1/node/identity")["data"]
+
+    def get_peers(self):
+        return self._get("/eth/v1/node/peers")
+
     def get_unsigned_blinded_block_json(
         self,
         slot: int,

@@ -24,6 +24,12 @@ Endpoints (the operative subset):
   GET  /eth/v1/validator/blinded_blocks/{slot}?randao_reveal=...
   POST /eth/v1/beacon/blinded_blocks
   POST /eth/v1/validator/register_validator
+  GET  /eth/v1/beacon/states/{id}/fork | committees | validator_balances
+       | sync_committees
+  GET  /eth/v1/beacon/blocks/{id}/root | attestations
+  GET  /eth/v1/config/spec | fork_schedule | deposit_contract
+  GET  /eth/v1/node/identity | peers | peer_count
+  GET  /lighthouse/health  (chain internals namespace)
   GET  /eth/v1/validator/attestation_data?slot=...&committee_index=...
   GET  /eth/v1/validator/aggregate_attestation?slot=...&attestation_data_root=...
   POST /eth/v1/validator/aggregate_and_proofs
@@ -49,9 +55,33 @@ class ApiError(Exception):
         self.message = message
 
 
+def _validator_status(v, balance: int, epoch: int) -> str:
+    """Standard validator status algorithm (the beacon-API state
+    machine): pending_initialized only while the deposit has no
+    eligibility epoch; withdrawal_done once the balance is gone."""
+    FAR = 2**64 - 1
+    if epoch < v.activation_epoch:
+        return (
+            "pending_initialized"
+            if v.activation_eligibility_epoch == FAR
+            else "pending_queued"
+        )
+    if epoch < v.exit_epoch:
+        if v.slashed:
+            return "active_slashed"
+        return (
+            "active_exiting" if v.exit_epoch < FAR else "active_ongoing"
+        )
+    if epoch < v.withdrawable_epoch:
+        return "exited_slashed" if v.slashed else "exited_unslashed"
+    return "withdrawal_done" if balance == 0 else "withdrawal_possible"
+
+
 class BeaconApiServer:
-    def __init__(self, chain, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, chain, host: str = "127.0.0.1", port: int = 0,
+                 net=None):
         self.chain = chain
+        self.net = net  # optional SocketNet for node/identity + peers
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -185,11 +215,59 @@ class BeaconApiServer:
                     "attestation-production cache statistics",
                 ).set(value)
             return (REGISTRY.render().encode(), "text/plain; version=0.0.4")
-        if parts[:3] == ["eth", "v1", "node"]:
+        if parts[:3] == ["eth", "v1", "node"] and len(parts) >= 4:
             if parts[3] == "version":
                 return {"data": {"version": VERSION}}
             if parts[3] == "health":
                 return {}
+            if parts[3] == "identity":
+                net = getattr(self, "net", None)
+                return {
+                    "data": {
+                        "peer_id": getattr(net, "node_id", "in-process"),
+                        "enr": "",
+                        "p2p_addresses": [
+                            f"/ip4/{net.host}/tcp/{net.tcp_port}"
+                        ]
+                        if net is not None
+                        else [],
+                        "discovery_addresses": [
+                            f"/ip4/{net.host}/udp/{net.udp_port}"
+                        ]
+                        if net is not None
+                        else [],
+                    }
+                }
+            if parts[3] == "peers" and len(parts) == 4:
+                net = getattr(self, "net", None)
+                peers = (
+                    [
+                        {
+                            "peer_id": pid,
+                            "state": "connected",
+                            "direction": "outbound",
+                        }
+                        # snapshot: network threads mutate peers
+                        for pid in list(getattr(net, "peers", {}))
+                    ]
+                    if net is not None
+                    else []
+                )
+                return {
+                    "data": peers,
+                    "meta": {"count": len(peers)},
+                }
+            if parts[3] == "peer_count":
+                net = getattr(self, "net", None)
+                n = len(getattr(net, "peers", {})) if net else 0
+                return {
+                    "data": {
+                        "connected": str(n),
+                        "connecting": "0",
+                        "disconnected": "0",
+                        "disconnecting": "0",
+                    }
+                }
             if parts[3] == "syncing":
                 return {
                     "data": {
@@ -213,6 +291,70 @@ class BeaconApiServer:
                 }
             if parts[3] == "states" and len(parts) >= 6:
                 state = self._resolve_state(parts[4])
+                if parts[5] == "fork":
+                    f = state.fork
+                    return {
+                        "data": {
+                            "previous_version": "0x"
+                            + bytes(f.previous_version).hex(),
+                            "current_version": "0x"
+                            + bytes(f.current_version).hex(),
+                            "epoch": str(f.epoch),
+                        }
+                    }
+                if parts[5] == "committees":
+                    return self._committees(state, self._query(path))
+                if parts[5] == "validator_balances":
+                    q = self._query(path)
+                    wanted = self._parse_validator_ids(state, q.get("id"))
+                    return {
+                        "data": [
+                            {"index": str(i), "balance": str(b)}
+                            for i, b in enumerate(state.balances)
+                            if wanted is None or i in wanted
+                        ]
+                    }
+                if parts[5] == "sync_committees":
+                    if not hasattr(state, "current_sync_committee"):
+                        raise ApiError(400, "pre-altair state")
+                    q = self._query(path)
+                    spec = chain.spec
+                    period = lambda e: (  # noqa: E731
+                        e // spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+                    )
+                    cur_epoch = spec.slot_to_epoch(state.slot)
+                    epoch = (
+                        int(q["epoch"]) if "epoch" in q else cur_epoch
+                    )
+                    if period(epoch) == period(cur_epoch):
+                        committee = state.current_sync_committee
+                    elif period(epoch) == period(cur_epoch) + 1:
+                        committee = state.next_sync_committee
+                    else:
+                        raise ApiError(
+                            400, f"epoch {epoch} outside known periods"
+                        )
+                    indices = [
+                        str(chain.pubkey_cache.index_of(bytes(pk)))
+                        for pk in committee.pubkeys
+                    ]
+                    # validator_aggregates: members grouped per
+                    # subcommittee (required by the API schema)
+                    sub = max(
+                        spec.SYNC_COMMITTEE_SIZE
+                        // spec.SYNC_COMMITTEE_SUBNET_COUNT,
+                        1,
+                    )
+                    aggregates = [
+                        indices[i : i + sub]
+                        for i in range(0, len(indices), sub)
+                    ]
+                    return {
+                        "data": {
+                            "validators": indices,
+                            "validator_aggregates": aggregates,
+                        }
+                    }
                 if parts[5] == "finality_checkpoints":
                     def cp(c):
                         return {
@@ -240,28 +382,24 @@ class BeaconApiServer:
                     }
                 if parts[5] == "validators":
                     q = self._query(path)
-                    wanted = None
-                    if "id" in q:
-                        wanted = set()
-                        for part in q["id"].split(","):
-                            if part.startswith("0x"):
-                                wanted.add(part.lower())
-                            else:
-                                wanted.add(int(part))
+                    wanted = self._parse_validator_ids(
+                        state, q.get("id")
+                    )
+                    epoch = chain.spec.slot_to_epoch(state.slot)
                     out = []
                     for i, v in enumerate(state.validators):
-                        pk_hex = "0x" + bytes(v.pubkey).hex()
-                        if wanted is not None and not (
-                            i in wanted or pk_hex in wanted
-                        ):
+                        if wanted is not None and i not in wanted:
                             continue
                         out.append(
                             {
                                 "index": str(i),
                                 "balance": str(state.balances[i]),
-                                "status": "active_ongoing",
+                                "status": _validator_status(
+                                    v, state.balances[i], epoch
+                                ),
                                 "validator": {
-                                    "pubkey": pk_hex,
+                                    "pubkey": "0x"
+                                    + bytes(v.pubkey).hex(),
                                     "effective_balance": str(
                                         v.effective_balance
                                     ),
@@ -278,6 +416,65 @@ class BeaconApiServer:
                 block = self._resolve_block(parts[4])
                 header = self._header_json(block)
                 return {"data": header}
+            if (
+                parts[3] == "blocks"
+                and len(parts) == 6
+                and parts[5] == "root"
+            ):
+                block = self._resolve_block(parts[4])
+                return {
+                    "data": {
+                        "root": "0x"
+                        + type(block.message)
+                        .hash_tree_root(block.message)
+                        .hex()
+                    }
+                }
+            if (
+                parts[3] == "blocks"
+                and len(parts) == 6
+                and parts[5] == "attestations"
+            ):
+                block = self._resolve_block(parts[4])
+                return {
+                    "data": [
+                        to_json(type(a), a)
+                        for a in block.message.body.attestations
+                    ]
+                }
+        if parts[:3] == ["eth", "v1", "config"] and len(parts) >= 4:
+            if parts[3] == "spec":
+                return {"data": self._spec_json()}
+            if parts[3] == "fork_schedule":
+                return {"data": self._fork_schedule()}
+            if parts[3] == "deposit_contract":
+                return {
+                    "data": {
+                        "chain_id": str(
+                            getattr(chain.spec, "DEPOSIT_CHAIN_ID", 1)
+                        ),
+                        "address": "0x" + "00" * 20,
+                    }
+                }
+        if parts[:3] == ["lighthouse", "tpu", "stats"] or parts[:2] == [
+            "lighthouse",
+            "health",
+        ]:
+            # lighthouse namespace analog: process + chain internals
+            return {
+                "data": {
+                    "metrics": dict(chain.metrics),
+                    "attester_cache": {
+                        "hits": chain.attester_cache.hits,
+                        "misses": chain.attester_cache.misses,
+                    },
+                    "proposer_cache": {
+                        "hits": chain.proposer_cache.hits,
+                        "misses": chain.proposer_cache.misses,
+                    },
+                    "snapshots": len(chain._snapshots),
+                }
+            }
         if parts[:3] == ["eth", "v2", "beacon"]:
             if parts[3] == "blocks" and len(parts) >= 5:
                 block = self._resolve_block(parts[4])
@@ -597,6 +794,118 @@ class BeaconApiServer:
                 "signature": "0x" + bytes(block.signature).hex(),
             },
         }
+
+    def _parse_validator_ids(self, state, raw):
+        """?id= parsing: indices and 0x pubkeys -> set of indices (the
+        standard API accepts both forms)."""
+        if raw is None:
+            return None
+        wanted = set()
+        for part in raw.split(","):
+            if part.startswith("0x"):
+                try:
+                    pk = bytes.fromhex(part[2:])
+                except ValueError:
+                    continue  # malformed id: matches nothing, not a 500
+                idx = self.chain.pubkey_cache.index_of(pk)
+                if idx is not None:
+                    wanted.add(idx)
+            else:
+                try:
+                    wanted.add(int(part))
+                except ValueError:
+                    continue
+        return wanted
+
+    def _committees(self, state, q):
+        """GET /eth/v1/beacon/states/{id}/committees — committee member
+        lists per (slot, index), filterable by epoch/index/slot
+        (http_api/src/lib.rs:920 region)."""
+        from lighthouse_tpu.state_processing.helpers import CommitteeCache
+
+        chain = self.chain
+        spec = chain.spec
+        current = spec.slot_to_epoch(state.slot)
+        epoch = int(q["epoch"]) if "epoch" in q else current
+        # the shuffling window: seeds beyond next epoch don't exist yet,
+        # and randao mixes wrap after EPOCHS_PER_HISTORICAL_VECTOR (the
+        # reference 400s outside the window rather than serving
+        # committees shuffled from a wrapped mix)
+        lookback = spec.EPOCHS_PER_HISTORICAL_VECTOR - 2
+        if epoch > current + 1 or (
+            current > lookback and epoch < current - lookback
+        ):
+            raise ApiError(400, f"epoch {epoch} outside shuffling window")
+        cache = CommitteeCache(state, epoch, spec)
+        want_index = int(q["index"]) if "index" in q else None
+        want_slot = int(q["slot"]) if "slot" in q else None
+        out = []
+        for slot in range(
+            spec.epoch_start_slot(epoch), spec.epoch_start_slot(epoch + 1)
+        ):
+            if want_slot is not None and slot != want_slot:
+                continue
+            for index in range(cache.committees_per_slot):
+                if want_index is not None and index != want_index:
+                    continue
+                committee = cache.get_beacon_committee(slot, index)
+                out.append(
+                    {
+                        "index": str(index),
+                        "slot": str(slot),
+                        "validators": [str(m) for m in committee],
+                    }
+                )
+        return {"data": out}
+
+    def _spec_json(self):
+        """GET /eth/v1/config/spec: the full two-tier config as decimal
+        strings / 0x-hex (config_and_preset in the reference)."""
+        import dataclasses
+
+        out = {}
+        for f in dataclasses.fields(self.chain.spec):
+            v = getattr(self.chain.spec, f.name)
+            if isinstance(v, bytes):
+                out[f.name] = "0x" + v.hex()
+            elif isinstance(v, int):
+                out[f.name] = str(v)
+            elif isinstance(v, str):
+                out[f.name] = v
+        return out
+
+    def _fork_schedule(self):
+        spec = self.chain.spec
+        sched = [
+            {
+                "previous_version": "0x"
+                + spec.GENESIS_FORK_VERSION.hex(),
+                "current_version": "0x" + spec.GENESIS_FORK_VERSION.hex(),
+                "epoch": "0",
+            }
+        ]
+        prev = spec.GENESIS_FORK_VERSION
+        for name, epoch_attr, ver_attr in (
+            ("altair", "ALTAIR_FORK_EPOCH", "ALTAIR_FORK_VERSION"),
+            (
+                "bellatrix",
+                "BELLATRIX_FORK_EPOCH",
+                "BELLATRIX_FORK_VERSION",
+            ),
+        ):
+            epoch = getattr(spec, epoch_attr, None)
+            ver = getattr(spec, ver_attr, None)
+            if epoch is None or ver is None or epoch >= 2**63:
+                continue
+            sched.append(
+                {
+                    "previous_version": "0x" + prev.hex(),
+                    "current_version": "0x" + ver.hex(),
+                    "epoch": str(epoch),
+                }
+            )
+            prev = ver
+        return sched
 
     def _proposer_duties(self, epoch: int):
         """Served from the chain's proposer cache — one whole-epoch

@@ -1,0 +1,156 @@
+"""Standard beacon-API surface beyond the VC hot path: committees,
+config, fork, balances, block sub-resources, node endpoints, validator
+statuses — the routes the reference serves from http_api/src/lib.rs that
+the HTTP-only VC (and any standard tooling) may hit.
+"""
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.harness import Harness
+from lighthouse_tpu.http_api.client import BeaconNodeHttpClient
+from lighthouse_tpu.http_api.server import (
+    BeaconApiServer,
+    _validator_status,
+)
+from lighthouse_tpu.state_processing.helpers import CommitteeCache
+from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH, minimal_spec
+
+
+@pytest.fixture(scope="module")
+def wire():
+    spec = minimal_spec(ALTAIR_FORK_EPOCH=0)
+    h = Harness(spec, 16)
+    chain = BeaconChain(h.state.copy(), spec, backend="ref")
+    for slot in range(1, spec.SLOTS_PER_EPOCH + 2):
+        chain.process_block(h.advance_slot_with_block(slot))
+        chain.set_slot(slot)
+    srv = BeaconApiServer(chain).start()
+    client = BeaconNodeHttpClient(f"http://127.0.0.1:{srv.port}")
+    yield spec, h, chain, client
+    srv.stop()
+
+
+def test_committees_match_committee_cache(wire):
+    spec, h, chain, client = wire
+    epoch = spec.slot_to_epoch(chain.head_state.slot)
+    data = client.get_committees(epoch=epoch)
+    assert data, "no committees served"
+    cache = CommitteeCache(chain.head_state, epoch, spec)
+    for entry in data:
+        committee = cache.get_beacon_committee(
+            int(entry["slot"]), int(entry["index"])
+        )
+        assert [int(v) for v in entry["validators"]] == list(committee)
+    # filters narrow the result
+    one_slot = client.get_committees(
+        epoch=epoch, slot=int(data[0]["slot"])
+    )
+    assert {e["slot"] for e in one_slot} == {data[0]["slot"]}
+
+
+def test_config_spec_and_fork_schedule(wire):
+    spec, h, chain, client = wire
+    doc = client.get_spec()
+    assert doc["SLOTS_PER_EPOCH"] == str(spec.SLOTS_PER_EPOCH)
+    assert doc["GENESIS_FORK_VERSION"] == (
+        "0x" + spec.GENESIS_FORK_VERSION.hex()
+    )
+    sched = client.get_fork_schedule()
+    assert sched[0]["epoch"] == "0"
+    # altair active at 0 in this spec -> appears in the schedule
+    assert any(
+        e["current_version"] == "0x" + spec.ALTAIR_FORK_VERSION.hex()
+        for e in sched
+    )
+    fork = client.get_fork()
+    assert fork["current_version"] == (
+        "0x" + bytes(chain.head_state.fork.current_version).hex()
+    )
+
+
+def test_balances_blockroot_attestations_node(wire):
+    spec, h, chain, client = wire
+    balances = client.get_validator_balances(ids=[0, 3])
+    assert {b["index"] for b in balances} == {"0", "3"}
+    assert int(balances[0]["balance"]) > 0
+
+    root = client.get_block_root("head")
+    assert root == chain.head_root
+    atts = client.get_block_attestations("head")
+    head_block = chain.store.get_block(chain.head_root)
+    assert len(atts) == len(head_block.message.body.attestations)
+
+    ident = client.get_node_identity()
+    assert ident["peer_id"] == "in-process"
+    peers = client.get_peers()
+    assert peers["meta"]["count"] == 0
+
+
+def test_sync_committees_endpoint(wire):
+    spec, h, chain, client = wire
+    doc = client._get(
+        "/eth/v1/beacon/states/head/sync_committees"
+    )["data"]
+    assert len(doc["validators"]) == spec.SYNC_COMMITTEE_SIZE
+    assert all(int(v) < 16 for v in doc["validators"])
+    # required schema field: members grouped per subcommittee
+    aggs = doc["validator_aggregates"]
+    assert [v for g in aggs for v in g] == doc["validators"]
+    assert len(aggs) == spec.SYNC_COMMITTEE_SUBNET_COUNT
+    # an epoch beyond the next period is a 400, not wrong data
+    from lighthouse_tpu.http_api.client import ApiClientError
+
+    far = 3 * spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    with pytest.raises(ApiClientError):
+        client._get(
+            f"/eth/v1/beacon/states/head/sync_committees?epoch={far}"
+        )
+
+
+def test_committee_window_and_malformed_ids(wire):
+    spec, h, chain, client = wire
+    from lighthouse_tpu.http_api.client import ApiClientError
+
+    current = spec.slot_to_epoch(chain.head_state.slot)
+    with pytest.raises(ApiClientError):
+        client.get_committees(epoch=current + 2)
+    # malformed 0x id matches nothing (the API's behavior, not a 500)
+    served = client._get(
+        "/eth/v1/beacon/states/head/validators?id=0xzz"
+    )["data"]
+    assert served == []
+    # bare prefixes 404 rather than 500
+    with pytest.raises(ApiClientError):
+        client._get("/eth/v1/config")
+
+
+def test_validator_status_machine(wire):
+    spec, h, chain, client = wire
+    v = chain.head_state.validators[0].copy()
+    FAR = FAR_FUTURE_EPOCH
+    bal = 32_000_000_000
+    v.activation_eligibility_epoch = FAR
+    v.activation_epoch = FAR
+    v.exit_epoch = FAR
+    v.withdrawable_epoch = FAR
+    assert _validator_status(v, bal, 3) == "pending_initialized"
+    v.activation_eligibility_epoch = 0
+    assert _validator_status(v, bal, 3) == "pending_queued"
+    v.activation_epoch = 2
+    assert _validator_status(v, bal, 3) == "active_ongoing"
+    v.exit_epoch = 9
+    assert _validator_status(v, bal, 3) == "active_exiting"
+    v.slashed = True
+    assert _validator_status(v, bal, 3) == "active_slashed"
+    v.withdrawable_epoch = 20
+    assert _validator_status(v, bal, 10) == "exited_slashed"
+    v.slashed = False
+    assert _validator_status(v, bal, 10) == "exited_unslashed"
+    assert _validator_status(v, bal, 25) == "withdrawal_possible"
+    assert _validator_status(v, 0, 25) == "withdrawal_done"
+
+    served = client._get(
+        "/eth/v1/beacon/states/head/validators?id=0"
+    )["data"]
+    assert served[0]["status"] == "active_ongoing"
