@@ -170,6 +170,23 @@ class CurveGroup:
         return acc
 
     def in_subgroup(self, pt):
+        """[r]P == inf — the deserialization-time subgroup policy check
+        (blst.rs key_validate / sig subgroup). Routed through the native
+        C ladder (native/g2decomp.c, ~40x the Python scalar mul) with
+        this Python path as fallback and ground truth."""
+        if self.is_infinity(pt):
+            return True
+        from lighthouse_tpu.native import g2decomp
+
+        if g2decomp.available():
+            aff = self.to_affine(pt)
+            got = (
+                g2decomp.g1_in_subgroup(aff[0], aff[1])
+                if self.name == "G1"
+                else g2decomp.g2_in_subgroup(aff[0], aff[1])
+            )
+            if got is not None:
+                return got
         return self.is_infinity(self.mul_scalar(pt, R))
 
     def clear_cofactor(self, pt):

@@ -59,7 +59,8 @@ def _validator_status(v, balance: int, epoch: int) -> str:
     """Standard validator status algorithm (the beacon-API state
     machine): pending_initialized only while the deposit has no
     eligibility epoch; withdrawal_done once the balance is gone."""
-    FAR = 2**64 - 1
+    from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH as FAR
+
     if epoch < v.activation_epoch:
         return (
             "pending_initialized"
@@ -306,7 +307,7 @@ class BeaconApiServer:
                     return self._committees(state, self._query(path))
                 if parts[5] == "validator_balances":
                     q = self._query(path)
-                    wanted = self._parse_validator_ids(state, q.get("id"))
+                    wanted = self._parse_validator_ids(q.get("id"))
                     return {
                         "data": [
                             {"index": str(i), "balance": str(b)}
@@ -323,9 +324,8 @@ class BeaconApiServer:
                         e // spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
                     )
                     cur_epoch = spec.slot_to_epoch(state.slot)
-                    epoch = (
-                        int(q["epoch"]) if "epoch" in q else cur_epoch
-                    )
+                    qe = self._int_q(q, "epoch")
+                    epoch = qe if qe is not None else cur_epoch
                     if period(epoch) == period(cur_epoch):
                         committee = state.current_sync_committee
                     elif period(epoch) == period(cur_epoch) + 1:
@@ -382,9 +382,7 @@ class BeaconApiServer:
                     }
                 if parts[5] == "validators":
                     q = self._query(path)
-                    wanted = self._parse_validator_ids(
-                        state, q.get("id")
-                    )
+                    wanted = self._parse_validator_ids(q.get("id"))
                     epoch = chain.spec.slot_to_epoch(state.slot)
                     out = []
                     for i, v in enumerate(state.validators):
@@ -651,6 +649,20 @@ class BeaconApiServer:
     # ------------------------------------------------------------ helpers
 
     @staticmethod
+    def _int_q(q: dict, name: str):
+        """Integer query param or a 400 (the API's invalid-param code,
+        never a 500); None when absent."""
+        if name not in q:
+            return None
+        try:
+            v = int(q[name])
+        except ValueError:
+            raise ApiError(400, f"invalid {name} {q[name]!r}") from None
+        if v < 0:
+            raise ApiError(400, f"negative {name}")
+        return v
+
+    @staticmethod
     def _query(path: str) -> dict:
         from urllib.parse import parse_qs, urlparse
 
@@ -795,7 +807,7 @@ class BeaconApiServer:
             },
         }
 
-    def _parse_validator_ids(self, state, raw):
+    def _parse_validator_ids(self, raw):
         """?id= parsing: indices and 0x pubkeys -> set of indices (the
         standard API accepts both forms)."""
         if raw is None:
@@ -826,7 +838,8 @@ class BeaconApiServer:
         chain = self.chain
         spec = chain.spec
         current = spec.slot_to_epoch(state.slot)
-        epoch = int(q["epoch"]) if "epoch" in q else current
+        qe = self._int_q(q, "epoch")
+        epoch = qe if qe is not None else current
         # the shuffling window: seeds beyond next epoch don't exist yet,
         # and randao mixes wrap after EPOCHS_PER_HISTORICAL_VECTOR (the
         # reference 400s outside the window rather than serving
@@ -837,8 +850,14 @@ class BeaconApiServer:
         ):
             raise ApiError(400, f"epoch {epoch} outside shuffling window")
         cache = CommitteeCache(state, epoch, spec)
-        want_index = int(q["index"]) if "index" in q else None
-        want_slot = int(q["slot"]) if "slot" in q else None
+        want_index = self._int_q(q, "index")
+        want_slot = self._int_q(q, "slot")
+        if want_slot is not None and spec.slot_to_epoch(
+            want_slot
+        ) != epoch:
+            raise ApiError(
+                400, f"slot {want_slot} not in epoch {epoch}"
+            )
         out = []
         for slot in range(
             spec.epoch_start_slot(epoch), spec.epoch_start_slot(epoch + 1)

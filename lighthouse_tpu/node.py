@@ -105,6 +105,17 @@ class BeaconNode:
 
     # ---------------------------------------------------------- transport
 
+    def start_http_api(self, host: str = "127.0.0.1", port: int = 0):
+        """Serve the beacon REST API for this node; the socket transport
+        (when attached) backs /eth/v1/node/identity, peers, peer_count."""
+        from lighthouse_tpu.http_api.server import BeaconApiServer
+
+        net = self.hub if hasattr(self.hub, "tcp_port") else None
+        self.http = BeaconApiServer(
+            self.chain, host=host, port=port, net=net
+        ).start()
+        return self.http
+
     def _topic_name(self, topic_str: str) -> str:
         return topic_str.split("/")[3]
 

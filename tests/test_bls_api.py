@@ -158,3 +158,79 @@ def test_verify_signature_set_batches_streaming():
     # subgroup-valid signatures, so its reject is a device verdict
     assert stats["dispatched"] == 3
     assert stats["host_marshal_ms"] > 0
+
+
+def test_native_decompression_matches_python():
+    """native/g2decomp.c vs the pure-Python sqrt path: identical
+    decompression results on valid points, identical rejections on
+    non-curve x, across G1 and G2 (the sort flag normalizes whichever
+    root family the backend returns)."""
+    import random
+
+    from lighthouse_tpu.bls import point_serde as ps
+    from lighthouse_tpu.crypto.ref_curve import G1 as RG1, G2 as RG2
+    from lighthouse_tpu.native import g2decomp
+
+    if not g2decomp.available():
+        import pytest
+
+        pytest.skip("native g2decomp unavailable")
+
+    rnd = random.Random(9)
+    for k in (rnd.randrange(2, 2**200) for _ in range(4)):
+        for group, compress, decompress in (
+            (RG1, ps.g1_compress, ps.g1_decompress),
+            (RG2, ps.g2_compress, ps.g2_decompress),
+        ):
+            pt = group.mul_scalar(group.generator, k)
+            data = compress(pt)
+            native_pt = decompress(data)
+            # force the Python fallback and compare exactly
+            g2decomp._lib_failed, saved = True, g2decomp._lib
+            g2decomp._lib = None
+            try:
+                py_pt = decompress(data)
+            finally:
+                g2decomp._lib, g2decomp._lib_failed = saved, False
+            assert group.to_affine(native_pt) == group.to_affine(py_pt)
+            assert compress(native_pt) == data  # roundtrip
+    # not-on-curve x rejected identically
+    bad_g2 = bytearray(ps.g2_compress(RG2.mul_scalar(RG2.generator, 5)))
+    bad_g2[-1] ^= 0x01
+    for _ in range(4):  # find an x off the curve (half are)
+        try:
+            ps.g2_decompress(bytes(bad_g2))
+            bad_g2[-1] += 1
+        except ps.DecodeError:
+            break
+    else:
+        raise AssertionError("never found an off-curve x")
+
+
+def test_native_subgroup_checks_match_python():
+    """native in-subgroup ladders vs the Python [r]P ground truth, on
+    r-torsion points AND adversarial pre-cofactor-clear curve points."""
+    import random
+
+    from lighthouse_tpu.bls.hash_to_curve import (
+        hash_to_field_fp2,
+        iso_map,
+        map_to_curve_sswu,
+    )
+    from lighthouse_tpu.crypto.ref_curve import G1 as RG1, G2 as RG2
+    from lighthouse_tpu.native import g2decomp
+
+    if not g2decomp.available():
+        pytest.skip("native g2decomp unavailable")
+    rnd = random.Random(11)
+    for k in (1, 7, rnd.randrange(2, R)):
+        assert g2decomp.g1_in_subgroup(
+            *RG1.to_affine(RG1.mul_scalar(RG1.generator, k))
+        )
+        assert g2decomp.g2_in_subgroup(
+            *RG2.to_affine(RG2.mul_scalar(RG2.generator, k))
+        )
+    for i in range(3):
+        u = hash_to_field_fp2(bytes([i]) + b"probe", 2)
+        pt = iso_map(map_to_curve_sswu(u[0]))
+        assert g2decomp.g2_in_subgroup(pt[0], pt[1]) is False

@@ -154,3 +154,53 @@ def test_validator_status_machine(wire):
         "/eth/v1/beacon/states/head/validators?id=0"
     )["data"]
     assert served[0]["status"] == "active_ongoing"
+
+
+def test_node_endpoints_backed_by_socket_net(wire):
+    """A node with the socket transport attached serves its real peer
+    list and addresses (node.start_http_api wires the net through)."""
+    import time as _time
+
+    from lighthouse_tpu.node import BeaconNode
+
+    spec = minimal_spec(ALTAIR_FORK_EPOCH=2**64 - 1)
+    h = Harness(spec, 16)
+    h.backend = "fake"
+    a = BeaconNode("peer-a", h.state.copy(), spec, backend="fake")
+    b = BeaconNode("peer-b", h.state.copy(), spec, backend="fake")
+    net_a = a.attach_socket_net()
+    net_b = b.attach_socket_net()
+    net_b.connect("127.0.0.1", net_a.tcp_port)
+    deadline = _time.time() + 5
+    while _time.time() < deadline and not net_a.peers:
+        _time.sleep(0.01)
+    srv = a.start_http_api()
+    try:
+        client = BeaconNodeHttpClient(f"http://127.0.0.1:{srv.port}")
+        ident = client.get_node_identity()
+        assert ident["peer_id"] == "peer-a"
+        assert str(net_a.tcp_port) in ident["p2p_addresses"][0]
+        peers = client.get_peers()
+        assert peers["meta"]["count"] == 1
+        assert peers["data"][0]["peer_id"] == "peer-b"
+    finally:
+        srv.stop()
+        net_a.close()
+        net_b.close()
+
+
+def test_query_param_validation(wire):
+    spec, h, chain, client = wire
+    from lighthouse_tpu.http_api.client import ApiClientError
+
+    for path in (
+        "/eth/v1/beacon/states/head/committees?epoch=abc",
+        "/eth/v1/beacon/states/head/committees?slot=-1",
+        "/eth/v1/beacon/states/head/sync_committees?epoch=x",
+        # slot outside the requested epoch is a 400, not an empty 200
+        f"/eth/v1/beacon/states/head/committees?epoch=1&slot="
+        f"{3 * spec.SLOTS_PER_EPOCH}",
+    ):
+        with pytest.raises(ApiClientError) as ei:
+            client._get(path)
+        assert "400" in str(ei.value), path
