@@ -150,15 +150,26 @@ def _git_head() -> str:
 def sweep() -> int:
     """Run the full A/B sweep; returns number of successful measurements."""
     n_ok = 0
+    n_fail = 0
     cache_dir = tempfile.mkdtemp(prefix="jaxcache_tpu_")
     try:
-        for impl, n_sets in SWEEP:
+        for i, (impl, n_sets) in enumerate(SWEEP):
             if os.path.exists(STOP_FILE):
+                break
+            # The tunnel dies MID-sweep routinely (observed: config 1
+            # lands, configs 2..6 each hang out their full per-config
+            # deadline = 2h of nothing). A failed config costs up to
+            # MEASURE_TIMEOUT; before sinking that again, spend a cheap
+            # bounded probe to learn whether the chip is even there.
+            if n_fail and not probe():
+                log("tunnel died mid-sweep; aborting remaining configs")
                 break
             rec = run_one(impl, n_sets, cache_dir)
             if rec is not None and rec.get("platform") in ("tpu", "axon"):
                 append_measurement(rec)
                 n_ok += 1
+            else:
+                n_fail += 1
     finally:
         shutil.rmtree(cache_dir, ignore_errors=True)
     return n_ok
