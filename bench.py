@@ -278,13 +278,20 @@ def _measure_sigsets(jax, platform):
     )
     args = jax.device_put(args)
 
-    # BENCH_IMPL=pallas runs the Miller loop as the fused VMEM kernel;
-    # BENCH_IMPL=mxu routes the limb-product contractions through int8
-    # MXU matmuls (fieldb._conv_contract) on the XLA path
+    # BENCH_IMPL=pallas runs the Miller loop + RLC ladders as fused VMEM
+    # kernels; BENCH_IMPL=ptail additionally runs the product fold +
+    # final exponentiation in-kernel (ops.pallas_tail); BENCH_IMPL=mxu
+    # routes the limb-product contractions through int8 MXU matmuls
+    # (fieldb._conv_contract) on the XLA path
     impl = os.environ.get("BENCH_IMPL", "xla")
+    if impl not in ("xla", "mxu", "pallas", "ptail", "txla"):
+        # an unrecognized impl must not fall through to the xla path and
+        # publish a mislabeled headline-eligible record
+        print(f"bench: unknown BENCH_IMPL {impl!r}", file=sys.stderr)
+        sys.exit(4)
     if impl == "mxu":
         os.environ["LIGHTHOUSE_TPU_MXU_CONV"] = "1"
-    if impl == "pallas":
+    if impl in ("pallas", "ptail"):
         import functools
 
         fn = jax.jit(
@@ -294,8 +301,12 @@ def _measure_sigsets(jax, platform):
                 # the kernel body in interpret mode so the JSON line
                 # still lands
                 interpret=(platform == "cpu"),
+                tail=(impl == "ptail"),
             )
         )
+    elif impl == "txla":
+        # fully-transposed batch-on-lanes pipeline, no Pallas
+        fn = jax.jit(batch_verify.verify_signature_sets_t)
     else:
         fn = jax.jit(batch_verify.verify_signature_sets)
     t_compile0 = time.perf_counter()

@@ -164,6 +164,73 @@ def verify_signature_sets_individual(
     return ok | ~set_mask
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+def verify_signature_sets_t(
+    msgs_g2_aff,
+    sigs_g2_aff,
+    pubkeys_g1_aff,
+    key_mask,
+    rand_bits,
+    set_mask,
+):
+    """Same verdict as verify_signature_sets, computed entirely in the
+    transposed batch-on-lanes layout (ops.tfield/tcurve/tpairing/tfexp)
+    at the XLA level — no Pallas. The batch-leading layout's trailing
+    33-limb axis wastes ~3/4 of each VPU register row; here the batch
+    rides the 128-lane axis end to end (RLC ladders, Miller loop, pair
+    fold, final exponentiation). Only the per-set K-key aggregation and
+    the two to-affine inversions stay batch-leading (they are small and
+    already lane-efficient over S)."""
+    from lighthouse_tpu.ops import tcurve, tfexp, tfield as tf
+    from lighthouse_tpu.ops import tower
+    from lighthouse_tpu.ops import tpairing as tp
+
+    S = set_mask.shape[0]
+    bits_t = jnp.transpose(rand_bits).astype(jnp.int32)  # (64, S)
+
+    # G1: per-set aggregate (tree fold over K), transposed RLC ladder
+    agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)
+    agg_t = tuple(tf.from_batchlead(c) for c in agg_pk)
+    pk_r_t = tcurve.TPG1.mul_scalar_bits(agg_t, bits_t)
+    pk_r = tuple(tf.to_batchlead(c) for c in pk_r_t)
+    pk_aff = curve.PG1.to_affine(pk_r)
+
+    # G2: transposed RLC ladder over the signatures + lane-tree fold.
+    # The ladder runs on exactly S lanes; only sum_lanes needs a
+    # power-of-two count, so identity-pad its INPUT, not the ladder's.
+    sx, sy = (tf.from_batchlead(c) for c in sigs_g2_aff)
+    sig_t = tcurve.TPG2.from_affine((sx, sy), set_mask)
+    sig_r_t = tcurve.TPG2.mul_scalar_bits(sig_t, bits_t)
+    pad = _next_pow2(S) - S
+    if pad:
+        ident = tcurve.TPG2.identity(pad)
+        sig_r_t = tuple(
+            jnp.concatenate([c, i], axis=-1)
+            for c, i in zip(sig_r_t, ident)
+        )
+    sig_folded = tcurve.TPG2.sum_lanes(sig_r_t)  # 1-lane bundles
+    sig_acc = tuple(tf.to_batchlead(c)[0] for c in sig_folded)
+    sig_aff = curve.PG2.to_affine(_expand0(sig_acc))
+
+    g1_side, g2_side, pair_mask = _assemble_pairs(
+        msgs_g2_aff, set_mask, pk_aff, sig_aff
+    )
+
+    # transposed Miller loop on exactly S+1 pair lanes — no padding:
+    # tfexp.fold_lanes carries odd counts, and pow2-padding here would
+    # nearly double the dominant Miller work at S=1024 (1025 -> 2048)
+    p_t = tuple(tf.from_batchlead(c) for c in g1_side)
+    q_t = tuple(tf.from_batchlead(c) for c in g2_side)
+    f_t = tp.miller_loop_t(p_t, q_t, pair_mask)
+    prod_t = tfexp.fold_lanes(f_t)
+    frob = jnp.asarray(tfexp.frob_consts())[:, :, None]
+    res_t = tfexp.final_exponentiation_t(prod_t, frob[:12], frob[12:])
+    return tower.fp12_is_one(tf.to_batchlead(res_t)[0])
+
+
 def g2_points_in_subgroup(points_g2_aff, mask):
     """(S,) bool — [r]·P == identity per lane, the batched device form of
     the host-side signature subgroup check (blst.rs:72-81 policy;
@@ -277,12 +344,14 @@ def verify_signature_sets_pallas(
     set_mask,
     block_b: int = 128,
     interpret: bool = False,
+    tail: bool = False,
 ):
     """Same verdict as verify_signature_sets, with the Miller loop AND
     the RLC scalar ladders running as fused Pallas VMEM kernels. The
     pair axis is padded to a lane-tile multiple with masked identity
-    pairs; MSM folds, to-affine inversions, and the final exponentiation
-    stay on the XLA path."""
+    pairs; MSM folds and the to-affine inversions stay on the XLA path.
+    With `tail=True` the product fold + final exponentiation also run
+    in-kernel (ops.pallas_tail) — without it they stay on XLA."""
     from lighthouse_tpu.ops import tfield as tf, tower
     from lighthouse_tpu.ops.pallas_miller import miller_loop_pallas
 
@@ -306,6 +375,12 @@ def verify_signature_sets_pallas(
     f_t = miller_loop_pallas(
         p_t, q_t, pair_mask, block_b=block_b, interpret=interpret
     )
+    if tail:
+        from lighthouse_tpu.ops.pallas_tail import fold_final_exp_pallas
+
+        res_t = fold_final_exp_pallas(f_t, interpret=interpret)
+        res = tf.to_batchlead(res_t)[0]  # (12, NB)
+        return tower.fp12_is_one(res)
     f = tf.to_batchlead(f_t)
     prod = tower.fp12_product_axis(f, axis=0)
     return pairing.final_exp_is_one(prod)

@@ -1,0 +1,102 @@
+"""Pallas TPU kernel: the final exponentiation fused in VMEM.
+
+After the Miller loop and the (batched, XLA-friendly) product fold, the
+batch-verify verdict is ~300 sequential Fp12 ops plus one Fp inversion on
+a batch of ONE value — the tail of the reference's one multi-pairing per
+batch (crypto/bls/src/impls/blst.rs:114-119). On the XLA path each of
+those small ops is its own HBM round-trip; this kernel keeps every chain
+intermediate in VMEM, with exponent bits in SMEM and the field/Frobenius
+constants passed as inputs (kernels cannot capture array constants —
+tfield.const_overrides convention).
+
+The product FOLD deliberately stays at the XLA level: its lane-halving
+tree slices the lane axis at sub-tile offsets, which Mosaic rejects
+("result/input offset mismatch on non-concat dimension" — measured on
+v5e 2026-07-31); XLA handles those slices fine and the fold is batched
+work it already does well.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lighthouse_tpu.ops import tfexp
+from lighthouse_tpu.ops import tfield as tf
+
+NB = tf.NB
+
+
+def _consts_array():
+    return jnp.asarray(
+        np.stack(
+            [
+                np.array(tf._OFF, np.int32)[:, None],
+                np.array(tf._SPREAD_SUB, np.int32)[:, None],
+                np.array(tf._COMP_2P, np.int32)[:, None],
+                np.array(tf.fb.ONE_MONT_B, np.int32)[:, None],
+            ]
+        )
+    )  # (4, NB, 1)
+
+
+def _kernel(pbits_ref, xbits_ref, f_ref, consts_ref, frob_ref, out_ref):
+    consts = consts_ref[:]
+    overrides = {
+        "off": consts[0],
+        "spread_sub": consts[1],
+        "comp_2p": consts[2],
+        "one": consts[3],
+    }
+    with tf.const_overrides(**overrides):
+        frob = frob_ref[:]
+        res = tfexp.final_exponentiation_t(
+            f_ref[:],
+            frob[:12],
+            frob[12:],
+            get_pbit=lambda j: pbits_ref[j],
+            get_xbit=lambda j: xbits_ref[j],
+        )
+        out_ref[:] = res
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def final_exp_pallas(f1_t, interpret: bool = False):
+    """(12, NB, 1) folded Miller product -> (12, NB, 1) final-exp'd
+    value, the whole addition chain in one VMEM-resident kernel."""
+    assert f1_t.shape == (12, NB, 1), f1_t.shape
+
+    pbits = jnp.asarray(tfexp.P_MINUS_2_BITS)
+    xbits = jnp.asarray(tfexp.X_ABS_BITS)
+
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((12, NB, 1), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # p-2 bits
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # |x| bits
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(
+        pbits,
+        xbits,
+        f1_t,
+        _consts_array(),
+        jnp.asarray(tfexp.frob_consts())[:, :, None],
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fold_final_exp_pallas(f_t, interpret: bool = False):
+    """(12, NB, B) per-pair Miller outputs -> (12, NB, 1) final-exp'd
+    product. XLA lane-tree fold + the final-exp kernel; any B (odd
+    fold levels carry a tail)."""
+    return final_exp_pallas(tfexp.fold_lanes(f_t), interpret=interpret)

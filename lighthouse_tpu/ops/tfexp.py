@@ -1,0 +1,240 @@
+"""Batch-last (transposed) Fp12 final exponentiation — the Pallas tail.
+
+The verdict stage of batch verification — fold the per-pair Miller outputs
+into one Fp12 product and raise it to 3*(p^12-1)/r (the role of the final
+exponentiation inside the reference backend's one multi-pairing,
+crypto/bls/src/impls/blst.rs:114-116) — runs on a batch of ONE value, so
+on the XLA path it is pure sequential latency: ~300 small Fp12 ops, each
+round-tripping HBM. This module re-expresses the whole chain on
+ops.tfield `(S, NB, B)` bundles so ops.pallas_tail can run it inside one
+VMEM-resident kernel. Runs in three modes:
+
+  * pure jnp under jit (XLA; numerically validated against ops.pairing);
+  * as the body of the Pallas tail kernel (ops.pallas_tail);
+  * interpret-mode for CPU tests.
+
+Bit ladders take a `get_bit(i)` accessor so the kernel can read exponent
+bits from an SMEM ref while the jit path indexes captured arrays. The
+Frobenius constants are passed as values (kernels cannot capture array
+constants — same convention as tfield.const_overrides).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import BLS_X_ABS, P
+from lighthouse_tpu.ops import tfield as tf
+from lighthouse_tpu.ops import tpairing as tp
+from lighthouse_tpu.ops import tower
+from lighthouse_tpu.ops.programs import FP2_MUL, FP6_MUL
+
+NB = tf.NB
+
+# LSB-first exponent bit arrays for the two ladders in the chain.
+P_MINUS_2_BITS = np.array(
+    [((P - 2) >> i) & 1 for i in range((P - 2).bit_length())], np.int32
+)
+X_ABS_BITS = np.array(
+    [(BLS_X_ABS >> i) & 1 for i in range(BLS_X_ABS.bit_length())], np.int32
+)
+
+_XI = np.array([[1, -1], [1, 1]], dtype=np.int32)
+_FP2_CONJ = np.array([[1, 0], [0, -1]], dtype=np.int32)
+
+
+def frob_consts() -> np.ndarray:
+    """(24, NB) int32 constant block for the kernel: rows 0..11 the
+    p-Frobenius gamma scalings (6 Fp2 in Frobenius slot order = tower
+    _FROB_GAMMAS), rows 12..23 the p^2-Frobenius Fp norms (_FROB2_N)."""
+    g = tower._FROB_GAMMAS.reshape(12, NB)
+    return np.concatenate([g, tower._FROB2_N]).astype(np.int32)
+
+
+# --------------------------------------------------------------- helpers
+
+
+def fp12_conj(f):
+    return tf.apply_combo(f, tower._CONJ12)
+
+
+def _fp2_mul(a, b):
+    return tp.bilinear(a, b, FP2_MUL)
+
+
+def _fp2_mul_by_xi(a):
+    return tf.apply_combo(a, _XI)
+
+
+def _fp6_mul_by_v(a):
+    return tf.apply_combo(a, tower._MUL_BY_V6)
+
+
+def _fp6_neg(a):
+    return tf.apply_combo(a, -np.eye(6, dtype=np.int32))
+
+
+def fp12_frobenius(f, gammas):
+    """f^p. `gammas`: (12, NB, 1) batch-last gamma constants
+    (frob_consts() rows 0..11)."""
+    conjed = tf.apply_combo(f, tower._CONJ_EACH)
+    pairs = conjed.reshape((6, 2) + conjed.shape[-2:])
+    gp = gammas.reshape((6, 2) + gammas.shape[-2:])
+    out = _fp2_mul(pairs, jnp.broadcast_to(gp, pairs.shape))
+    return out.reshape(f.shape)
+
+
+def fp12_frobenius2(f, norms):
+    """f^(p^2) for any Fp12 element: slot-wise scale by Fp norms
+    (frob_consts() rows 12..23, shaped (12, NB, 1))."""
+    return tf.mul_lazy(f, jnp.broadcast_to(norms, f.shape))
+
+
+# ----------------------------------------------------------------- ladders
+
+
+def _pow_bits(mul_fn, sqr_fn, one, base, n_bits, get_bit):
+    """Square-and-multiply, LSB-first bits via `get_bit(i)`. The multiply
+    is under lax.cond — a skipped bit costs only the squaring (proven
+    in-kernel by the Miller scan's add-step cond)."""
+
+    def body(i, carry):
+        result, b = carry
+        result = jax.lax.cond(
+            get_bit(i) == 1,
+            lambda rb: mul_fn(rb[0], rb[1]),
+            lambda rb: rb[0],
+            (result, b),
+        )
+        return result, sqr_fn(b)
+
+    result, _ = jax.lax.fori_loop(0, n_bits, body, (one, base))
+    return result
+
+
+def fp_inv(a, get_pbit=None):
+    """Per-slot Fermat inverse a^(p-2) on (..., S, NB, B) bundles."""
+    if get_pbit is None:
+        bits = jnp.asarray(P_MINUS_2_BITS)
+        get_pbit = lambda i: bits[i]  # noqa: E731
+    one = jnp.broadcast_to(tf.one_col(), a.shape)
+    return _pow_bits(
+        tf.mul_lazy, tf.sqr_lazy, one, a, len(P_MINUS_2_BITS), get_pbit
+    )
+
+
+def fp2_inv(a, get_pbit=None):
+    """1/(c0 + c1 u) = conj(a) / (c0^2 + c1^2) on (..., 2, NB, B)."""
+    sq = tf.sqr_lazy(a)  # (c0^2, c1^2) slotwise
+    norm = tf.add(sq[..., 0:1, :, :], sq[..., 1:2, :, :])
+    ninv = fp_inv(norm, get_pbit)
+    conj = tf.apply_combo(a, _FP2_CONJ)
+    return tf.mul_lazy(conj, jnp.broadcast_to(ninv, conj.shape))
+
+
+def fp6_inv(a, get_pbit=None):
+    """Tower inversion on (..., 6, NB, B) (tower.fp6_inv transposed)."""
+    a3 = a.reshape(a.shape[:-3] + (3, 2) + a.shape[-2:])
+    a0 = a3[..., 0, :, :, :]
+    a1 = a3[..., 1, :, :, :]
+    a2 = a3[..., 2, :, :, :]
+    lhs = jnp.stack([a0, a1, a2, a0, a1, a0], axis=-4)
+    rhs = jnp.stack([a0, a1, a2, a1, a2, a2], axis=-4)
+    prods = _fp2_mul(lhs, rhs)
+    sq0 = prods[..., 0, :, :, :]
+    sq1 = prods[..., 1, :, :, :]
+    sq2 = prods[..., 2, :, :, :]
+    p01 = prods[..., 3, :, :, :]
+    p12 = prods[..., 4, :, :, :]
+    p02 = prods[..., 5, :, :, :]
+    c0 = tf.sub(sq0, _fp2_mul_by_xi(p12))
+    c1 = tf.sub(_fp2_mul_by_xi(sq2), p01)
+    c2 = tf.sub(sq1, p02)
+    pr = _fp2_mul(
+        jnp.stack([a0, a2, a1], axis=-4), jnp.stack([c0, c1, c2], axis=-4)
+    )
+    norm = tf.add(
+        pr[..., 0, :, :, :],
+        _fp2_mul_by_xi(tf.add(pr[..., 1, :, :, :], pr[..., 2, :, :, :])),
+    )
+    ninv = fp2_inv(norm, get_pbit)
+    scaled = _fp2_mul(
+        jnp.stack([c0, c1, c2], axis=-4),
+        jnp.broadcast_to(
+            ninv[..., None, :, :, :], c0.shape[:-3] + (3,) + ninv.shape[-3:]
+        ),
+    )
+    return scaled.reshape(a.shape)
+
+
+def fp12_inv(a, get_pbit=None):
+    """1/(b0 + b1 w) = (b0 - b1 w)/(b0^2 - v b1^2) on (12, NB, B)."""
+    b0 = a[..., :6, :, :]
+    b1 = a[..., 6:, :, :]
+    sq = tp.bilinear(
+        jnp.stack([b0, b1], axis=-4), jnp.stack([b0, b1], axis=-4), FP6_MUL
+    )
+    norm = tf.sub(sq[..., 0, :, :, :], _fp6_mul_by_v(sq[..., 1, :, :, :]))
+    ninv = fp6_inv(norm, get_pbit)
+    scaled = tp.bilinear(
+        jnp.stack([b0, b1], axis=-4),
+        jnp.broadcast_to(
+            ninv[..., None, :, :, :], b0.shape[:-3] + (2,) + ninv.shape[-3:]
+        ),
+        FP6_MUL,
+    )
+    return jnp.concatenate(
+        [scaled[..., 0, :, :, :], _fp6_neg(scaled[..., 1, :, :, :])],
+        axis=-3,
+    )
+
+
+def pow_x_abs(f, get_xbit=None):
+    """f^|x| (|x| = BLS_X_ABS, Hamming weight 6 — the cond ladder skips
+    58 of 64 multiplies)."""
+    if get_xbit is None:
+        bits = jnp.asarray(X_ABS_BITS)
+        get_xbit = lambda i: bits[i]  # noqa: E731
+    one = tp.fp12_one(f.shape[-1])
+    return _pow_bits(
+        tp.fp12_mul, tp.fp12_sqr, one, f, len(X_ABS_BITS), get_xbit
+    )
+
+
+# ------------------------------------------------------------- the chain
+
+
+def final_exponentiation_t(f, gammas, norms, get_pbit=None, get_xbit=None):
+    """f^(3 (p^12-1)/r) on a (12, NB, B) bundle — ops.pairing's addition
+    chain transposed. `gammas`/`norms` are frob_consts() halves shaped
+    (12, NB, 1)."""
+
+    def pow_neg_x(g):
+        return fp12_conj(pow_x_abs(g, get_xbit))
+
+    f = tp.fp12_mul(fp12_conj(f), fp12_inv(f, get_pbit))
+    f = tp.fp12_mul(fp12_frobenius2(f, norms), f)
+    t0 = tp.fp12_mul(pow_neg_x(f), fp12_conj(f))
+    t1 = tp.fp12_mul(pow_neg_x(t0), fp12_conj(t0))
+    t2 = tp.fp12_mul(pow_neg_x(t1), fp12_frobenius(t1, gammas))
+    t3 = tp.fp12_mul(
+        pow_neg_x(pow_neg_x(t2)),
+        tp.fp12_mul(fp12_frobenius2(t2, norms), fp12_conj(t2)),
+    )
+    f3 = tp.fp12_mul(tp.fp12_mul(f, f), f)
+    return tp.fp12_mul(t3, f3)
+
+
+def fold_lanes(f):
+    """Lane-halving tree product: (12, NB, B) -> (12, NB, 1) — the lane
+    axis analog of tower.fp12_product_axis (odd counts carry a tail)."""
+    B = f.shape[-1]
+    while B > 1:
+        half = B // 2
+        prod = tp.fp12_mul(f[..., :half], f[..., half : 2 * half])
+        if B % 2:
+            prod = jnp.concatenate([prod, f[..., B - 1 :]], axis=-1)
+        f = prod
+        B = half + (B % 2)
+    return f

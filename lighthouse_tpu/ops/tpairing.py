@@ -141,11 +141,14 @@ def _add_step(t, q_affine, px, py):
 
 def _one_slot0(slots: int, batch: int):
     """Montgomery 1 in slot 0, zero elsewhere — built from tf.one_col()
-    so a Pallas kernel can substitute a ref-read constant."""
+    so a Pallas kernel can substitute a ref-read constant. The slots == 1
+    case must NOT build a (0, NB, 1) pad: Mosaic rejects zero-sized
+    vectors when lowering on hardware (interpret mode tolerates them)."""
     col = tf.one_col()[None, :, :]  # (1, NB, 1)
-    pad = jnp.zeros((slots - 1, NB, 1), dtype=jnp.int32)
-    one = jnp.concatenate([col, pad], axis=0)
-    return jnp.broadcast_to(one, (slots, NB, batch))
+    if slots > 1:
+        pad = jnp.zeros((slots - 1, NB, 1), dtype=jnp.int32)
+        col = jnp.concatenate([col, pad], axis=0)
+    return jnp.broadcast_to(col, (slots, NB, batch))
 
 
 def fp12_one(batch: int):

@@ -1,0 +1,115 @@
+"""The batch-last final-exponentiation plane (ops.tfexp) and the fused
+fold+final-exp Pallas tail kernel (ops.pallas_tail), validated against the
+production ops.pairing / ops.tower chain (interpret mode on the CPU mesh;
+the same kernel runs compiled on TPU)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lighthouse_tpu import testing as td
+from lighthouse_tpu.crypto import ref_fields
+from lighthouse_tpu.ops import batch_verify, fieldb as fb, pairing, tower
+from lighthouse_tpu.ops import tfexp, tfield as tf
+from lighthouse_tpu.ops.pallas_tail import fold_final_exp_pallas
+
+
+def _canon(x):
+    return np.asarray(fb.from_mont(fb.canon(x)))
+
+
+def _random_fp12_bundle(n, seed=0):
+    """(n, 12, NB) Montgomery bundle of random ref-format Fp12 values."""
+    rng = np.random.default_rng(seed)
+    vals = []
+    for _ in range(n):
+        ints = [int.from_bytes(rng.bytes(48), "big") for _ in range(12)]
+        fp6s = []
+        for i in range(2):
+            fp6s.append(
+                tuple(
+                    (ints[i * 6 + 2 * j], ints[i * 6 + 2 * j + 1])
+                    for j in range(3)
+                )
+            )
+        vals.append((fp6s[0], fp6s[1]))
+    return tower.fp12_pack(vals), vals
+
+
+def test_tfexp_inverse_and_frobenius_match_tower():
+    bundle, _ = _random_fp12_bundle(2, seed=11)
+    f_t = tf.from_batchlead(bundle)
+    frob = jnp.asarray(tfexp.frob_consts())[:, :, None]
+
+    inv_ref = jax.jit(tower.fp12_inv)(bundle)
+    inv_t = jax.jit(tfexp.fp12_inv)(f_t)
+    assert np.array_equal(_canon(inv_ref), _canon(tf.to_batchlead(inv_t)))
+
+    fr_ref = jax.jit(tower.fp12_frobenius)(bundle)
+    fr_t = jax.jit(functools.partial(tfexp.fp12_frobenius))(f_t, frob[:12])
+    assert np.array_equal(_canon(fr_ref), _canon(tf.to_batchlead(fr_t)))
+
+    fr2_ref = jax.jit(tower.fp12_frobenius2)(bundle)
+    fr2_t = jax.jit(tfexp.fp12_frobenius2)(f_t, frob[12:])
+    assert np.array_equal(_canon(fr2_ref), _canon(tf.to_batchlead(fr2_t)))
+
+
+def test_tfexp_final_exponentiation_matches_pairing():
+    bundle, _ = _random_fp12_bundle(2, seed=12)
+    f_t = tf.from_batchlead(bundle)
+    frob = jnp.asarray(tfexp.frob_consts())[:, :, None]
+    ref = jax.jit(pairing.final_exponentiation)(bundle)
+    out_t = jax.jit(
+        lambda f: tfexp.final_exponentiation_t(f, frob[:12], frob[12:])
+    )(f_t)
+    assert np.array_equal(_canon(ref), _canon(tf.to_batchlead(out_t)))
+
+
+def test_fold_lanes_matches_product_axis():
+    # 7 lanes: exercises the odd-count tail carries
+    bundle, _ = _random_fp12_bundle(7, seed=13)
+    ref = jax.jit(lambda a: tower.fp12_product_axis(a, axis=0))(bundle)
+    out = jax.jit(tfexp.fold_lanes)(tf.from_batchlead(bundle))
+    assert np.array_equal(_canon(ref), _canon(tf.to_batchlead(out)[0]))
+
+
+def test_pallas_tail_kernel_interpret():
+    """XLA lane fold + the in-kernel final exp equals the XLA fold +
+    addition chain (6 lanes: odd fold path included)."""
+    bundle, _ = _random_fp12_bundle(6, seed=14)
+    ref = jax.jit(
+        lambda a: pairing.final_exponentiation(
+            tower.fp12_product_axis(a, axis=0)
+        )
+    )(bundle)
+    out_t = fold_final_exp_pallas(tf.from_batchlead(bundle), interpret=True)
+    assert np.array_equal(_canon(ref)[None], _canon(tf.to_batchlead(out_t)))
+
+
+def test_pallas_verify_tail_end_to_end():
+    """verify_signature_sets_pallas(tail=True) agrees with the XLA path,
+    positive and negative."""
+    args = td.make_signature_set_batch(2, max_keys=2, seed=21)
+    fn = functools.partial(
+        batch_verify.verify_signature_sets_pallas,
+        block_b=4,
+        interpret=True,
+        tail=True,
+    )
+    assert bool(np.asarray(jax.jit(fn)(*args)))
+    msgs, sigs, pks, km, rb, sm = args
+    bad = (sigs[0].at[0, 0, 0].add(1), sigs[1])
+    assert not bool(np.asarray(jax.jit(fn)(msgs, bad, pks, km, rb, sm)))
+
+
+def test_tfexp_fp_inv_matches_ref():
+    """Transposed Fermat inverse against the pure-reference field."""
+    rng = np.random.default_rng(15)
+    vals = [int.from_bytes(rng.bytes(48), "big") % ref_fields.P for _ in range(3)]
+    bundle = fb.to_mont(jnp.asarray(np.stack([fb.pack_ints([v]) for v in vals])))
+    out = jax.jit(tfexp.fp_inv)(tf.from_batchlead(bundle))
+    got = fb.unpack_ints(fb.from_mont(tf.to_batchlead(out)))
+    for v, g in zip(vals, got):
+        assert g == pow(v, ref_fields.P - 2, ref_fields.P)
