@@ -45,8 +45,10 @@ def _overrides(consts):
 
 
 def _ladder_kernel(group, n_bits, x_ref, y_ref, z_ref, bits_ref,
-                   consts_ref, ox_ref, oy_ref, oz_ref):
-    with tf.const_overrides(**_overrides(consts_ref[:])):
+                   consts_ref, redc_ref, ox_ref, oy_ref, oz_ref):
+    with tf.const_overrides(
+        **_overrides(consts_ref[:]), **tf.redc_overrides(redc_ref[:])
+    ):
         pt = (x_ref[:], y_ref[:], z_ref[:])
         B = pt[0].shape[-1]
         acc0 = group.identity(B)
@@ -93,14 +95,18 @@ def ladder_pallas(
     const_spec = pl.BlockSpec(
         (4, NB, 1), lambda i: (0, 0, 0), memory_space=pltpu.VMEM
     )
+    redc_spec = pl.BlockSpec(
+        tf.REDC_MATS_SHAPE, lambda i: (0, 0), memory_space=pltpu.VMEM
+    )
 
     shape = jax.ShapeDtypeStruct((w, NB, B), jnp.int32)
     ox, oy, oz = pl.pallas_call(
         functools.partial(_ladder_kernel, group, n_bits),
         out_shape=(shape, shape, shape),
         grid=grid,
-        in_specs=[spec(w), spec(w), spec(w), bits_spec, const_spec],
+        in_specs=[spec(w), spec(w), spec(w), bits_spec, const_spec,
+                  redc_spec],
         out_specs=(spec(w), spec(w), spec(w)),
         interpret=interpret,
-    )(X, Y, Z, bits, _consts_array())
+    )(X, Y, Z, bits, _consts_array(), tf.redc_mats_array())
     return ox, oy, oz

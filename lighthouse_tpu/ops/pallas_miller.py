@@ -29,15 +29,16 @@ NB = tf.NB
 _BITS = np.array(tp._X_BITS, dtype=np.int32)
 
 
-def _kernel(bits_ref, px_ref, py_ref, qx_ref, qy_ref, consts_ref, f_ref):
+def _kernel(
+    bits_ref, px_ref, py_ref, qx_ref, qy_ref, consts_ref, redc_ref, f_ref
+):
+    from lighthouse_tpu.ops.pallas_ladder import _overrides
+
     px, py = px_ref[:], py_ref[:]
     qx, qy = qx_ref[:], qy_ref[:]
-    consts = consts_ref[:]  # (4, NB, 1): off/spread_sub/comp_2p/one cols
     overrides = {
-        "off": consts[0],
-        "spread_sub": consts[1],
-        "comp_2p": consts[2],
-        "one": consts[3],
+        **_overrides(consts_ref[:]),
+        **tf.redc_overrides(redc_ref[:]),
     }
     with tf.const_overrides(**overrides):
         B = qx.shape[-1]
@@ -80,16 +81,9 @@ def miller_loop_pallas(
             memory_space=pltpu.VMEM,
         )
 
-    consts = jnp.asarray(
-        np.stack(
-            [
-                np.array(tf._OFF, np.int32)[:, None],
-                np.array(tf._SPREAD_SUB, np.int32)[:, None],
-                np.array(tf._COMP_2P, np.int32)[:, None],
-                np.array(tf.fb.ONE_MONT_B, np.int32)[:, None],
-            ]
-        )
-    )  # (4, NB, 1)
+    from lighthouse_tpu.ops.pallas_ladder import _consts_array
+
+    consts = _consts_array()
     bits = jnp.asarray(_BITS)
 
     f = pl.pallas_call(
@@ -105,10 +99,14 @@ def miller_loop_pallas(
             pl.BlockSpec(
                 (4, NB, 1), lambda i: (0, 0, 0), memory_space=pltpu.VMEM
             ),
+            pl.BlockSpec(
+                tf.REDC_MATS_SHAPE, lambda i: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
         ],
         out_specs=spec(12),
         interpret=interpret,
-    )(bits, px, py, qx, qy, consts)
+    )(bits, px, py, qx, qy, consts, tf.redc_mats_array())
     if valid_mask is not None:
         f = tf.select(valid_mask, f, tp.fp12_one(B))
     return f

@@ -31,26 +31,15 @@ from lighthouse_tpu.ops import tfield as tf
 NB = tf.NB
 
 
-def _consts_array():
-    return jnp.asarray(
-        np.stack(
-            [
-                np.array(tf._OFF, np.int32)[:, None],
-                np.array(tf._SPREAD_SUB, np.int32)[:, None],
-                np.array(tf._COMP_2P, np.int32)[:, None],
-                np.array(tf.fb.ONE_MONT_B, np.int32)[:, None],
-            ]
-        )
-    )  # (4, NB, 1)
+from lighthouse_tpu.ops.pallas_ladder import _consts_array, _overrides
 
 
-def _kernel(pbits_ref, xbits_ref, f_ref, consts_ref, frob_ref, out_ref):
-    consts = consts_ref[:]
+def _kernel(
+    pbits_ref, xbits_ref, f_ref, consts_ref, frob_ref, redc_ref, out_ref
+):
     overrides = {
-        "off": consts[0],
-        "spread_sub": consts[1],
-        "comp_2p": consts[2],
-        "one": consts[3],
+        **_overrides(consts_ref[:]),
+        **tf.redc_overrides(redc_ref[:]),
     }
     with tf.const_overrides(**overrides):
         frob = frob_ref[:]
@@ -82,6 +71,7 @@ def final_exp_pallas(f1_t, interpret: bool = False):
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
@@ -91,6 +81,7 @@ def final_exp_pallas(f1_t, interpret: bool = False):
         f1_t,
         _consts_array(),
         jnp.asarray(tfexp.frob_consts())[:, :, None],
+        tf.redc_mats_array(),
     )
 
 

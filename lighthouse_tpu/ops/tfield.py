@@ -27,6 +27,8 @@ Parity note: behind the reference's BLS boundary
 (crypto/bls/src/impls/blst.rs), alternate layout of the same plane.
 """
 
+import functools
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -123,6 +125,105 @@ def reduce_small(x):
 # ------------------------------------------------------------- multiplies
 
 
+def use_mxu_redc() -> bool:
+    """Route the two STATIC convolutions of Montgomery REDC (by N' and
+    by p) through int8 MXU matmuls (LIGHTHOUSE_TPU_MXU_REDC=1). Unlike
+    the failed data-conv int8 path (fieldb._conv_contract, measured
+    slower 2026-07-31), the MXU here consumes RAW limb digits against
+    precomputed Toeplitz digit matrices — no VPU-computed products
+    feed it. Read at trace time — build fresh jitted functions after
+    flipping it."""
+    import os
+
+    return os.environ.get("LIGHTHOUSE_TPU_MXU_REDC") == "1"
+
+
+def _toeplitz(vals, n_out: int, n_in: int) -> np.ndarray:
+    """Conv-as-matmul matrix: out_k = sum_l x_l * vals[k - l], rows
+    truncated at n_out (mod-R truncation for the N' matrix)."""
+    m = np.zeros((n_out, n_in), np.int32)
+    for l in range(n_in):
+        for k in range(l, min(n_out, l + len(vals))):
+            m[k, l] = vals[k - l]
+    return m
+
+
+# TP gets 64 output rows (63 real + one all-zero) so kernel refs slice at
+# 8-aligned sublane offsets and no value-slicing is needed; the zero row
+# contributes nothing downstream.
+_TN_FULL = _toeplitz(_NPRIME, NLIMBS, NLIMBS)
+_TP_FULL = _toeplitz(_PLIMBS, 64, NLIMBS)
+
+
+def _digits8(m: np.ndarray):
+    """12-bit-entry static matrix -> (lo7, hi5) int8 digit matrices."""
+    return (m & 127).astype(np.int8), (m >> 7).astype(np.int8)
+
+
+_TN_LO, _TN_HI = _digits8(_TN_FULL)
+_TP_LO, _TP_HI = _digits8(_TP_FULL)
+
+
+# Stack layout of redc_mats_array: [tn_lo | tn_hi | tp_lo | tp_hi] with
+# row offsets derived from the matrix heights. Kernels size their
+# BlockSpecs from REDC_MATS_SHAPE so a change here cannot silently
+# misalign the in-kernel slices.
+_REDC_OFFS = np.cumsum(
+    [0, _TN_LO.shape[0], _TN_HI.shape[0], _TP_LO.shape[0], _TP_HI.shape[0]]
+)
+REDC_MATS_SHAPE = (int(_REDC_OFFS[-1]), NLIMBS)
+
+
+def redc_mats_array():
+    """(REDC_MATS_SHAPE) int8 stack — the single extra input a Pallas
+    kernel threads when the MXU-REDC path is on (kernels cannot capture
+    array constants). All slice offsets are 8-aligned sublane offsets."""
+    return jnp.asarray(
+        np.concatenate([_TN_LO, _TN_HI, _TP_LO, _TP_HI], axis=0)
+    )
+
+
+def redc_overrides(mats):
+    """Split a REDC_MATS_SHAPE stack (ref-loaded in-kernel) into the
+    const_overrides keys _static_conv_mxu reads."""
+    o = _REDC_OFFS
+    return {
+        "tn_lo": mats[int(o[0]) : int(o[1])],
+        "tn_hi": mats[int(o[1]) : int(o[2])],
+        "tp_lo": mats[int(o[2]) : int(o[3])],
+        "tp_hi": mats[int(o[3]) : int(o[4])],
+    }
+
+
+def _const_mat(arr_np, name):
+    if name in _CONST_OVERRIDES:
+        return _CONST_OVERRIDES[name]
+    return jnp.asarray(arr_np)
+
+
+def _static_conv_mxu(x, lo_np, hi_np, lo_name, hi_name):
+    """Static convolution as four int8 x int8 -> int32 MXU matmuls.
+
+    x: (..., L, B) non-negative limbs < 2^13 (relaxed bound 4097).
+    Exactness: x splits into lo7 (< 2^7) and hi (< 2^6) digits, the
+    matrices into lo7/hi5; per-digit column sums <= 32*127*127 < 2^19
+    and the recombination sum(p_ab << 7(a+b)) <= 32*4097*4095 < 2^30 —
+    all int32-exact, bit-identical to the unrolled shift-pad FMA chain
+    (adversarially checked in tests/test_tfield.py)."""
+    mlo = _const_mat(lo_np, lo_name)
+    mhi = _const_mat(hi_np, hi_name)
+    xlo = (x & 127).astype(jnp.int8)
+    xhi = (x >> 7).astype(jnp.int8)
+    dot = functools.partial(
+        jnp.einsum, preferred_element_type=jnp.int32
+    )
+    p00 = dot("kl,...lb->...kb", mlo, xlo)
+    p01 = dot("kl,...lb->...kb", mlo, xhi)
+    p10 = dot("kl,...lb->...kb", mhi, xlo)
+    p11 = dot("kl,...lb->...kb", mhi, xhi)
+    return p00 + ((p01 + p10) << 7) + (p11 << 14)
+
+
 def _shift_pad(x, lo: int, total: int):
     """Place x at limb offset `lo` within a length-`total` limb axis.
     Pad-and-sum composition (NO .at[] scatter updates: those lower to
@@ -146,19 +247,28 @@ def mul_lazy(a, b):
     t = _relax(t, 2 * NB)
 
     t_low = t[..., :NLIMBS, :]
-    # shift t_low up by j limbs, truncated at NLIMBS (mod R)
-    m = sum(
-        _shift_pad(_NPRIME[j] * t_low[..., : NLIMBS - j, :], j, NLIMBS)
-        for j in range(NLIMBS)
-        if _NPRIME[j] != 0
-    )
-    m = _relax(m, NLIMBS)
+    if use_mxu_redc():
+        # both static convs as int8 MXU matmuls against Toeplitz digit
+        # matrices (the _TN mod-R truncation is baked into the matrix)
+        m = _relax(
+            _static_conv_mxu(t_low, _TN_LO, _TN_HI, "tn_lo", "tn_hi"),
+            NLIMBS,
+        )
+        mp = _static_conv_mxu(m, _TP_LO, _TP_HI, "tp_lo", "tp_hi")
+    else:
+        # shift t_low up by j limbs, truncated at NLIMBS (mod R)
+        m = sum(
+            _shift_pad(_NPRIME[j] * t_low[..., : NLIMBS - j, :], j, NLIMBS)
+            for j in range(NLIMBS)
+            if _NPRIME[j] != 0
+        )
+        m = _relax(m, NLIMBS)
 
-    mp = sum(
-        _shift_pad(_PLIMBS[j] * m, j, 2 * NLIMBS - 1)
-        for j in range(NLIMBS)
-        if _PLIMBS[j] != 0
-    )
+        mp = sum(
+            _shift_pad(_PLIMBS[j] * m, j, 2 * NLIMBS - 1)
+            for j in range(NLIMBS)
+            if _PLIMBS[j] != 0
+        )
     full = _relax(t + _shift_pad(mp, 0, 2 * NB), 2 * NB)
 
     low_nonzero = jnp.any(full[..., :NLIMBS, :] != 0, axis=-2)

@@ -46,21 +46,21 @@ SWEEP_COOLDOWN = 1800      # seconds after a successful sweep
 PROBE_TIMEOUT = 90
 MEASURE_TIMEOUT = 1500     # per-config deadline (fresh compile included)
 
-# (impl, n_sets) sweep — the Pallas/XLA A/B the verdict asks for.
-# xla@1024 stays as the per-sweep reference point; mxu was measured
-# 2026-07-31 (1,008/760 sigs/s — SLOWER than xla's 1,470/1,445, the int8
-# digit decomposition doesn't pay at these contraction shapes) and is
-# dropped from the recurring sweep; pallas (miller+ladder kernels) and
-# ptail (+ in-kernel fold/final-exp) are the paths that need hardware
-# numbers.
+# (impl, n_sets) sweep. All five impls have hardware numbers from
+# 2026-07-31: xla 1,470 @1024 / mxu 1,008 (int8 digit decomposition
+# loses at these contraction shapes) / txla 2,299 / pallas 5,425 @1024
+# and 8,433 @4096 / ptail ~= pallas (the final exp is not the
+# bottleneck). Throughput rises with batch size (~90 ms fixed cost
+# amortizing over ~97 us/sig linear cost), so the recurring sweep
+# tracks the Pallas path at growing batch sizes, with xla@1024 as the
+# per-sweep reference point. 30720 ~= the mainnet full-slot load
+# (BASELINE.md north-star config).
 SWEEP = [
     ("xla", 1024),
-    ("txla", 1024),
-    ("txla", 4096),
-    ("pallas", 1024),
     ("pallas", 4096),
-    ("ptail", 1024),
-    ("ptail", 4096),
+    ("predc", 4096),
+    ("pallas", 16384),
+    ("pallas", 30720),
 ]
 
 

@@ -70,3 +70,34 @@ def test_mul_chain_parity():
         bt = tf.mul_lazy(bt, _t(a))
         bb = fb.mul_lazy(bb, a)
     _check_same("chain", bt, bb)
+
+
+def test_mxu_redc_bit_identical(monkeypatch):
+    """LIGHTHOUSE_TPU_MXU_REDC=1 (static REDC convs as int8 Toeplitz
+    matmuls) is bit-identical to the unrolled shift-pad chain, including
+    at the adversarial relaxed-limb bound (all limbs = LIMB_RELAX)."""
+    a = _rand_bundle(6, 4)
+    b = _rand_bundle(6, 4)
+    worst = jnp.full((2, 6, fb.NB), tf.LIMB_RELAX, dtype=jnp.int32)
+
+    monkeypatch.delenv("LIGHTHOUSE_TPU_MXU_REDC", raising=False)
+    base = np.asarray(tf.mul_lazy(_t(a), _t(b)))
+    base_w = np.asarray(tf.mul_lazy(_t(worst), _t(worst)))
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU_REDC", "1")
+    mxu = np.asarray(tf.mul_lazy(_t(a), _t(b)))
+    mxu_w = np.asarray(tf.mul_lazy(_t(worst), _t(worst)))
+
+    assert np.array_equal(base, mxu)
+    assert np.array_equal(base_w, mxu_w)
+
+
+def test_mxu_redc_override_split_matches():
+    """redc_overrides(redc_mats_array()) reproduces the four digit
+    matrices exactly (the kernel threading path)."""
+    mats = np.asarray(tf.redc_mats_array())
+    ov = tf.redc_overrides(mats)
+    assert np.array_equal(np.asarray(ov["tn_lo"]), tf._TN_LO)
+    assert np.array_equal(np.asarray(ov["tn_hi"]), tf._TN_HI)
+    assert np.array_equal(np.asarray(ov["tp_lo"]), tf._TP_LO)
+    assert np.array_equal(np.asarray(ov["tp_hi"]), tf._TP_HI)
