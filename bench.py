@@ -23,7 +23,7 @@ real TPU measurement (live or replayed); the CPU-fallback path-proof number
 is explicitly false.
 
 Env knobs:
-  BENCH_IMPL=xla|txla|mxu|pallas|ptail|predc   kernel path (default xla)
+  BENCH_IMPL=xla|txla|mxu|pallas|ptail|predc|predcbf   kernel path (default xla)
   BENCH_NSETS=N             batch size override
   BENCH_REQUIRE_TPU=1       exit(3) instead of any CPU fallback/replay
   BENCH_SMOKE=1             small batch
@@ -284,7 +284,8 @@ def _measure_sigsets(jax, platform):
     # routes the limb-product contractions through int8 MXU matmuls
     # (fieldb._conv_contract) on the XLA path
     impl = os.environ.get("BENCH_IMPL", "xla")
-    if impl not in ("xla", "mxu", "pallas", "ptail", "txla", "predc"):
+    known = ("xla", "mxu", "pallas", "ptail", "txla", "predc", "predcbf")
+    if impl not in known:
         # an unrecognized impl must not fall through to the xla path and
         # publish a mislabeled headline-eligible record
         print(f"bench: unknown BENCH_IMPL {impl!r}", file=sys.stderr)
@@ -293,8 +294,10 @@ def _measure_sigsets(jax, platform):
         os.environ["LIGHTHOUSE_TPU_MXU_CONV"] = "1"
     if impl == "predc":
         # pallas kernels with the static REDC convolutions on the MXU
-        os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "1"
-    if impl in ("pallas", "ptail", "predc"):
+        os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "i8"
+    if impl == "predcbf":
+        os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "bf16"
+    if impl in ("pallas", "ptail", "predc", "predcbf"):
         import functools
 
         fn = jax.jit(

@@ -125,17 +125,29 @@ def reduce_small(x):
 # ------------------------------------------------------------- multiplies
 
 
-def use_mxu_redc() -> bool:
+def use_mxu_redc() -> str:
     """Route the two STATIC convolutions of Montgomery REDC (by N' and
-    by p) through int8 MXU matmuls (LIGHTHOUSE_TPU_MXU_REDC=1). Unlike
-    the failed data-conv int8 path (fieldb._conv_contract, measured
-    slower 2026-07-31), the MXU here consumes RAW limb digits against
+    by p) through MXU matmuls. LIGHTHOUSE_TPU_MXU_REDC selects the
+    operand form: "1"/"i8" = int8 x int8 -> int32; "bf16" = bfloat16
+    operands with f32 accumulation (exact: 7-bit digits give column
+    sums <= 2^19 << 2^24, and bf16 matmul is the most-trodden Mosaic
+    lowering). "" = off (the unrolled VPU chain). Unlike the failed
+    data-conv int8 path (fieldb._conv_contract, measured slower
+    2026-07-31), the MXU here consumes RAW limb digits against
     precomputed Toeplitz digit matrices — no VPU-computed products
     feed it. Read at trace time — build fresh jitted functions after
     flipping it."""
     import os
 
-    return os.environ.get("LIGHTHOUSE_TPU_MXU_REDC") == "1"
+    v = os.environ.get("LIGHTHOUSE_TPU_MXU_REDC", "")
+    if v in ("", "0"):
+        return ""
+    if v == "1":
+        return "i8"
+    if v in ("i8", "bf16"):
+        return v
+    # a typo must not silently measure the baseline under an MXU label
+    raise ValueError(f"LIGHTHOUSE_TPU_MXU_REDC={v!r}: use i8, bf16, or 0")
 
 
 def _toeplitz(vals, n_out: int, n_in: int) -> np.ndarray:
@@ -201,26 +213,38 @@ def _const_mat(arr_np, name):
     return jnp.asarray(arr_np)
 
 
-def _static_conv_mxu(x, lo_np, hi_np, lo_name, hi_name):
-    """Static convolution as four int8 x int8 -> int32 MXU matmuls.
+def _static_conv_mxu(x, lo_np, hi_np, lo_name, hi_name, form: str):
+    """Static convolution as four digit-matmuls on the MXU.
 
     x: (..., L, B) non-negative limbs < 2^13 (relaxed bound 4097).
     Exactness: x splits into lo7 (< 2^7) and hi (< 2^6) digits, the
     matrices into lo7/hi5; per-digit column sums <= 32*127*127 < 2^19
-    and the recombination sum(p_ab << 7(a+b)) <= 32*4097*4095 < 2^30 —
-    all int32-exact, bit-identical to the unrolled shift-pad FMA chain
+    (int32-exact, and also f32-exact since 2^19 << 2^24 for the bf16
+    form) and the recombination sum(p_ab << 7(a+b)) <= 32*4097*4095
+    < 2^30 — bit-identical to the unrolled shift-pad FMA chain
     (adversarially checked in tests/test_tfield.py)."""
     mlo = _const_mat(lo_np, lo_name)
     mhi = _const_mat(hi_np, hi_name)
-    xlo = (x & 127).astype(jnp.int8)
-    xhi = (x >> 7).astype(jnp.int8)
-    dot = functools.partial(
-        jnp.einsum, preferred_element_type=jnp.int32
-    )
-    p00 = dot("kl,...lb->...kb", mlo, xlo)
-    p01 = dot("kl,...lb->...kb", mlo, xhi)
-    p10 = dot("kl,...lb->...kb", mhi, xlo)
-    p11 = dot("kl,...lb->...kb", mhi, xhi)
+    xlo = x & 127
+    xhi = x >> 7
+    if form == "bf16":
+        dt, acc = jnp.bfloat16, jnp.float32
+    else:
+        dt, acc = jnp.int8, jnp.int32
+
+    def dot(m, v):
+        out = jnp.einsum(
+            "kl,...lb->...kb",
+            m.astype(dt),
+            v.astype(dt),
+            preferred_element_type=acc,
+        )
+        return out.astype(jnp.int32)
+
+    p00 = dot(mlo, xlo)
+    p01 = dot(mlo, xhi)
+    p10 = dot(mhi, xlo)
+    p11 = dot(mhi, xhi)
     return p00 + ((p01 + p10) << 7) + (p11 << 14)
 
 
@@ -247,14 +271,17 @@ def mul_lazy(a, b):
     t = _relax(t, 2 * NB)
 
     t_low = t[..., :NLIMBS, :]
-    if use_mxu_redc():
-        # both static convs as int8 MXU matmuls against Toeplitz digit
+    form = use_mxu_redc()
+    if form:
+        # both static convs as digit MXU matmuls against Toeplitz digit
         # matrices (the _TN mod-R truncation is baked into the matrix)
         m = _relax(
-            _static_conv_mxu(t_low, _TN_LO, _TN_HI, "tn_lo", "tn_hi"),
+            _static_conv_mxu(
+                t_low, _TN_LO, _TN_HI, "tn_lo", "tn_hi", form
+            ),
             NLIMBS,
         )
-        mp = _static_conv_mxu(m, _TP_LO, _TP_HI, "tp_lo", "tp_hi")
+        mp = _static_conv_mxu(m, _TP_LO, _TP_HI, "tp_lo", "tp_hi", form)
     else:
         # shift t_low up by j limbs, truncated at NLIMBS (mod R)
         m = sum(

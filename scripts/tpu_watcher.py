@@ -55,12 +55,20 @@ MEASURE_TIMEOUT = 1500     # per-config deadline (fresh compile included)
 # tracks the Pallas path at growing batch sizes, with xla@1024 as the
 # per-sweep reference point. 30720 ~= the mainnet full-slot load
 # (BASELINE.md north-star config).
+# predcbf (bf16-operand REDC matmuls) goes before predc (int8): the
+# int8 einsum form timed out compiling for 1500 s on its first attempt
+# while the tunnel died mid-sweep; bf16 is the most-trodden Mosaic
+# matmul lowering, so it gets the first slot after the baselines.
+# predc (int8 einsum) is LAST: its one observed attempt burned the full
+# 1500 s compile deadline and the tunnel died — if that repeats, the
+# mid-sweep abort must not cost the headline configs before it.
 SWEEP = [
     ("xla", 1024),
     ("pallas", 4096),
-    ("predc", 4096),
-    ("pallas", 16384),
+    ("predcbf", 4096),
     ("pallas", 30720),
+    ("predcbf", 30720),
+    ("predc", 4096),
 ]
 
 

@@ -87,6 +87,12 @@ def test_mxu_redc_bit_identical(monkeypatch):
     monkeypatch.setenv("LIGHTHOUSE_TPU_MXU_REDC", "1")
     mxu = np.asarray(tf.mul_lazy(_t(a), _t(b)))
     mxu_w = np.asarray(tf.mul_lazy(_t(worst), _t(worst)))
+    assert np.array_equal(base, mxu)
+    assert np.array_equal(base_w, mxu_w)
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU_REDC", "bf16")
+    mxu = np.asarray(tf.mul_lazy(_t(a), _t(b)))
+    mxu_w = np.asarray(tf.mul_lazy(_t(worst), _t(worst)))
 
     assert np.array_equal(base, mxu)
     assert np.array_equal(base_w, mxu_w)
