@@ -254,40 +254,21 @@ def _measure(jax, platform):
             print(f"bench: oppool32k config unavailable: {e}", file=sys.stderr)
             sys.exit(4)
         return bench_oppool.measure(jax, platform)
+    if config == "sync512":
+        return _measure_sync512(jax, platform)
     return _measure_sigsets(jax, platform)
 
 
-def _measure_sigsets(jax, platform):
-    import numpy as np
-
-    from lighthouse_tpu import testing as td
+def _resolve_impl_fn(jax, platform):
+    """Validate BENCH_IMPL, apply its env side effects, and return
+    (impl, jitted verify fn) — shared by every config so an impl added
+    in one place cannot be mislabeled in another. Exits 4 on unknown
+    impls (a typo must not measure the xla path under its label)."""
     from lighthouse_tpu.ops import batch_verify
 
-    smoke = os.environ.get("BENCH_SMOKE") == "1"
-    if os.environ.get("BENCH_NSETS"):
-        n_sets, reps = int(os.environ["BENCH_NSETS"]), 5
-    elif platform == "cpu":
-        n_sets, reps = 16, 3  # fallback: just prove the path end to end
-    elif smoke:
-        n_sets, reps = 128, 3
-    else:
-        n_sets, reps = 1024, 5
-
-    args = td.make_signature_set_batch(
-        n_sets, max_keys=1, seed=0, fast_sequential=True
-    )
-    args = jax.device_put(args)
-
-    # BENCH_IMPL=pallas runs the Miller loop + RLC ladders as fused VMEM
-    # kernels; BENCH_IMPL=ptail additionally runs the product fold +
-    # final exponentiation in-kernel (ops.pallas_tail); BENCH_IMPL=mxu
-    # routes the limb-product contractions through int8 MXU matmuls
-    # (fieldb._conv_contract) on the XLA path
     impl = os.environ.get("BENCH_IMPL", "xla")
     known = ("xla", "mxu", "pallas", "ptail", "txla", "predc", "predcbf")
     if impl not in known:
-        # an unrecognized impl must not fall through to the xla path and
-        # publish a mislabeled headline-eligible record
         print(f"bench: unknown BENCH_IMPL {impl!r}", file=sys.stderr)
         sys.exit(4)
     if impl == "mxu":
@@ -315,18 +296,80 @@ def _measure_sigsets(jax, platform):
         fn = jax.jit(batch_verify.verify_signature_sets_t)
     else:
         fn = jax.jit(batch_verify.verify_signature_sets)
-    t_compile0 = time.perf_counter()
-    ok = bool(np.asarray(fn(*args)))  # compile + warm
-    compile_s = time.perf_counter() - t_compile0
-    assert ok, "benchmark batch failed to verify"
+    return impl, fn
 
+
+def _compile_and_time(jax, fn, args, reps, what):
+    """Compile+warm (asserting the batch verifies), then return
+    (p50 seconds, compile seconds)."""
+    import numpy as np
+
+    t0 = time.perf_counter()
+    ok = bool(np.asarray(fn(*args)))
+    compile_s = time.perf_counter() - t0
+    assert ok, f"{what}: benchmark batch failed to verify"
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         jax.block_until_ready(fn(*args))
         times.append(time.perf_counter() - t0)
-    p50 = sorted(times)[len(times) // 2]
+    return sorted(times)[len(times) // 2], compile_s
 
+
+def _measure_sync512(jax, platform):
+    """BASELINE config #2: 512-key aggregate verification (the
+    sync-committee fast_aggregate_verify shape) — exercises the per-set
+    G1 MSM fold the single-key headline config does not. BENCH_NSETS
+    overrides the aggregate count; the 512-key width is the config."""
+    from lighthouse_tpu import testing as td
+
+    if platform == "cpu":
+        n_sets, n_keys, reps = 2, 8, 3  # prove the path only
+    else:
+        n_sets = int(os.environ.get("BENCH_NSETS") or 64)
+        n_keys, reps = 512, 5
+
+    args = jax.device_put(
+        td.make_aggregate_set_batch(n_sets, n_keys, seed=0)
+    )
+    impl, fn = _resolve_impl_fn(jax, platform)
+    p50, compile_s = _compile_and_time(jax, fn, args, reps, "sync512")
+    on_tpu = platform in ("tpu", "axon")
+    return {
+        "metric": "fast_aggregate_verify_throughput",
+        "value": round(n_sets / p50, 2),
+        "unit": "aggregates/sec",
+        "vs_baseline": 0.0,  # no published reference number for this shape
+        "platform": platform,
+        "impl": impl,
+        "n_sets": n_sets,
+        "n_keys": n_keys,
+        "p50_s": round(p50, 4),
+        "compile_s": round(compile_s, 1),
+        "valid_for_headline": bool(on_tpu and n_keys >= 512),
+    }
+
+
+def _measure_sigsets(jax, platform):
+    from lighthouse_tpu import testing as td
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    if os.environ.get("BENCH_NSETS"):
+        n_sets, reps = int(os.environ["BENCH_NSETS"]), 5
+    elif platform == "cpu":
+        n_sets, reps = 16, 3  # fallback: just prove the path end to end
+    elif smoke:
+        n_sets, reps = 128, 3
+    else:
+        n_sets, reps = 1024, 5
+
+    args = td.make_signature_set_batch(
+        n_sets, max_keys=1, seed=0, fast_sequential=True
+    )
+    args = jax.device_put(args)
+
+    impl, fn = _resolve_impl_fn(jax, platform)
+    p50, compile_s = _compile_and_time(jax, fn, args, reps, "sigsets")
     sigs_per_sec = n_sets / p50
     on_tpu = platform in ("tpu", "axon")
     out = {

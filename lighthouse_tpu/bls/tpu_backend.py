@@ -34,8 +34,10 @@ from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
 from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
 from lighthouse_tpu.ops import batch_verify, curve, fieldb as fb, fp2
 
-_jitted = None
-_jitted_indexed = None
+# jit caches keyed by the impl choice (use_pallas bool) so the
+# LIGHTHOUSE_TPU_IMPL override takes effect at dispatch time
+_jitted: dict = {}
+_jitted_indexed: dict = {}
 
 # host-marshalling telemetry for the last dispatched batch (read by bench)
 LAST_HOST_STATS: dict = {}
@@ -44,31 +46,66 @@ LAST_HOST_STATS: dict = {}
 CALL_COUNTS = {"batch": 0, "individual": 0}
 
 
+def _use_pallas() -> bool:
+    """The fused VMEM kernels (5,425-9,824 sigs/s measured vs the XLA
+    graph's 1,470 — PERF_NOTES.md) lower only on real TPU hardware; the
+    CPU mesh keeps the XLA graph. LIGHTHOUSE_TPU_IMPL=xla|pallas
+    overrides the choice."""
+    import os
+
+    forced = os.environ.get("LIGHTHOUSE_TPU_IMPL")
+    if forced == "pallas":
+        return True
+    if forced == "xla":
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _verify_impl(use_pallas: bool):
+    if use_pallas:
+        return batch_verify.verify_signature_sets_pallas
+    return batch_verify.verify_signature_sets
+
+
 def _get_fn():
-    global _jitted
-    if _jitted is None:
-        _jitted = jax.jit(batch_verify.verify_signature_sets)
-    return _jitted
+    """Jitted verify fn for the CURRENT impl choice. Keyed by the choice
+    (not cached once) so flipping LIGHTHOUSE_TPU_IMPL mid-process takes
+    effect on the next dispatch instead of being baked into the first
+    trace."""
+    choice = _use_pallas()
+    fn = _jitted.get(choice)
+    if fn is None:
+        fn = _jitted[choice] = jax.jit(_verify_impl(choice))
+    return fn
 
 
 def _indexed_verify(
-    msgs, sigs, table_x, table_y, indices, key_mask, rand_bits, set_mask
+    use_pallas, msgs, sigs, table_x, table_y, indices, key_mask,
+    rand_bits, set_mask,
 ):
     """Gather pubkey limb rows by validator index on device, then verify."""
     import jax.numpy as jnp
 
     pk_x = jnp.take(table_x, indices, axis=0)  # (S, K, 1, NB)
     pk_y = jnp.take(table_y, indices, axis=0)
-    return batch_verify.verify_signature_sets(
+    return _verify_impl(use_pallas)(
         msgs, sigs, (pk_x, pk_y), key_mask, rand_bits, set_mask
     )
 
 
 def _get_indexed_fn():
-    global _jitted_indexed
-    if _jitted_indexed is None:
-        _jitted_indexed = jax.jit(_indexed_verify)
-    return _jitted_indexed
+    import functools
+
+    choice = _use_pallas()
+    fn = _jitted_indexed.get(choice)
+    if fn is None:
+        fn = _jitted_indexed[choice] = jax.jit(
+            functools.partial(_indexed_verify, choice)
+        )
+    return fn
 
 
 def _bucket(n: int, minimum: int) -> int:

@@ -61,6 +61,36 @@ def pack_sets_from_points(msgs, sigs, pk_rows, rand_scalars):
     )
 
 
+def make_aggregate_set_batch(n_sets: int, n_keys: int, seed: int = 0):
+    """BASELINE config #2 shape: each set is ONE aggregate signature over
+    one distinct message by exactly `n_keys` distinct pubkeys (the
+    512-member sync-committee `fast_aggregate_verify` shape,
+    signature_sets.rs sync_aggregate role). Built with running point
+    sums — O(S*K) additions + O(S) scalar muls — so S=64 x K=512 packs
+    in seconds.
+
+    Set j holds keys j*K+1 .. j*K+K, so the aggregate secret is
+    K*(j*K) + K*(K+1)/2 and the aggregate signature is one scalar mul
+    of the set's message point."""
+    rng = random.Random(seed)
+    msgs, sigs, pk_rows = [], [], []
+    running_pk = RG1.infinity
+    for j in range(n_sets):
+        h = RG2.mul_scalar(RG2.generator, rng.randrange(2, C.R))
+        msgs.append(RG2.to_affine(h))
+        row = []
+        for _ in range(n_keys):
+            running_pk = RG1.add(running_pk, RG1.generator)
+            row.append(RG1.to_affine(running_pk))
+        pk_rows.append(row)
+        agg_sk = (n_keys * j * n_keys + n_keys * (n_keys + 1) // 2) % C.R
+        sigs.append(RG2.to_affine(RG2.mul_scalar(h, agg_sk)))
+    rand_scalars = [
+        rng.randrange(1, 1 << batch_verify.RAND_BITS) for _ in range(n_sets)
+    ]
+    return pack_sets_from_points(msgs, sigs, pk_rows, rand_scalars)
+
+
 def make_signature_set_batch(
     n_sets: int,
     max_keys: int = 1,

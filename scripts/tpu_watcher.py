@@ -62,12 +62,14 @@ MEASURE_TIMEOUT = 1500     # per-config deadline (fresh compile included)
 # predc (int8 einsum) is LAST: its one observed attempt burned the full
 # 1500 s compile deadline and the tunnel died — if that repeats, the
 # mid-sweep abort must not cost the headline configs before it.
+# Entries are (impl, n_sets) or (impl, n_sets, BENCH_CONFIG).
 SWEEP = [
     ("xla", 1024),
     ("pallas", 4096),
     ("predcbf", 4096),
     ("pallas", 30720),
     ("predcbf", 30720),
+    ("pallas", 64, "sync512"),
     ("predc", 4096),
 ]
 
@@ -83,7 +85,7 @@ def probe() -> bool:
     return _tpu_probe_ok(timeout_s=PROBE_TIMEOUT)
 
 
-def run_one(impl: str, n_sets: int, cache_dir: str):
+def run_one(impl: str, n_sets: int, cache_dir: str, config: str = "sigsets"):
     """One measurement config in a subprocess; returns the parsed JSON
     line or None."""
     env = dict(
@@ -93,6 +95,7 @@ def run_one(impl: str, n_sets: int, cache_dir: str):
         BENCH_SKIP_PROBE="1",  # the watcher just probed; don't re-probe
         BENCH_IMPL=impl,
         BENCH_NSETS=str(n_sets),
+        BENCH_CONFIG=config,
         LIGHTHOUSE_TPU_CACHE_DIR=cache_dir,
     )
     try:
@@ -126,8 +129,12 @@ def run_one(impl: str, n_sets: int, cache_dir: str):
     except (json.JSONDecodeError, KeyError, TypeError) as e:
         log(f"  {impl} S={n_sets}: unparseable output ({e!r}): {lines[-1]!r}")
         return None
+    unit = rec.get("unit", "sigs/sec")
+    tag = f"{impl} S={n_sets}" if config == "sigsets" else (
+        f"{impl} {config} S={rec.get('n_sets')}"
+    )
     log(
-        f"  {impl} S={n_sets}: {value} sigs/s "
+        f"  {tag}: {value} {unit} "
         f"(p50 {rec.get('p50_s')}s, compile {rec.get('compile_s')}s, "
         f"platform {rec.get('platform')})"
     )
@@ -167,7 +174,9 @@ def sweep() -> int:
     n_fail = 0
     cache_dir = tempfile.mkdtemp(prefix="jaxcache_tpu_")
     try:
-        for i, (impl, n_sets) in enumerate(SWEEP):
+        for i, entry in enumerate(SWEEP):
+            impl, n_sets = entry[0], entry[1]
+            config = entry[2] if len(entry) > 2 else "sigsets"
             if os.path.exists(STOP_FILE):
                 break
             # The tunnel dies MID-sweep routinely (observed: config 1
@@ -178,7 +187,7 @@ def sweep() -> int:
             if n_fail and not probe():
                 log("tunnel died mid-sweep; aborting remaining configs")
                 break
-            rec = run_one(impl, n_sets, cache_dir)
+            rec = run_one(impl, n_sets, cache_dir, config)
             if rec is not None and rec.get("platform") in ("tpu", "axon"):
                 append_measurement(rec)
                 n_ok += 1

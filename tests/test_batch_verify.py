@@ -129,3 +129,28 @@ def test_sharded_graph_size_pinned():
         f"sharded verify graph grew to {n} equations — compile time "
         f"scales with this; check for unrolled loops / lost scan rolling"
     )
+
+
+def test_aggregate_set_batch_verifies():
+    """BASELINE config #2 fixture (make_aggregate_set_batch: one
+    aggregate signature by exactly K keys per set) verifies, and a
+    tampered aggregate fails."""
+    import jax
+    import numpy as np
+
+    from lighthouse_tpu import testing as td
+    from lighthouse_tpu.ops import batch_verify
+
+    args = td.make_aggregate_set_batch(2, 5, seed=3)
+    assert bool(np.asarray(jax.jit(batch_verify.verify_signature_sets)(*args)))
+    msgs, sigs, pks, km, rb, sm = args
+    bad0 = np.array(sigs[0])
+    bad0[1, 0, 0] += 1
+    ok = bool(
+        np.asarray(
+            jax.jit(batch_verify.verify_signature_sets)(
+                msgs, (bad0, sigs[1]), pks, km, rb, sm
+            )
+        )
+    )
+    assert not ok
