@@ -27,7 +27,7 @@ Env knobs:
   BENCH_NSETS=N             batch size override
   BENCH_REQUIRE_TPU=1       exit(3) instead of any CPU fallback/replay
   BENCH_SMOKE=1             small batch
-  BENCH_CONFIG=oppool32k    run the 32k-gossip-attestation config instead
+  BENCH_CONFIG=oppool32k|sync512|block   alternate BASELINE configs (#4, #2, #3)
 """
 
 import json
@@ -130,9 +130,12 @@ def _best_recorded_measurement(metric="verify_signature_sets_throughput"):
 
 
 def _active_metric():
-    if os.environ.get("BENCH_CONFIG", "sigsets") == "oppool32k":
-        return "oppool32k_throughput"
-    return "verify_signature_sets_throughput"
+    cfg = os.environ.get("BENCH_CONFIG", "sigsets")
+    return {
+        "oppool32k": "oppool32k_throughput",
+        "sync512": "fast_aggregate_verify_throughput",
+        "block": "block_signature_verify_throughput",
+    }.get(cfg, "verify_signature_sets_throughput")
 
 
 def _run_cpu_fallback(allow_replay: bool = True):
@@ -256,6 +259,8 @@ def _measure(jax, platform):
         return bench_oppool.measure(jax, platform)
     if config == "sync512":
         return _measure_sync512(jax, platform)
+    if config == "block":
+        return _measure_block(jax, platform)
     return _measure_sigsets(jax, platform)
 
 
@@ -347,6 +352,45 @@ def _measure_sync512(jax, platform):
         "p50_s": round(p50, 4),
         "compile_s": round(compile_s, 1),
         "valid_for_headline": bool(on_tpu and n_keys >= 512),
+    }
+
+
+def _measure_block(jax, platform):
+    """BASELINE config #3: one full mainnet-ish block's signature sets
+    (proposal + randao + 128 committee-aggregate attestations + exits)
+    verified in one batch — the BlockSignatureVerifier
+    (block_signature_verifier.rs:120-131) shape."""
+    from lighthouse_tpu import testing as td
+
+    if platform == "cpu":
+        n_att, committee, reps = 4, 8, 3  # prove the path only
+    else:
+        # BENCH_NSETS = total sets; 4 are the proposal/randao/exit singles
+        n_sets_env = os.environ.get("BENCH_NSETS")
+        n_att = (int(n_sets_env) - 4) if n_sets_env else 128
+        committee, reps = 256, 5
+
+    args = jax.device_put(
+        td.make_block_sets_batch(
+            seed=0, n_attestations=n_att, committee_size=committee
+        )
+    )
+    impl, fn = _resolve_impl_fn(jax, platform)
+    p50, compile_s = _compile_and_time(jax, fn, args, reps, "block")
+    on_tpu = platform in ("tpu", "axon")
+    return {
+        "metric": "block_signature_verify_throughput",
+        "value": round(1.0 / p50, 2),
+        "unit": "blocks/sec",
+        "vs_baseline": 0.0,  # no published reference number for this shape
+        "platform": platform,
+        "impl": impl,
+        "n_sets": n_att + 4,
+        "n_attestations": n_att,
+        "committee_size": committee,
+        "p50_s": round(p50, 4),
+        "compile_s": round(compile_s, 1),
+        "valid_for_headline": bool(on_tpu and n_att >= 128),
     }
 
 

@@ -61,34 +61,58 @@ def pack_sets_from_points(msgs, sigs, pk_rows, rand_scalars):
     )
 
 
-def make_aggregate_set_batch(n_sets: int, n_keys: int, seed: int = 0):
-    """BASELINE config #2 shape: each set is ONE aggregate signature over
-    one distinct message by exactly `n_keys` distinct pubkeys (the
-    512-member sync-committee `fast_aggregate_verify` shape,
-    signature_sets.rs sync_aggregate role). Built with running point
-    sums — O(S*K) additions + O(S) scalar muls — so S=64 x K=512 packs
-    in seconds.
+def make_aggregate_set_batch(
+    n_sets: int, n_keys: int, seed: int = 0, keys_per_set=None
+):
+    """Aggregate-signature fixtures: each set is ONE aggregate signature
+    over one distinct message by a fixed (or per-set, via
+    `keys_per_set`) number of distinct pubkeys. Shapes:
 
-    Set j holds keys j*K+1 .. j*K+K, so the aggregate secret is
-    K*(j*K) + K*(K+1)/2 and the aggregate signature is one scalar mul
-    of the set's message point."""
+      * BASELINE config #2 (sync-committee fast_aggregate_verify,
+        signature_sets.rs sync_aggregate role): n_keys=512;
+      * BASELINE config #3 (full-block BlockSignatureVerifier): a
+        ragged keys_per_set list — single-key proposal/randao/exit sets
+        plus committee-sized attestation aggregates.
+
+    Built with running point sums — O(total keys) additions + O(S)
+    scalar muls — so S=64 x K=512 packs in seconds. Keys are assigned
+    sequentially across sets, so set j (starting at global key base_j)
+    has aggregate secret K_j*base_j + K_j*(K_j+1)/2 and its aggregate
+    signature is one scalar mul of the set's message point."""
     rng = random.Random(seed)
+    if keys_per_set is None:
+        keys_per_set = [n_keys] * n_sets
+    else:
+        n_sets = len(keys_per_set)  # the list IS the shape
     msgs, sigs, pk_rows = [], [], []
     running_pk = RG1.infinity
+    base = 0
     for j in range(n_sets):
+        k = keys_per_set[j]
         h = RG2.mul_scalar(RG2.generator, rng.randrange(2, C.R))
         msgs.append(RG2.to_affine(h))
         row = []
-        for _ in range(n_keys):
+        for _ in range(k):
             running_pk = RG1.add(running_pk, RG1.generator)
             row.append(RG1.to_affine(running_pk))
         pk_rows.append(row)
-        agg_sk = (n_keys * j * n_keys + n_keys * (n_keys + 1) // 2) % C.R
+        agg_sk = (k * base + k * (k + 1) // 2) % C.R
         sigs.append(RG2.to_affine(RG2.mul_scalar(h, agg_sk)))
+        base += k
     rand_scalars = [
         rng.randrange(1, 1 << batch_verify.RAND_BITS) for _ in range(n_sets)
     ]
     return pack_sets_from_points(msgs, sigs, pk_rows, rand_scalars)
+
+
+def make_block_sets_batch(seed: int = 0, n_attestations: int = 128,
+                          committee_size: int = 256):
+    """BASELINE config #3 shape — every signature set of one full
+    mainnet-ish block as BlockSignatureVerifier collects them
+    (block_signature_verifier.rs:120-333): proposal + randao (single
+    key), `n_attestations` committee aggregates, and two exits."""
+    keys = [1, 1] + [committee_size] * n_attestations + [1, 1]
+    return make_aggregate_set_batch(0, 0, seed=seed, keys_per_set=keys)
 
 
 def make_signature_set_batch(

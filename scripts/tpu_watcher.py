@@ -70,6 +70,7 @@ SWEEP = [
     ("pallas", 30720),
     ("predcbf", 30720),
     ("pallas", 64, "sync512"),
+    ("pallas", 132, "block"),
     ("predc", 4096),
 ]
 

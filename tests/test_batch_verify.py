@@ -154,3 +154,16 @@ def test_aggregate_set_batch_verifies():
         )
     )
     assert not ok
+
+
+def test_block_sets_batch_verifies():
+    """BASELINE config #3 fixture (ragged per-set key counts: proposal/
+    randao/exit singles + committee aggregates) verifies end to end."""
+    import jax
+    import numpy as np
+
+    from lighthouse_tpu import testing as td
+    from lighthouse_tpu.ops import batch_verify
+
+    args = td.make_block_sets_batch(seed=5, n_attestations=2, committee_size=3)
+    assert bool(np.asarray(jax.jit(batch_verify.verify_signature_sets)(*args)))
