@@ -27,7 +27,7 @@ Env knobs:
   BENCH_NSETS=N             batch size override
   BENCH_REQUIRE_TPU=1       exit(3) instead of any CPU fallback/replay
   BENCH_SMOKE=1             small batch
-  BENCH_CONFIG=oppool32k|sync512|block   alternate BASELINE configs (#4, #2, #3)
+  BENCH_CONFIG=oppool32k|sync512|block|replay32   BASELINE configs #4/#2/#3/#5
 """
 
 import json
@@ -135,6 +135,7 @@ def _active_metric():
         "oppool32k": "oppool32k_throughput",
         "sync512": "fast_aggregate_verify_throughput",
         "block": "block_signature_verify_throughput",
+        "replay32": "epoch_replay_slots_per_sec",
     }.get(cfg, "verify_signature_sets_throughput")
 
 
@@ -261,6 +262,10 @@ def _measure(jax, platform):
         return _measure_sync512(jax, platform)
     if config == "block":
         return _measure_block(jax, platform)
+    if config == "replay32":
+        from lighthouse_tpu import bench_replay
+
+        return bench_replay.measure(jax, platform)
     return _measure_sigsets(jax, platform)
 
 
@@ -367,6 +372,12 @@ def _measure_block(jax, platform):
     else:
         # BENCH_NSETS = total sets; 4 are the proposal/randao/exit singles
         n_sets_env = os.environ.get("BENCH_NSETS")
+        if n_sets_env and int(n_sets_env) < 5:
+            print(
+                f"bench: block config needs BENCH_NSETS >= 5, got "
+                f"{n_sets_env}", file=sys.stderr,
+            )
+            sys.exit(4)
         n_att = (int(n_sets_env) - 4) if n_sets_env else 128
         committee, reps = 256, 5
 

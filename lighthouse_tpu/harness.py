@@ -277,14 +277,16 @@ class Harness:
 
     # ----------------------------------------------------------- driving
 
-    def advance_slot_with_block(self, slot: int):
+    def advance_slot_with_block(self, slot: int, strategy=None):
         """Produce + import the block for `slot` including all pending
-        attestations, then attest at `slot` with every committee."""
+        attestations, then attest at `slot` with every committee.
+        `strategy` forwards to import_block (e.g. NO_VERIFICATION for a
+        builder whose blocks will be verified elsewhere)."""
         capacity = self.spec.MAX_ATTESTATIONS
         atts = self.pending_attestations[:capacity]
         self.pending_attestations = self.pending_attestations[capacity:]
         block = self.produce_block(slot, atts)
-        self.import_block(block)
+        self.import_block(block, strategy=strategy)
         self.pending_attestations.extend(
             self.make_attestations(self.state, slot)
         )

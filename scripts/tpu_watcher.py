@@ -71,6 +71,7 @@ SWEEP = [
     ("predcbf", 30720),
     ("pallas", 64, "sync512"),
     ("pallas", 132, "block"),
+    ("pallas", 32, "replay32"),
     ("predc", 4096),
 ]
 
