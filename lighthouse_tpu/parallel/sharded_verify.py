@@ -50,11 +50,39 @@ def _gather_fold_points(group, pt, axis_name):
     return group.sum_axis(gathered, axis=0)
 
 
-def sharded_verify_signature_sets(mesh):
+def _butterfly_reduce(val, combine, axis_name, axis_size: int):
+    """All-reduce a per-device partial with a log2(n) recursive-doubling
+    butterfly of ppermute exchanges + `combine` steps — the ICI-native
+    reduction for values whose combine is a GROUP law, not a
+    componentwise add (SURVEY §2.6 TP row: MSM partial-sum reduction
+    over ICI; psum cannot express point addition or Fp12
+    multiplication). Each step exchanges with the device 2^k away;
+    after log2(n) steps every device holds the total, replicated —
+    exactly what all_gather + local fold produces, without
+    materializing n copies per device. Axis size must be a power of
+    two (callers fall back to gather+fold otherwise)."""
+    assert axis_size & (axis_size - 1) == 0, axis_size
+    step = 1
+    while step < axis_size:
+        perm = [(i, i ^ step) for i in range(axis_size)]
+        other = jax.tree_util.tree_map(
+            lambda c: jax.lax.ppermute(c, axis_name, perm), val
+        )
+        val = combine(val, other)
+        step *= 2
+    return val
+
+
+def sharded_verify_signature_sets(mesh, ring: bool = False):
     """Build the jitted multi-chip verify step for a given mesh.
 
     Returns fn(msgs, sigs, pubkeys, key_mask, rand_bits, set_mask) -> bool.
     Global shapes: S divisible by mesh 'sets' size, K by 'keys' size.
+
+    ring=True replaces every all_gather+fold reduction with the
+    recursive-doubling ppermute butterfly (_butterfly_reduce) — point
+    sums over "keys"/"sets" and the Fp12 product over "sets" — when the
+    axis is a power of two (gather+fold otherwise).
     """
     bundle = P("sets", None, None)        # (S, slots, NB)
     pk_leaf = P("sets", "keys", None, None)  # (S, K, 1, NB)
@@ -69,10 +97,16 @@ def sharded_verify_signature_sets(mesh):
     )
     out_specs = P()
 
+    def _reduce_points(group, pt, axis_name):
+        n = mesh.shape[axis_name]
+        if ring and n & (n - 1) == 0:
+            return _butterfly_reduce(pt, group.add, axis_name, n)
+        return _gather_fold_points(group, pt, axis_name)
+
     def step(msgs, sigs, pubkeys, key_mask, rand_bits, set_mask):
         # ---- keys-axis: partial pubkey aggregation + reduction
         partial_pk = batch_verify.aggregate_pubkeys(pubkeys, key_mask)
-        agg_pk = _gather_fold_points(curve.PG1, partial_pk, "keys")
+        agg_pk = _reduce_points(curve.PG1, partial_pk, "keys")
 
         # ---- per-set RLC scale + affinize
         agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
@@ -82,7 +116,7 @@ def sharded_verify_signature_sets(mesh):
         local_sig = batch_verify.rlc_combined_signature(
             sigs, rand_bits, set_mask
         )
-        sig_acc = _gather_fold_points(curve.PG2, local_sig, "sets")
+        sig_acc = _reduce_points(curve.PG2, local_sig, "sets")
         s_x, s_y, s_inf = curve.PG2.to_affine(
             jax.tree_util.tree_map(lambda t: t[None], sig_acc)
         )
@@ -98,8 +132,14 @@ def sharded_verify_signature_sets(mesh):
         # the same sets product; gather over "sets" only, then dedupe "keys"
         # by construction — every device already holds identical values along
         # "keys", so gathering "sets" suffices).
-        gathered = jax.lax.all_gather(prod_local, "sets")
-        prod = tower.fp12_product_axis(gathered, axis=0)
+        n_sets_axis = mesh.shape["sets"]
+        if ring and n_sets_axis & (n_sets_axis - 1) == 0:
+            prod = _butterfly_reduce(
+                prod_local, tower.fp12_mul, "sets", n_sets_axis
+            )
+        else:
+            gathered = jax.lax.all_gather(prod_local, "sets")
+            prod = tower.fp12_product_axis(gathered, axis=0)
 
         # ---- the single signature pair, multiplied in once (replicated)
         neg_g1 = (

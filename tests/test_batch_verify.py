@@ -167,3 +167,17 @@ def test_block_sets_batch_verifies():
 
     args = td.make_block_sets_batch(seed=5, n_attestations=2, committee_size=3)
     assert bool(np.asarray(jax.jit(batch_verify.verify_signature_sets)(*args)))
+
+
+def test_sharded_ring_reduction_matches():
+    """ring=True (recursive-doubling ppermute butterflies for the point
+    and Fp12 reductions) gives the same verdicts as the all_gather+fold
+    path on the same mesh."""
+    mesh = make_mesh(n_sets=4, n_keys=2)
+    fn = sharded_verify_signature_sets(mesh, ring=True)
+    good = td.make_signature_set_batch(8, max_keys=2, seed=5)
+    bad = td.make_signature_set_batch(
+        8, max_keys=2, seed=5, corrupt_indices=(3,)
+    )
+    assert bool(np.asarray(fn(*good)))
+    assert not bool(np.asarray(fn(*bad)))
