@@ -274,20 +274,11 @@ def _resolve_impl_fn(jax, platform):
     (impl, jitted verify fn) — shared by every config so an impl added
     in one place cannot be mislabeled in another. Exits 4 on unknown
     impls (a typo must not measure the xla path under its label)."""
+    from lighthouse_tpu.bench_impl import apply_impl_env
     from lighthouse_tpu.ops import batch_verify
 
     impl = os.environ.get("BENCH_IMPL", "xla")
-    known = ("xla", "mxu", "pallas", "ptail", "txla", "predc", "predcbf")
-    if impl not in known:
-        print(f"bench: unknown BENCH_IMPL {impl!r}", file=sys.stderr)
-        sys.exit(4)
-    if impl == "mxu":
-        os.environ["LIGHTHOUSE_TPU_MXU_CONV"] = "1"
-    if impl == "predc":
-        # pallas kernels with the static REDC convolutions on the MXU
-        os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "i8"
-    if impl == "predcbf":
-        os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "bf16"
+    apply_impl_env(impl)
     if impl in ("pallas", "ptail", "predc", "predcbf"):
         import functools
 

@@ -1,0 +1,24 @@
+"""BENCH_IMPL validation + env side effects, shared by every benchmark
+config (bench.py configs and lighthouse_tpu.bench_replay) so an impl
+added or renamed in one place cannot be silently mislabeled in another."""
+
+import os
+import sys
+
+KNOWN_IMPLS = ("xla", "mxu", "pallas", "ptail", "txla", "predc", "predcbf")
+
+
+def apply_impl_env(impl: str, what: str = "bench") -> None:
+    """Validate `impl` and apply its process-env side effects. Exits 4
+    on an unknown impl — a typo must not measure the default path under
+    its label."""
+    if impl not in KNOWN_IMPLS:
+        print(f"{what}: unknown BENCH_IMPL {impl!r}", file=sys.stderr)
+        sys.exit(4)
+    if impl == "mxu":
+        os.environ["LIGHTHOUSE_TPU_MXU_CONV"] = "1"
+    if impl == "predc":
+        # pallas kernels with the static REDC convolutions on the MXU
+        os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "i8"
+    if impl == "predcbf":
+        os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "bf16"

@@ -31,6 +31,26 @@ def measure(jax, platform):
     from lighthouse_tpu.types.spec import minimal_spec
 
     on_tpu = platform in ("tpu", "axon")
+
+    # ---- impl selection FIRST (cheap; a typo must fail before the
+    # minutes-long segment build). The harness verifies through the bls
+    # backend dispatch, steered by LIGHTHOUSE_TPU_IMPL. With BENCH_IMPL
+    # unset the dispatch keeps its own auto-selection (Pallas on real
+    # TPU) — pinning xla here would silently regress the default replay
+    # measurement several-fold.
+    impl = os.environ.get("BENCH_IMPL")
+    if impl is not None:
+        from lighthouse_tpu.bench_impl import apply_impl_env
+
+        apply_impl_env(impl, what="replay32")
+        if on_tpu:
+            os.environ["LIGHTHOUSE_TPU_IMPL"] = (
+                "xla" if impl in ("xla", "txla", "mxu") else "pallas"
+            )
+        impl_label = impl
+    else:
+        impl_label = "auto:pallas" if on_tpu else "auto:xla"
+
     # BENCH_NSETS (the watcher's generic size knob) maps to the slot
     # count; BENCH_REPLAY_SLOTS takes precedence when both are set.
     n_slots = int(
@@ -62,27 +82,6 @@ def measure(jax, platform):
             )
         )
 
-    # ---- impl selection: the harness verifies through the bls backend
-    # dispatch, steered by LIGHTHOUSE_TPU_IMPL; validate BENCH_IMPL so a
-    # typo cannot measure the default path under its label. On the CPU
-    # prove-the-path run the kernels cannot lower, so xla is forced.
-    impl = os.environ.get("BENCH_IMPL", "xla")
-    if impl not in ("xla", "pallas", "predc", "predcbf"):
-        import sys
-
-        print(f"bench: replay32 unsupported BENCH_IMPL {impl!r}",
-              file=sys.stderr)
-        sys.exit(4)
-    if not on_tpu:
-        impl = "xla"
-    os.environ["LIGHTHOUSE_TPU_IMPL"] = (
-        "pallas" if impl in ("pallas", "predc", "predcbf") else "xla"
-    )
-    if impl == "predc":
-        os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "i8"
-    if impl == "predcbf":
-        os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "bf16"
-
     n_sigs = 0
     for b in blocks:
         # proposal + randao + one set per attestation (+ sync aggregate)
@@ -111,7 +110,7 @@ def measure(jax, platform):
         "unit": "slots/sec",
         "vs_baseline": 0.0,  # no published reference number for this shape
         "platform": platform,
-        "impl": impl,
+        "impl": impl_label,
         "n_sets": n_slots,  # the watcher's generic size field
         "n_slots": n_slots,
         "n_validators": n_validators,

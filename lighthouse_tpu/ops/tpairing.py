@@ -19,7 +19,12 @@ import jax.numpy as jnp
 
 from lighthouse_tpu.crypto.constants import BLS_X, BLS_X_ABS
 from lighthouse_tpu.ops import tfield as tf
-from lighthouse_tpu.ops.programs import FP2_MUL, FP12_MUL, LINE_MUL
+from lighthouse_tpu.ops.programs import (
+    FP2_MUL,
+    FP12_MUL,
+    FP12_SQR,
+    LINE_MUL,
+)
 
 NB = tf.NB
 
@@ -34,7 +39,8 @@ def bilinear(x, y, prog):
 
 
 def fp12_sqr(f):
-    return bilinear(f, f, FP12_MUL)
+    # dedicated complex-squaring program: 12 products vs the mul's 18
+    return bilinear(f, f, FP12_SQR)
 
 
 def fp12_mul(a, b):
