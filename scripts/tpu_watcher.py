@@ -63,15 +63,21 @@ MEASURE_TIMEOUT = 1500     # per-config deadline (fresh compile included)
 # 1500 s compile deadline and the tunnel died — if that repeats, the
 # mid-sweep abort must not cost the headline configs before it.
 # Entries are (impl, n_sets) or (impl, n_sets, BENCH_CONFIG).
+# The unproven MXU-REDC forms run LAST: the one observed predc attempt
+# burned the full 1500 s compile deadline and the tunnel died, and
+# predcbf may share the einsum lowering path — a repeat must not cost
+# the headline and BASELINE-config measurements queued before it
+# (scripts/probe_mxu_forms.py settles the form question with bounded
+# micro-kernels first).
 SWEEP = [
     ("xla", 1024),
     ("pallas", 4096),
-    ("predcbf", 4096),
     ("pallas", 30720),
-    ("predcbf", 30720),
     ("pallas", 64, "sync512"),
     ("pallas", 132, "block"),
     ("pallas", 32, "replay32"),
+    ("predcbf", 4096),
+    ("predcbf", 30720),
     ("predc", 4096),
 ]
 
