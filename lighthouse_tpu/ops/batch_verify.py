@@ -133,7 +133,10 @@ def verify_signature_sets_individual(
         e(agg_pk_i, H_i) * e(-G1, sig_i) == 1.
 
     No RLC is needed — each set is its own independent pairing check; the
-    Miller loop runs over 2S pairs and the final exponentiation is
+    Miller loop runs over 2S pairs (so one poisoned batch costs ~2x a
+    full batch verify — accepted: batch failures are rare and the
+    alternative, residue bisection, would cost device round trips the
+    <=2-call bound forbids) and the final exponentiation is
     batched per set. Returns a (S,) bool array (padding lanes True)."""
     S = set_mask.shape[0]
     agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)
