@@ -92,6 +92,8 @@ def _build_fixture(n_atts: int, n_committees: int, seed: int):
 
 
 def measure(jax, platform) -> dict:
+    import sys
+
     import numpy as np
 
     from lighthouse_tpu import bls
@@ -100,12 +102,44 @@ def measure(jax, platform) -> dict:
     from lighthouse_tpu.crypto.ref_curve import G2 as RG2
 
     on_tpu = platform in ("tpu", "axon")
+
+    # ---- impl selection FIRST (same contract as bench_replay): the
+    # verify phase goes through the bls backend dispatch, which knows
+    # the xla|pallas program pair plus the MXU env knobs. txla/ptail
+    # exist only as standalone bench programs — accepting them would
+    # record the plain path under their label (exit-4 rule).
+    impl = os.environ.get("BENCH_IMPL")
+    if impl is not None:
+        from lighthouse_tpu.bench_impl import apply_impl_env
+
+        apply_impl_env(impl, what="oppool32k")
+        if impl in ("txla", "ptail"):
+            print(
+                f"oppool32k: BENCH_IMPL={impl} has no backend dispatch;"
+                " use xla|mxu|pallas|predc|predcbf",
+                file=sys.stderr,
+            )
+            sys.exit(4)
+        if on_tpu:
+            os.environ["LIGHTHOUSE_TPU_IMPL"] = (
+                "xla" if impl in ("xla", "mxu") else "pallas"
+            )
+        impl_label = impl
+    else:
+        impl_label = "auto:pallas" if on_tpu else "auto:xla"
+
     n_committees = int(
         os.environ.get("BENCH_OPPOOL_COMMITTEES", "64" if on_tpu else "8")
     )
-    # CPU fallback is a path-proof only: compiles dominate at any size
+    # CPU fallback is a path-proof only: compiles dominate at any size.
+    # BENCH_NSETS (the watcher's generic size knob) maps to the
+    # attestation count; BENCH_OPPOOL_N takes precedence when both set.
     default_n = 32_768 if on_tpu else 64
-    n_atts = int(os.environ.get("BENCH_OPPOOL_N", str(default_n)))
+    n_atts = int(
+        os.environ.get("BENCH_OPPOOL_N")
+        or os.environ.get("BENCH_NSETS")
+        or default_n
+    )
     chunk = 1024 if on_tpu else 32
 
     msgs, pk_bytes, sig_bytes, committee_of = _build_fixture(
@@ -174,6 +208,7 @@ def measure(jax, platform) -> dict:
         "unit": "sigs/sec",
         "vs_baseline": round(sigs_per_sec / TARGET_SIGS_PER_SEC, 4),
         "platform": platform,
+        "impl": impl_label,
         "n_sets": n_atts,
         "committees": n_committees,
         "phase_s": {

@@ -40,12 +40,28 @@ def measure(jax, platform):
     # measurement several-fold.
     impl = os.environ.get("BENCH_IMPL")
     if impl is not None:
+        import sys
+
         from lighthouse_tpu.bench_impl import apply_impl_env
 
         apply_impl_env(impl, what="replay32")
+        # The harness verifies through the bls backend dispatch, which
+        # only knows the xla|pallas program pair (+ the MXU env knobs
+        # apply_impl_env just set). txla (bench-only transposed layout)
+        # and ptail (in-kernel final exp) exist only as standalone bench
+        # programs — accepting them here would measure the plain
+        # xla/pallas path under their label, the exact mislabeling the
+        # exit-4 rule exists to prevent.
+        if impl in ("txla", "ptail"):
+            print(
+                f"replay32: BENCH_IMPL={impl} has no backend dispatch;"
+                " use xla|mxu|pallas|predc|predcbf",
+                file=sys.stderr,
+            )
+            sys.exit(4)
         if on_tpu:
             os.environ["LIGHTHOUSE_TPU_IMPL"] = (
-                "xla" if impl in ("xla", "txla", "mxu") else "pallas"
+                "xla" if impl in ("xla", "mxu") else "pallas"
             )
         impl_label = impl
     else:

@@ -34,8 +34,10 @@ from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
 from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
 from lighthouse_tpu.ops import batch_verify, curve, fieldb as fb, fp2
 
-# jit caches keyed by the impl choice (use_pallas bool) so the
-# LIGHTHOUSE_TPU_IMPL override takes effect at dispatch time
+# jit caches keyed by the full impl choice — the LIGHTHOUSE_TPU_IMPL
+# selection AND the MXU knobs (MXU_REDC/MXU_CONV) that fieldb reads at
+# trace time — so flipping ANY of them mid-process retraces instead of
+# silently reusing a stale trace
 _jitted: dict = {}
 _jitted_indexed: dict = {}
 
@@ -50,7 +52,9 @@ def _use_pallas() -> bool:
     """The fused VMEM kernels (5,425-9,824 sigs/s measured vs the XLA
     graph's 1,470 — PERF_NOTES.md) lower only on real TPU hardware; the
     CPU mesh keeps the XLA graph. LIGHTHOUSE_TPU_IMPL=xla|pallas
-    overrides the choice."""
+    overrides the choice; any other value raises (fail-loud, matching
+    bench_impl's exit-4 rule — a typo must not silently measure the
+    auto-selected path)."""
     import os
 
     forced = os.environ.get("LIGHTHOUSE_TPU_IMPL")
@@ -58,10 +62,34 @@ def _use_pallas() -> bool:
         return True
     if forced == "xla":
         return False
+    # "" follows the shell convention for unset (tfield.use_mxu_redc
+    # treats its knob the same way)
+    if forced:
+        raise ValueError(
+            f"LIGHTHOUSE_TPU_IMPL={forced!r}: expected 'xla', 'pallas',"
+            " or unset"
+        )
     try:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:
         return False
+
+
+def _impl_key():
+    """(use_pallas, MXU_REDC form, MXU_CONV on) — everything read at
+    trace time that changes the compiled program, NORMALIZED the way the
+    kernels consume it (tfield.use_mxu_redc maps "1"/"i8" to one form;
+    fieldb only tests MXU_CONV == "1") so equivalent spellings share one
+    trace instead of recompiling."""
+    from lighthouse_tpu.ops import tfield
+
+    import os
+
+    return (
+        _use_pallas(),
+        tfield.use_mxu_redc(),
+        os.environ.get("LIGHTHOUSE_TPU_MXU_CONV") == "1",
+    )
 
 
 def _verify_impl(use_pallas: bool):
@@ -72,13 +100,13 @@ def _verify_impl(use_pallas: bool):
 
 def _get_fn():
     """Jitted verify fn for the CURRENT impl choice. Keyed by the choice
-    (not cached once) so flipping LIGHTHOUSE_TPU_IMPL mid-process takes
-    effect on the next dispatch instead of being baked into the first
-    trace."""
-    choice = _use_pallas()
-    fn = _jitted.get(choice)
+    (not cached once) so flipping LIGHTHOUSE_TPU_IMPL or an MXU knob
+    mid-process takes effect on the next dispatch instead of being baked
+    into the first trace."""
+    key = _impl_key()
+    fn = _jitted.get(key)
     if fn is None:
-        fn = _jitted[choice] = jax.jit(_verify_impl(choice))
+        fn = _jitted[key] = jax.jit(_verify_impl(key[0]))
     return fn
 
 
@@ -99,11 +127,11 @@ def _indexed_verify(
 def _get_indexed_fn():
     import functools
 
-    choice = _use_pallas()
-    fn = _jitted_indexed.get(choice)
+    key = _impl_key()
+    fn = _jitted_indexed.get(key)
     if fn is None:
-        fn = _jitted_indexed[choice] = jax.jit(
-            functools.partial(_indexed_verify, choice)
+        fn = _jitted_indexed[key] = jax.jit(
+            functools.partial(_indexed_verify, key[0])
         )
     return fn
 

@@ -55,20 +55,14 @@ MEASURE_TIMEOUT = 1500     # per-config deadline (fresh compile included)
 # tracks the Pallas path at growing batch sizes, with xla@1024 as the
 # per-sweep reference point. 30720 ~= the mainnet full-slot load
 # (BASELINE.md north-star config).
-# predcbf (bf16-operand REDC matmuls) goes before predc (int8): the
-# int8 einsum form timed out compiling for 1500 s on its first attempt
-# while the tunnel died mid-sweep; bf16 is the most-trodden Mosaic
-# matmul lowering, so it gets the first slot after the baselines.
-# predc (int8 einsum) is LAST: its one observed attempt burned the full
-# 1500 s compile deadline and the tunnel died — if that repeats, the
-# mid-sweep abort must not cost the headline configs before it.
 # Entries are (impl, n_sets) or (impl, n_sets, BENCH_CONFIG).
-# The unproven MXU-REDC forms run LAST: the one observed predc attempt
-# burned the full 1500 s compile deadline and the tunnel died, and
-# predcbf may share the einsum lowering path — a repeat must not cost
-# the headline and BASELINE-config measurements queued before it
-# (scripts/probe_mxu_forms.py settles the form question with bounded
-# micro-kernels first).
+# The unproven MXU-REDC forms run LAST, predcbf before predc: the one
+# observed predc (int8 einsum) attempt burned the full 1500 s compile
+# deadline and then the tunnel died, while bf16 is the most-trodden
+# Mosaic matmul lowering — so a repeat of the compile blow-up must not
+# cost the headline and BASELINE-config measurements queued before it
+# (scripts/probe_mxu_forms.py settles the matmul-form question with
+# bounded micro-kernels first).
 SWEEP = [
     ("xla", 1024),
     ("pallas", 4096),
@@ -76,6 +70,7 @@ SWEEP = [
     ("pallas", 64, "sync512"),
     ("pallas", 132, "block"),
     ("pallas", 32, "replay32"),
+    ("pallas", 32768, "oppool32k"),
     ("predcbf", 4096),
     ("predcbf", 30720),
     ("predc", 4096),
