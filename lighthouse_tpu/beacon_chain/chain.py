@@ -140,7 +140,13 @@ class BeaconChain:
         self._justified_balances = [
             v.effective_balance for v in genesis_state.validators
         ]
-        self.metrics = {"blocks_imported": 0, "attestations_processed": 0}
+        self.metrics = {
+            "blocks_imported": 0,
+            "attestations_processed": 0,
+            "pre_advance_hits": 0,
+        }
+        # pre-slot state advance result: (head block root, advanced state)
+        self._advanced = None
 
         # attestation-production caches (attester_cache.rs,
         # early_attester_cache.rs, beacon_proposer_cache.rs)
@@ -171,6 +177,15 @@ class BeaconChain:
 
         self.events = EventBus()
         self.validator_monitor = ValidatorMonitor()
+
+        # finality-driven store lifecycle (migrate.rs:29-35): head
+        # recompute notifies the migrator on every finalization advance.
+        # Synchronous by default (deterministic for tests); BeaconNode
+        # swaps in a threaded one so migration runs off the import path.
+        from lighthouse_tpu.store.migrate import BackgroundMigrator
+
+        self.migrator = BackgroundMigrator(self, threaded=False)
+        self._migrated_finalized_epoch = 0
 
     @classmethod
     def from_checkpoint(
@@ -225,6 +240,18 @@ class BeaconChain:
             return self.slot_clock.current_slot()
         return max(self.head_state.slot, self.fork_choice.current_slot)
 
+    def _fc_checkpoint(self, cp) -> tuple:
+        """A (epoch, root) checkpoint safe for fork choice: roots the
+        proto array cannot know — epoch-0 zero roots, and on a
+        checkpoint-synced chain any root from BEFORE the anchor — clamp
+        to the chain's anchor root (the reference initializes its
+        ForkChoiceStore the same way: everything starts at the anchor,
+        client/src/config.rs:31-34 + fork_choice anchor init)."""
+        root = bytes(cp.root)
+        if cp.epoch == 0 or root not in self.fork_choice.proto.indices:
+            root = self.genesis_root
+        return (cp.epoch, root)
+
     def set_slot(self, slot: int):
         self.fork_choice.set_slot(slot)
         self.attester_cache.prune(self.finalized_checkpoint.epoch)
@@ -236,22 +263,30 @@ class BeaconChain:
         self.observed_sync_aggregators.prune(slot)
         self.observed_sync_contributions.prune(slot)
 
-    def committee_for(self, data):
-        """Committee for an AttestationData via the per-epoch shuffling
-        cache (reference shuffling_cache)."""
-        epoch = data.target.epoch
-        key = epoch
-        cache = self._committee_caches.get(key)
+    def _committee_cache_for_epoch(self, epoch: int) -> CommitteeCache:
+        """Per-epoch shuffling cache, bounded at 8 epochs (reference
+        shuffling_cache) — the ONE fill path for every consumer."""
+        cache = self._committee_caches.get(epoch)
         if cache is None:
             base = self.state_for_epoch(epoch)
             cache = CommitteeCache(base, epoch, self.spec)
-            self._committee_caches[key] = cache
+            self._committee_caches[epoch] = cache
             if len(self._committee_caches) > 8:
                 oldest = min(self._committee_caches)
                 del self._committee_caches[oldest]
+        return cache
+
+    def committee_for(self, data):
+        """Committee for an AttestationData via the shuffling cache."""
+        cache = self._committee_cache_for_epoch(data.target.epoch)
         if data.index >= cache.committees_per_slot:
             raise attn.AttestationError("committee index out of range")
         return cache.get_beacon_committee(data.slot, data.index)
+
+    def committees_per_slot_at(self, epoch: int) -> int:
+        """Committee count per slot for `epoch` via the shuffling cache
+        (needed by the committee→subnet mapping, subnet_id.rs)."""
+        return self._committee_cache_for_epoch(epoch).committees_per_slot
 
     def state_for_epoch(self, epoch: int):
         """A state usable to compute epoch `epoch` committees."""
@@ -295,6 +330,19 @@ class BeaconChain:
             if parent_state is None:
                 raise BlockError("parent state unavailable")
 
+        # pre-slot state advance (state_advance_timer.rs:89,321): if the
+        # timer already advanced the head state across this slot's (or
+        # epoch's) boundary, start from that instead of re-running the
+        # epoch transition on the import critical path
+        adv = self._advanced
+        if (
+            adv is not None
+            and adv[0] == parent_root
+            and adv[1].slot <= block.slot
+        ):
+            parent_state = adv[1]
+            self.metrics["pre_advance_hits"] += 1
+
         state = self._copy_state(parent_state)
         t0 = time.perf_counter()
         state = process_slots(state, block.slot, spec)
@@ -329,18 +377,8 @@ class BeaconChain:
         self.store.put_block(block_root, signed_block)
         self.store.put_hot_state(state)
         self.store.set_canonical_block_root(block.slot, block_root)
-        justified = (
-            state.current_justified_checkpoint.epoch,
-            bytes(state.current_justified_checkpoint.root),
-        )
-        finalized = (
-            state.finalized_checkpoint.epoch,
-            bytes(state.finalized_checkpoint.root),
-        )
-        if justified[0] == 0:
-            justified = (0, self.genesis_root)
-        if finalized[0] == 0:
-            finalized = (0, self.genesis_root)
+        justified = self._fc_checkpoint(state.current_justified_checkpoint)
+        finalized = self._fc_checkpoint(state.finalized_checkpoint)
         exec_status, exec_hash = self._execution_verdict(block, engine)
         self.fork_choice.on_block(
             block.slot,
@@ -415,18 +453,30 @@ class BeaconChain:
 
     def process_chain_segment(self, signed_blocks):
         """Batched segment import (range sync path): one bulk signature
-        batch across ALL blocks (block_verification.rs:509), then
-        sequential state transitions with signatures skipped."""
-        from lighthouse_tpu.state_processing import signature_sets as ss
+        batch across ALL sets of ALL blocks (block_verification.rs:509),
+        then sequential state transitions with signatures skipped.
+
+        Every signature in every block — proposal, randao reveal,
+        slashing/exit operations, attestations, sync aggregate — goes
+        into the segment batch, evaluated against each block's advancing
+        pre-state. A serving peer that tampers with ANY inner signature
+        fails the whole segment, exactly like the reference's
+        signature_verify_chain_segment → BlockSignatureVerifier chain."""
+        from lighthouse_tpu.state_processing.per_block import (
+            BlockProcessingError,
+            SignatureCollector,
+        )
         from lighthouse_tpu import bls
 
         if not signed_blocks:
             return []
-        # collect every signature set across the segment against each
-        # block's (advanced) pre-state
+        # one collector spanning the segment: per_block_processing feeds
+        # it each block's sets (built eagerly against the in-hand
+        # advanced state) and leaves finish() to us
+        collector = SignatureCollector(
+            BlockSignatureStrategy.VERIFY_BULK, backend=self.backend
+        )
         roots = []
-        sets = []
-        states = {}
         state = None
         for sb in signed_blocks:
             block = sb.message
@@ -438,20 +488,20 @@ class BeaconChain:
                 state = parent_state.copy()
             state = process_slots(state, block.slot, self.spec)
             self.pubkey_cache.import_new(state)
-            sets.append(
-                ss.block_proposal_set(
-                    state, sb, self.pubkey_cache.get, self.spec
+            try:
+                per_block_processing(
+                    state,
+                    sb,
+                    self.spec,
+                    BlockSignatureStrategy.VERIFY_BULK,
+                    self.pubkey_cache,
+                    collector=collector,
                 )
-            )
-            states[bytes(type(block).hash_tree_root(block))] = None
-            per_block_processing(
-                state,
-                sb,
-                self.spec,
-                BlockSignatureStrategy.NO_VERIFICATION,
-                self.pubkey_cache,
-            )
-        if not bls.verify_signature_sets(sets, backend=self.backend):
+            except BlockProcessingError as e:
+                raise BlockError(f"segment block invalid: {e}") from e
+        if not collector.sets or not bls.verify_signature_sets(
+            collector.sets, backend=self.backend
+        ):
             raise BlockError("segment signature batch failed")
         # apply for real through the normal pipeline (signatures already
         # batch-checked; per-block re-verification is skipped)
@@ -496,18 +546,8 @@ class BeaconChain:
             block.slot,
             block_root,
             parent_root,
-            (
-                state.current_justified_checkpoint.epoch,
-                bytes(state.current_justified_checkpoint.root)
-                if state.current_justified_checkpoint.epoch
-                else self.genesis_root,
-            ),
-            (
-                state.finalized_checkpoint.epoch,
-                bytes(state.finalized_checkpoint.root)
-                if state.finalized_checkpoint.epoch
-                else self.genesis_root,
-            ),
+            self._fc_checkpoint(state.current_justified_checkpoint),
+            self._fc_checkpoint(state.finalized_checkpoint),
             execution_status=exec_status,
             execution_block_hash=exec_hash,
         )
@@ -1020,6 +1060,19 @@ class BeaconChain:
 
     # --------------------------------------------------------------- head
 
+    def advance_head_to_slot(self, target_slot: int):
+        """Pre-slot state advance (state_advance_timer.rs:89,321): advance
+        a COPY of the head state across the upcoming slot — including any
+        epoch boundary — BEFORE the slot's block arrives, so the import
+        path's process_slots finds the work already done. The result is
+        keyed by the head root it was computed from; a reorg before the
+        block arrives simply misses the cache."""
+        if target_slot <= self.head_state.slot:
+            return
+        st = self._copy_state(self.head_state)
+        st = process_slots(st, target_slot, self.spec)
+        self._advanced = (self.head_root, st)
+
     def recompute_head(self):
         """Fork-choice head + justified-balance refresh
         (canonical_head.rs:431 recompute_head_at_slot)."""
@@ -1050,6 +1103,15 @@ class BeaconChain:
             # (attester_cache.rs is primed at head recompute)
             self._attestation_parts_from_state(
                 self.spec.slot_to_epoch(self.head_state.slot)
+            )
+        # finalization advance drives the store lifecycle: hot→cold
+        # migration + finality-keyed cache pruning, off the critical
+        # path when the migrator is threaded (migrate.rs:29-35)
+        fin = self.head_state.finalized_checkpoint
+        if fin.epoch > self._migrated_finalized_epoch:
+            self._migrated_finalized_epoch = fin.epoch
+            self.migrator.notify_finalized(
+                self.spec.epoch_start_slot(fin.epoch), fin.epoch
             )
         return self.head_root
 

@@ -122,13 +122,24 @@ def per_block_processing(
     backend: str | None = None,
     seed: int | None = None,
     execution_engine=None,
+    collector: SignatureCollector | None = None,
 ):
     """Apply `signed_block` to `state` (which must already be advanced to
-    the block's slot via process_slots). Mutates state in place."""
+    the block's slot via process_slots). Mutates state in place.
+
+    `collector`: an externally-owned SignatureCollector. When given, every
+    set this block produces (proposal, randao, operations, sync aggregate)
+    accumulates into it and `finish()` is NOT called here — the caller
+    batches across blocks and verifies once. This is how a chain segment
+    verifies EVERY signature of every block in one device batch
+    (block_verification.rs:509 signature_verify_chain_segment semantics),
+    not just the proposer signatures."""
     block = signed_block.message
     fork = spec.fork_name_at_epoch(get_current_epoch(state, spec))
     pubkey_cache.import_new(state)
-    collector = SignatureCollector(strategy, backend=backend, seed=seed)
+    deferred = collector is not None
+    if collector is None:
+        collector = SignatureCollector(strategy, backend=backend, seed=seed)
     pk = pubkey_cache.get
 
     if committee_cache is None or committee_cache.epoch != get_current_epoch(
@@ -167,7 +178,8 @@ def per_block_processing(
             state, block.body.sync_aggregate, pubkey_cache, spec, collector
         )
 
-    collector.finish()
+    if not deferred:
+        collector.finish()
     return state
 
 
