@@ -35,24 +35,46 @@ class BeaconNode:
         kv=None,
         backend: str = "ref",
         slasher=None,
+        anchor_block=None,
     ):
+        """`anchor_block` set = checkpoint-sync boot (`ClientGenesis::
+        WeakSubjSszBytes`, client/src/config.rs:31-34): `genesis_state`
+        is then a trusted FINALIZED state, the node serves duties from it
+        immediately, and SyncManager.run_backfill fills history behind
+        the anchor."""
         self.node_id = node_id
         self.spec = spec
         self.clock = ManualSlotClock(
             genesis_state.genesis_time, spec.SECONDS_PER_SLOT
         )
-        self.chain = BeaconChain(
-            genesis_state.copy(),
-            spec,
-            kv=kv,
-            backend=backend,
-            slot_clock=self.clock,
-        )
+        if anchor_block is not None:
+            self.chain = BeaconChain.from_checkpoint(
+                genesis_state.copy(),
+                anchor_block,
+                spec,
+                kv=kv,
+                backend=backend,
+                slot_clock=self.clock,
+            )
+        else:
+            self.chain = BeaconChain(
+                genesis_state.copy(),
+                spec,
+                kv=kv,
+                backend=backend,
+                slot_clock=self.clock,
+            )
         self.fork_digest = compute_fork_digest(
             spec.fork_version_at_epoch(0),
             bytes(genesis_state.genesis_validators_root),
         )
         self.slasher = slasher
+        # live node: run the finality-driven store migration on its own
+        # thread (migrate.rs:29-35) so a slow freezer write cannot stall
+        # block import; the chain's default is synchronous
+        from lighthouse_tpu.store.migrate import BackgroundMigrator
+
+        self.chain.migrator = BackgroundMigrator(self.chain, threaded=True)
         self.rpc = RpcServer(self.chain, node_id, self.fork_digest)
         self.sync = SyncManager(self.chain, spec)
         self.processor = BeaconProcessor(
@@ -68,18 +90,39 @@ class BeaconNode:
             }
         )
         self.hub = hub
+        self.subnets = None
         if hub is not None:
             hub.join(node_id, self._deliver)
             for name in self._gossip_topics():
                 hub.subscribe(node_id, topic(self.fork_digest, name))
+            self._init_subnet_service()
 
     def _gossip_topics(self):
+        # attestation subnets are NOT here: the AttestationSubnetService
+        # owns the 64-topic plane (long-lived backbone + duty-driven)
         return (
             "beacon_block",
             "beacon_aggregate_and_proof",
-            "beacon_attestation_0",
             "voluntary_exit",
             "attester_slashing",
+        )
+
+    def _init_subnet_service(self):
+        """Duty-driven attestation-subnet subscriptions over the current
+        transport (subnet_service/attestation_subnets.rs)."""
+        from lighthouse_tpu.network.subnet_service import (
+            AttestationSubnetService,
+        )
+
+        self.subnets = AttestationSubnetService(
+            self.spec,
+            self.node_id,
+            subscribe=lambda name: self.hub.subscribe(
+                self.node_id, topic(self.fork_digest, name)
+            ),
+            unsubscribe=lambda name: self.hub.unsubscribe(
+                self.node_id, topic(self.fork_digest, name)
+            ),
         )
 
     def attach_socket_net(self, host: str = "127.0.0.1"):
@@ -101,6 +144,7 @@ class BeaconNode:
         self.hub = net.join(self.node_id, self._deliver)
         for name in self._gossip_topics():
             net.subscribe(self.node_id, topic(self.fork_digest, name))
+        self._init_subnet_service()
         return net
 
     # ---------------------------------------------------------- transport
@@ -112,7 +156,8 @@ class BeaconNode:
 
         net = self.hub if hasattr(self.hub, "tcp_port") else None
         self.http = BeaconApiServer(
-            self.chain, host=host, port=port, net=net
+            self.chain, host=host, port=port, net=net, sync=self.sync,
+            node=self,
         ).start()
         return self.http
 
@@ -155,11 +200,24 @@ class BeaconNode:
         )
 
     def publish_attestation(self, att):
+        """Route an unaggregated attestation onto its committee's subnet
+        topic (subnet_id.rs compute_subnet_for_attestation)."""
         if self.hub is None:
             return
+        from lighthouse_tpu.network.subnet_service import (
+            compute_subnet,
+            subnet_topic_name,
+        )
+
+        sub = compute_subnet(
+            self.spec,
+            int(att.data.slot),
+            int(att.data.index),
+            self.chain.committees_per_slot_at(int(att.data.target.epoch)),
+        )
         self.hub.publish(
             self.node_id,
-            topic(self.fork_digest, "beacon_attestation_0"),
+            topic(self.fork_digest, subnet_topic_name(sub)),
             encode_gossip(att.to_bytes()),
         )
 
@@ -243,8 +301,45 @@ class BeaconNode:
 
     # ------------------------------------------------------------- timers
 
+    def advertise(self, registry):
+        """Publish this node's ENR-analog record — including its ACTIVE
+        attestation subnets — to a bootstrap registry, so peers can run
+        subnet-predicate discovery queries against it
+        (discovery/mod.rs subnet queries + ENR attnets field)."""
+        from lighthouse_tpu.network.discovery import PeerRecord
+
+        attnets = [False] * self.spec.ATTESTATION_SUBNET_COUNT
+        if self.subnets is not None:
+            for s in self.subnets.active_subnets:
+                attnets[s] = True
+        self._enr_seq = getattr(self, "_enr_seq", 0) + 1
+        registry.register(
+            PeerRecord(
+                node_id=self.node_id, seq=self._enr_seq, attnets=attnets
+            )
+        )
+
+    def subscribe_for_attestation_duty(
+        self, slot: int, committee_index: int
+    ) -> int | None:
+        """VC-driven subnet subscription ahead of an attestation duty
+        (the beacon_committee_subscriptions flow). Returns the subnet."""
+        if self.subnets is None:
+            return None
+        epoch = self.spec.slot_to_epoch(slot)
+        return self.subnets.subscribe_for_duty(
+            slot, committee_index, self.chain.committees_per_slot_at(epoch)
+        )
+
     def on_slot(self, slot: int):
         """Per-slot tick (timer/src/lib.rs:12 + state_advance_timer)."""
         self.clock.set_slot(slot)
         self.chain.set_slot(slot)
+        if self.subnets is not None:
+            self.subnets.on_slot(slot)
         self.processor.process_pending()
+        # pre-slot state advance (state_advance_timer.rs:89): with this
+        # slot's work drained, advance the head state across the NEXT
+        # slot boundary so the coming block's import skips the (epoch)
+        # transition on its critical path
+        self.chain.advance_head_to_slot(slot + 1)

@@ -121,6 +121,11 @@ class Spec:
     SYNC_COMMITTEE_SUBNET_COUNT: int = 4
     TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE: int = 16
 
+    # attestation gossip plane (phase0 p2p spec ATTESTATION_SUBNET_COUNT;
+    # reference consensus/types/src/subnet_id.rs — committees shard onto
+    # 64 `beacon_attestation_{id}` topics)
+    ATTESTATION_SUBNET_COUNT: int = 64
+
     # bellatrix (merge) — execution payload sizes + penalty variants
     # (consensus/types/src/eth_spec.rs MaxBytesPerTransaction etc.,
     # chain_spec.rs *_bellatrix fields)
@@ -354,3 +359,29 @@ def spec_from_config_yaml(text: str, base: Spec | None = None) -> Spec:
     if "CONFIG_NAME" in values:
         overrides["name"] = str(values["CONFIG_NAME"])
     return replace(base, **overrides)
+
+
+def spec_to_config_yaml(spec: Spec) -> str:
+    """Serialize a Spec as a consensus config.yaml — the exact inverse of
+    `spec_from_config_yaml` (every field is emitted, so the named
+    PRESET_BASE only seeds defaults the override lines then pin). This is
+    what `lcli new-testnet` writes into a --testnet-dir and what the
+    embedded network-config assets are generated from
+    (eth2_network_config's config.yaml role)."""
+    preset = spec.name if spec.name in ("mainnet", "minimal", "gnosis") \
+        else "mainnet"
+    lines = [
+        f"# {spec.name} — generated by lighthouse_tpu "
+        "(spec_to_config_yaml)",
+        f"PRESET_BASE: '{preset}'",
+        f"CONFIG_NAME: '{spec.name}'",
+    ]
+    for f in fields(Spec):
+        if f.name == "name":
+            continue
+        v = getattr(spec, f.name)
+        if isinstance(v, bytes):
+            lines.append(f"{f.name}: 0x{v.hex()}")
+        else:
+            lines.append(f"{f.name}: {v}")
+    return "\n".join(lines) + "\n"

@@ -51,13 +51,18 @@ class ValidatorClient:
         keypairs_by_index: dict,
         slashing_db: SlashingProtectionDB | None = None,
         doppelganger_epochs: int = 0,
+        subnet_subscriber=None,
     ):
         """keypairs_by_index: validator index -> bls Keypair for the keys
-        this client manages."""
+        this client manages. `subnet_subscriber(slot, committee_index)`:
+        optional hook notified for every attester duty found, so the BN
+        joins the duty's attestation subnet ahead of time (the
+        beacon_committee_subscriptions flow of duties_service.rs)."""
         self.chain = chain
         self.spec = chain.spec
         self.t = chain.t
         self.keys = dict(keypairs_by_index)
+        self.subnet_subscriber = subnet_subscriber
         self.slashing_db = slashing_db or SlashingProtectionDB()
         self._duties: dict[int, EpochDuties] = {}
         self.doppelganger_epochs = doppelganger_epochs
@@ -106,6 +111,8 @@ class ValidatorClient:
                         )
                         self._attach_selection_proof(state, duty)
                         duties.attesters[v] = duty
+                        if self.subnet_subscriber is not None:
+                            self.subnet_subscriber(slot, index)
         self._duties[epoch] = duties
         return duties
 

@@ -213,3 +213,160 @@ def test_beacon_processor_priorities_and_bounds():
     assert seen[0] == ("block", "b1")
     assert seen[1] == ("atts", [0, 1, 2])
     assert bp.metrics["dropped"] == 2
+
+
+def test_checkpoint_boot_serves_duties_and_backfills(spec):
+    """Weak-subjectivity boot end to end (client/src/config.rs:31-34 +
+    backfill_sync/mod.rs): a late node boots from a peer's FINALIZED
+    state + block, serves attestation duties immediately, range-syncs
+    forward to the peer's head, then backfills history to genesis with
+    batched signature verification and an intact hash chain."""
+    h, hub, nodes = build_sim(spec, 1)
+    (a,) = nodes
+    slots = spec.SLOTS_PER_EPOCH * 5
+    for slot in range(1, slots + 1):
+        block = h.advance_slot_with_block(slot)
+        a.on_slot(slot)
+        a.chain.process_block(block)
+    fin_epoch = a.chain.finalized_checkpoint.epoch
+    assert fin_epoch >= 2
+    anchor_root = bytes(a.chain.finalized_checkpoint.root)
+    anchor_block = a.chain.store.get_block(anchor_root)
+    anchor_slot = anchor_block.message.slot
+    anchor_state = a.chain.store.state_at_slot(anchor_slot)
+    assert anchor_state is not None
+
+    late = BeaconNode(
+        "late",
+        anchor_state,
+        spec,
+        hub=hub,
+        backend="ref",
+        anchor_block=anchor_block,
+    )
+    # duties served immediately from the anchor: attestation data at the
+    # anchor slot works without any history
+    late.on_slot(anchor_slot)
+    data = late.chain.produce_attestation_data(anchor_slot, 0)
+    assert bytes(data.beacon_block_root) == late.chain.head_root
+
+    # forward range sync to the peer's head
+    late.sync.add_peer("node0", a.rpc)
+    imported = late.sync.run_range_sync()
+    assert imported == slots - anchor_slot
+    assert late.chain.head_root == a.chain.head_root
+
+    # backfill to genesis: every pre-anchor slot stored, hash chain holds
+    stored = late.sync.run_backfill()
+    assert stored == anchor_slot - 1
+    child = anchor_block
+    for slot in range(anchor_slot - 1, 0, -1):
+        root = late.chain.store.get_canonical_block_root(slot)
+        assert root is not None, f"backfill missing slot {slot}"
+        blk = late.chain.store.get_block(root)
+        assert bytes(child.message.parent_root) == root
+        child = blk
+
+
+def _single_bit_attestations(h, chain, atts, limit=2):
+    """Re-sign committee aggregates down to single-attester gossip shape."""
+    from lighthouse_tpu.state_processing.helpers import get_domain
+    from lighthouse_tpu.types.helpers import compute_signing_root
+
+    singles = []
+    for att in atts:
+        committee = chain.committee_for(att.data)
+        domain = get_domain(
+            h.state,
+            h.spec.DOMAIN_BEACON_ATTESTER,
+            att.data.target.epoch,
+            h.spec,
+        )
+        root = type(att.data).hash_tree_root(att.data)
+        for i, bit in enumerate(att.aggregation_bits):
+            if not bit or len(singles) >= limit:
+                break
+            single = att.copy()
+            single.aggregation_bits = [
+                j == i for j in range(len(att.aggregation_bits))
+            ]
+            single.signature = h.keypairs[committee[i]].sk.sign(
+                compute_signing_root(root, domain)
+            ).to_bytes()
+            singles.append(single)
+    return singles
+
+
+def test_attestation_subnet_plane(spec):
+    """64-subnet attestation plane (attestation_subnets.rs +
+    subnet_id.rs): VC duties drive the receiving node's subnet
+    subscriptions, attestations flow on >=2 distinct subnets, expired
+    duty subscriptions drop, and discovery answers subnet-predicate
+    queries from the advertised attnets."""
+    from lighthouse_tpu.network.discovery import BootstrapRegistry
+    from lighthouse_tpu.network.subnet_service import compute_subnet
+    from lighthouse_tpu.validator_client import ValidatorClient
+
+    h, hub, nodes = build_sim(spec, 2)
+    a, b = nodes
+
+    # VC-duty-driven subscription change: the VC managing validators on
+    # node B announces its epoch-0 duties; B joins those subnets
+    before = set(b.subnets.active_subnets)
+    vc = ValidatorClient(
+        b.chain,
+        {i: h.keypairs[i] for i in range(N)},
+        subnet_subscriber=b.subscribe_for_attestation_duty,
+    )
+    vc.update_duties(0)
+    duty_subnets = set(b.subnets.active_subnets) - set(
+        b.subnets.long_lived
+    )
+    assert duty_subnets, "VC duties did not add any subnet subscription"
+    assert set(b.subnets.active_subnets) != before
+
+    # two slots of single-bit attestations -> two distinct subnets
+    seen_subnets = set()
+    for slot in (1, 2):
+        block = h.advance_slot_with_block(slot)
+        a.chain.process_block(block)
+        b.chain.process_block(block)
+        atts = h.make_attestations(h.state, slot)
+        for att in _single_bit_attestations(h, a.chain, atts, limit=1):
+            seen_subnets.add(
+                compute_subnet(
+                    spec,
+                    int(att.data.slot),
+                    int(att.data.index),
+                    a.chain.committees_per_slot_at(
+                        int(att.data.target.epoch)
+                    ),
+                )
+            )
+            a.publish_attestation(att)
+        # tick past the attestation's slot so it lands inside the gossip
+        # propagation window, then drain the receive queue
+        b.on_slot(slot + 1)
+        b.processor.process_pending()
+    assert len(seen_subnets) >= 2, seen_subnets
+    assert b.chain.metrics["attestations_processed"] >= 2
+
+    # discovery: B's advertised record answers subnet-predicate queries
+    reg = BootstrapRegistry()
+    b.advertise(reg)
+    found = reg.find_subnet_peers(list(duty_subnets), exclude="node0")
+    assert any(r.node_id == "node1" for r in found)
+
+    # expiry: past the duty window the subscriptions drop...
+    far = spec.SLOTS_PER_EPOCH + 4
+    b.subnets.on_slot(far)
+    assert set(b.subnets.active_subnets) == set(b.subnets.long_lived)
+    # ...and re-advertising shows the shrunken attnets
+    b.advertise(reg)
+    assert not any(
+        r.node_id == "node1"
+        for r in reg.find_subnet_peers(
+            [s for s in duty_subnets if s not in b.subnets.long_lived],
+            exclude="node0",
+        )
+    )
