@@ -80,9 +80,11 @@ def _validator_status(v, balance: int, epoch: int) -> str:
 
 class BeaconApiServer:
     def __init__(self, chain, host: str = "127.0.0.1", port: int = 0,
-                 net=None):
+                 net=None, sync=None, node=None):
         self.chain = chain
         self.net = net  # optional SocketNet for node/identity + peers
+        self.sync = sync  # optional SyncManager for node/syncing
+        self.node = node  # optional BeaconNode for subnet subscriptions
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -220,6 +222,10 @@ class BeaconApiServer:
             if parts[3] == "version":
                 return {"data": {"version": VERSION}}
             if parts[3] == "health":
+                # standard semantics: 200 synced, 206 syncing — external
+                # tooling health-checks read the status code only
+                if self._sync_distance() > 1:
+                    raise ApiError(206, "syncing")
                 return {}
             if parts[3] == "identity":
                 net = getattr(self, "net", None)
@@ -243,11 +249,7 @@ class BeaconApiServer:
                 net = getattr(self, "net", None)
                 peers = (
                     [
-                        {
-                            "peer_id": pid,
-                            "state": "connected",
-                            "direction": "outbound",
-                        }
+                        self._peer_json(pid)
                         # snapshot: network threads mutate peers
                         for pid in list(getattr(net, "peers", {}))
                     ]
@@ -258,6 +260,13 @@ class BeaconApiServer:
                     "data": peers,
                     "meta": {"count": len(peers)},
                 }
+            if parts[3] == "peers" and len(parts) == 5:
+                net = getattr(self, "net", None)
+                if net is None or parts[4] not in getattr(
+                    net, "peers", {}
+                ):
+                    raise ApiError(404, "peer not found")
+                return {"data": self._peer_json(parts[4])}
             if parts[3] == "peer_count":
                 net = getattr(self, "net", None)
                 n = len(getattr(net, "peers", {})) if net else 0
@@ -270,13 +279,79 @@ class BeaconApiServer:
                     }
                 }
             if parts[3] == "syncing":
+                distance = self._sync_distance()
                 return {
                     "data": {
                         "head_slot": str(chain.head_state.slot),
-                        "sync_distance": "0",
-                        "is_syncing": False,
-                        "is_optimistic": False,
+                        "sync_distance": str(distance),
+                        # >1: the clock running one slot ahead of the
+                        # head is steady-state, not syncing
+                        "is_syncing": distance > 1,
+                        "is_optimistic": chain.fork_choice.is_optimistic(
+                            chain.head_root
+                        ),
+                        "el_offline": False,
                     }
+                }
+        # ---- debug namespace (http_api/src/lib.rs debug routes) ----
+        if (
+            len(parts) >= 4
+            and parts[0] == "eth"
+            and parts[2] == "debug"
+        ):
+            if parts[3:5] == ["beacon", "heads"]:
+                proto = chain.fork_choice.proto
+                is_parent = {
+                    n.parent for n in proto.nodes if n.parent is not None
+                }
+                heads = [
+                    {
+                        "root": "0x" + n.root.hex(),
+                        "slot": str(n.slot),
+                        "execution_optimistic":
+                            chain.fork_choice.is_optimistic(n.root),
+                    }
+                    for i, n in enumerate(proto.nodes)
+                    if i not in is_parent
+                ]
+                return {"data": heads}
+            if parts[3:5] == ["beacon", "states"] and len(parts) == 6:
+                # full state as SSZ (the v2 octet-stream form — the JSON
+                # rendering of a whole BeaconState is not served)
+                state = self._resolve_state(parts[5])
+                return (state.to_bytes(), "application/octet-stream")
+            if parts[3] == "fork_choice":
+                proto = chain.fork_choice.proto
+                nodes = []
+                for node in proto.nodes:
+                    parent_root = (
+                        proto.nodes[node.parent].root
+                        if node.parent is not None
+                        else b""
+                    )
+                    nodes.append(
+                        {
+                            "slot": str(node.slot),
+                            "block_root": "0x" + node.root.hex(),
+                            "parent_root": "0x" + parent_root.hex(),
+                            "justified_epoch": str(node.justified_epoch),
+                            "finalized_epoch": str(node.finalized_epoch),
+                            "weight": str(node.weight),
+                            "validity": node.execution_status,
+                        }
+                    )
+                jc_epoch, jc_root = chain.fork_choice.justified_checkpoint
+                fc_epoch, fc_root = chain.fork_choice.finalized_checkpoint
+                return {
+                    "justified_checkpoint": {
+                        "epoch": str(jc_epoch),
+                        "root": "0x" + jc_root.hex(),
+                    },
+                    "finalized_checkpoint": {
+                        "epoch": str(fc_epoch),
+                        "root": "0x" + fc_root.hex(),
+                    },
+                    "fork_choice_nodes": nodes,
                 }
         if parts[:3] == ["eth", "v1", "beacon"]:
             if parts[3] == "genesis":
@@ -596,6 +671,18 @@ class BeaconApiServer:
             cls = chain.t.signed_blinded_block_classes[fork]
             chain.import_blinded_block(from_json(cls, doc))
             return {}
+        if path == "/eth/v1/validator/beacon_committee_subscriptions":
+            # duty-driven subnet subscriptions (attestation_subnets.rs
+            # validator_subscriptions): the VC announces upcoming duties
+            # so the BN joins the right beacon_attestation_{id} topics
+            node = getattr(self, "node", None)
+            if node is None:
+                raise ApiError(400, "no network service attached")
+            for s in json.loads(body):
+                node.subscribe_for_attestation_duty(
+                    int(s["slot"]), int(s["committee_index"])
+                )
+            return {}
         if path == "/eth/v1/validator/register_validator":
             regs = [
                 from_json(chain.t.SignedValidatorRegistrationData, d)
@@ -761,6 +848,29 @@ class BeaconApiServer:
                     }
                 )
         return {"data": duties}
+
+    def _sync_distance(self) -> int:
+        """Slots between the wall clock and the head — the standard
+        node/syncing + health signal. 0/1 = synced (the clock leads the
+        head by one slot between block arrival and the tick)."""
+        chain = self.chain
+        return max(0, chain.current_slot() - chain.head_state.slot)
+
+    def _peer_json(self, pid: str) -> dict:
+        net = getattr(self, "net", None)
+        conn = getattr(net, "peers", {}).get(pid)
+        port = getattr(conn, "listen_port", None)
+        host = getattr(net, "host", "127.0.0.1")
+        return {
+            "peer_id": pid,
+            "enr": "",
+            "last_seen_p2p_address": (
+                f"/ip4/{host}/tcp/{port}" if port else ""
+            ),
+            "state": "connected" if getattr(conn, "alive", True)
+            else "disconnected",
+            "direction": "outbound",
+        }
 
     def _resolve_state(self, state_id: str):
         chain = self.chain

@@ -204,3 +204,81 @@ def test_query_param_validation(wire):
         with pytest.raises(ApiClientError) as ei:
             client._get(path)
         assert "400" in str(ei.value), path
+
+
+def test_node_syncing_and_debug_namespace(wire):
+    """node/syncing wired to the clock-vs-head distance plus the debug
+    namespace (http_api/src/lib.rs debug routes): heads, fork_choice
+    dump, and the full state as SSZ."""
+    import json
+    import urllib.request
+
+    spec, h, chain, client = wire
+
+    # synced: no slot clock attached -> distance 0
+    sync = client._get("/eth/v1/node/syncing")["data"]
+    assert sync["is_syncing"] is False
+    assert sync["head_slot"] == str(chain.head_state.slot)
+    assert "is_optimistic" in sync
+
+    # debug heads include the canonical head
+    heads = client._get("/eth/v1/debug/beacon/heads")["data"]
+    assert any(x["root"] == "0x" + chain.head_root.hex() for x in heads)
+
+    # debug fork-choice dump carries every imported block
+    fc = client._get("/eth/v1/debug/fork_choice")
+    assert len(fc["fork_choice_nodes"]) >= chain.head_state.slot
+    roots = {n["block_root"] for n in fc["fork_choice_nodes"]}
+    assert "0x" + chain.head_root.hex() in roots
+
+    # debug state as SSZ: decodes back to the head state
+    with urllib.request.urlopen(
+        client.base + "/eth/v2/debug/beacon/states/head", timeout=10
+    ) as r:
+        assert r.headers["Content-Type"] == "application/octet-stream"
+        raw = r.read()
+    decoded = type(chain.head_state).decode(raw)
+    assert decoded.slot == chain.head_state.slot
+    from lighthouse_tpu.ssz.cached_hash import cached_state_root
+
+    assert cached_state_root(decoded) == cached_state_root(
+        chain.head_state.copy()
+    )
+
+
+def test_syncing_distance_and_health_206():
+    """A chain whose wall clock runs ahead of its head reports the
+    distance and fails the standard health check with 206."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from lighthouse_tpu.common.slot_clock import ManualSlotClock
+
+    spec = minimal_spec(ALTAIR_FORK_EPOCH=2**64 - 1)
+    h = Harness(spec, 16)
+    clock = ManualSlotClock(h.state.genesis_time, spec.SECONDS_PER_SLOT)
+    chain = BeaconChain(
+        h.state.copy(), spec, backend="ref", slot_clock=clock
+    )
+    srv = BeaconApiServer(chain).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        clock.set_slot(5)  # head is at 0 -> distance 5
+        with urllib.request.urlopen(
+            base + "/eth/v1/node/syncing", timeout=5
+        ) as r:
+            sync = json.load(r)["data"]
+        assert sync["is_syncing"] is True
+        assert sync["sync_distance"] == "5"
+        with urllib.request.urlopen(
+            base + "/eth/v1/node/health", timeout=5
+        ) as r:  # 2xx: urllib returns normally; the CODE is the signal
+            assert r.status == 206
+        clock.set_slot(0)
+        with urllib.request.urlopen(
+            base + "/eth/v1/node/health", timeout=5
+        ) as r:
+            assert r.status == 200
+    finally:
+        srv.stop()
