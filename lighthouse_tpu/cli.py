@@ -18,16 +18,37 @@ import time
 
 
 def _spec_for(name: str, altair_epoch=None):
+    """Spec for --network: built-in network-config assets first (the
+    eth2_network_config path — mainnet/minimal/gnosis config.yaml dirs
+    under lighthouse_tpu/network_configs/), programmatic presets as the
+    fallback."""
+    from dataclasses import replace
+
+    from lighthouse_tpu import network_config as nc
     from lighthouse_tpu.types.spec import mainnet_spec, minimal_spec
 
-    overrides = {}
+    try:
+        spec = nc.builtin(name).spec
+    except nc.NetworkConfigError:
+        spec = minimal_spec() if name == "minimal" else mainnet_spec()
     if altair_epoch is not None:
-        overrides["ALTAIR_FORK_EPOCH"] = altair_epoch
-    return (
-        minimal_spec(**overrides)
-        if name == "minimal"
-        else mainnet_spec(**overrides)
-    )
+        spec = replace(spec, ALTAIR_FORK_EPOCH=altair_epoch)
+    return spec
+
+
+def _serve_api(chain, args, banner: str) -> int:
+    """Start the HTTP API, print the banner, serve for --serve-seconds,
+    stop — shared by every bn boot path."""
+    from lighthouse_tpu.http_api import BeaconApiServer
+
+    srv = BeaconApiServer(chain, port=args.http_port).start()
+    print(f"{banner}; HTTP API on 127.0.0.1:{srv.port}")
+    try:
+        if args.serve_seconds:
+            time.sleep(args.serve_seconds)
+    finally:
+        srv.stop()
+    return 0
 
 
 def cmd_bn(args):
@@ -38,14 +59,84 @@ def cmd_bn(args):
     from lighthouse_tpu.http_api import BeaconApiServer
     from lighthouse_tpu.store import SqliteStore
 
+    kv = SqliteStore(args.datadir) if args.datadir else None
+    if args.testnet_dir:
+        # file-driven boot (--testnet-dir: config.yaml + genesis.ssz,
+        # the eth2_network_config custom-directory path)
+        from lighthouse_tpu import network_config as nc
+
+        cfg = nc.load_dir(args.testnet_dir)
+        genesis = cfg.genesis_state()
+        if genesis is None:
+            print(
+                f"{args.testnet_dir}: no genesis.ssz "
+                "(generate one with lcli new-testnet)",
+                file=sys.stderr,
+            )
+            return 1
+        chain = BeaconChain(
+            genesis, cfg.spec, kv=kv, backend=args.bls_backend
+        )
+        return _serve_api(
+            chain,
+            args,
+            f"booted network {cfg.name!r} from {args.testnet_dir} "
+            f"(genesis_validators_root 0x"
+            f"{bytes(genesis.genesis_validators_root).hex()[:12]}, "
+            f"{len(cfg.boot_nodes or [])} boot nodes)",
+        )
     spec = _spec_for(args.network)
+    if args.checkpoint_state or args.checkpoint_block:
+        # weak-subjectivity boot (client/src/config.rs:31-34): trusted
+        # finalized state + matching block from SSZ files; no dev chain
+        if not (args.checkpoint_state and args.checkpoint_block):
+            print(
+                "--checkpoint-state and --checkpoint-block are required "
+                "together",
+                file=sys.stderr,
+            )
+            return 1
+        from lighthouse_tpu.types.containers import types_for
+
+        t = types_for(spec)
+        with open(args.checkpoint_state, "rb") as f:
+            raw_state = f.read()
+        with open(args.checkpoint_block, "rb") as f:
+            raw_block = f.read()
+        # decode with the newest fork class that round-trips
+        state = block = None
+        for fork in reversed(list(t.state_classes)):
+            try:
+                cand = t.state_classes[fork].decode(raw_state)
+                if spec.fork_name_at_epoch(
+                    spec.slot_to_epoch(cand.slot)
+                ) != fork:
+                    continue
+                block = t.signed_block_classes[fork].decode(raw_block)
+            except Exception:
+                continue
+            state = cand
+            break
+        if state is None:
+            print(
+                "could not decode checkpoint state/block", file=sys.stderr
+            )
+            return 1
+        chain = BeaconChain.from_checkpoint(
+            state, block, spec, kv=kv, backend=args.bls_backend
+        )
+        return _serve_api(
+            chain,
+            args,
+            f"checkpoint boot at slot {state.slot} "
+            f"(anchor 0x{chain.head_root.hex()[:12]})",
+        )
     h = Harness(
         spec,
         args.validators,
         backend=args.bls_backend,
         genesis_time=int(time.time()) if args.slots == 0 else 0,
     )
-    kv = SqliteStore(args.datadir) if args.datadir else None
     chain = BeaconChain(
         h.state.copy(), spec, kv=kv, backend=args.bls_backend
     )
@@ -238,6 +329,22 @@ def cmd_lcli(args):
         state = interop_genesis_state(
             [k.pk.to_bytes() for k in kps], args.genesis_time, spec
         )
+        if args.testnet_dir:
+            # full network directory (config.yaml + genesis.ssz) that
+            # `bn --testnet-dir` boots from — new_testnet in lcli
+            from lighthouse_tpu import network_config as nc
+
+            nc.write_dir(args.testnet_dir, spec, genesis_state=state)
+            print(
+                json.dumps(
+                    {
+                        "testnet_dir": args.testnet_dir,
+                        "genesis_validators_root": "0x"
+                        + bytes(state.genesis_validators_root).hex(),
+                    }
+                )
+            )
+            return 0
         data = state.to_bytes()
         with open(args.out, "wb") as f:
             f.write(data)
@@ -347,6 +454,21 @@ def build_parser():
     bn.add_argument("--datadir", default=None)
     bn.add_argument("--bls-backend", default="ref")
     bn.add_argument("--serve-seconds", type=float, default=0)
+    bn.add_argument(
+        "--checkpoint-state",
+        default=None,
+        help="SSZ file with a trusted finalized state (checkpoint sync)",
+    )
+    bn.add_argument(
+        "--checkpoint-block",
+        default=None,
+        help="SSZ file with the block matching --checkpoint-state",
+    )
+    bn.add_argument(
+        "--testnet-dir",
+        default=None,
+        help="network directory (config.yaml + genesis.ssz) to boot from",
+    )
     bn.set_defaults(fn=cmd_bn)
 
     vc = sub.add_parser("vc", help="validator client")
@@ -384,6 +506,11 @@ def build_parser():
     lcli.add_argument("--slots", type=int, default=8)
     lcli.add_argument("--genesis-time", type=int, default=0)
     lcli.add_argument("--out", default="genesis.ssz")
+    lcli.add_argument(
+        "--testnet-dir",
+        default=None,
+        help="write a full network dir (config.yaml + genesis.ssz)",
+    )
     lcli.set_defaults(fn=cmd_lcli)
 
     db = sub.add_parser("db", help="database tools")

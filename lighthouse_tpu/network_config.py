@@ -1,0 +1,125 @@
+"""Network configuration directories: embedded assets + --testnet-dir.
+
+Role of the reference's `eth2_network_config` crate
+(common/eth2_network_config, built_in_network_configs/): a network is a
+DIRECTORY of three artifacts —
+
+  config.yaml   runtime ChainSpec overrides (config_and_preset.rs tier)
+  genesis.ssz   the genesis BeaconState (optional: deposit-contract or
+                checkpoint boots build theirs elsewhere)
+  boot_nodes.yaml   bootstrap peer addresses, one "host:port" per line
+                (the boot-ENR role; this stack's discovery records are
+                address-based, not ENR-encoded)
+
+Built-in networks ship as the same directory layout under
+`lighthouse_tpu/network_configs/<name>/`, generated from the programmatic
+presets in types/spec.py — so `--network mainnet` and
+`--testnet-dir my_dir` go through one loader. Mainnet/gnosis genesis
+states are NOT embedded (they are hundreds of MB and this build has no
+egress); nodes on those configs boot via checkpoint sync or a provided
+genesis.ssz, exactly like the reference's `--checkpoint-sync-url` path.
+"""
+
+import os
+from dataclasses import dataclass
+
+ASSET_ROOT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "network_configs"
+)
+
+
+class NetworkConfigError(Exception):
+    pass
+
+
+@dataclass
+class NetworkConfig:
+    name: str
+    spec: object
+    genesis_state_bytes: bytes | None = None
+    boot_nodes: list = None
+
+    def genesis_state(self):
+        """Decode genesis.ssz against the spec's genesis fork."""
+        if self.genesis_state_bytes is None:
+            return None
+        from lighthouse_tpu.types.containers import types_for
+
+        t = types_for(self.spec)
+        fork = self.spec.fork_name_at_epoch(0)
+        return t.state_classes[fork].decode(self.genesis_state_bytes)
+
+
+def load_dir(path: str, name: str | None = None) -> NetworkConfig:
+    """Load a network directory (--testnet-dir or a built-in asset dir)."""
+    from lighthouse_tpu.types.spec import spec_from_config_yaml
+
+    config_path = os.path.join(path, "config.yaml")
+    if not os.path.exists(config_path):
+        raise NetworkConfigError(f"{path}: no config.yaml")
+    with open(config_path) as f:
+        spec = spec_from_config_yaml(f.read())
+
+    genesis = None
+    genesis_path = os.path.join(path, "genesis.ssz")
+    if os.path.exists(genesis_path):
+        with open(genesis_path, "rb") as f:
+            genesis = f.read()
+
+    boot_nodes = []
+    for candidate in ("boot_nodes.yaml", "boot_enr.yaml"):
+        p = os.path.join(path, candidate)
+        if os.path.exists(p):
+            with open(p) as f:
+                for raw in f:
+                    line = raw.split("#", 1)[0].strip().strip("-").strip()
+                    line = line.strip("'\"")
+                    if line:
+                        boot_nodes.append(line)
+            break
+
+    return NetworkConfig(
+        name=name or spec.name,
+        spec=spec,
+        genesis_state_bytes=genesis,
+        boot_nodes=boot_nodes,
+    )
+
+
+def builtin_names() -> list:
+    if not os.path.isdir(ASSET_ROOT):
+        return []
+    return sorted(
+        d
+        for d in os.listdir(ASSET_ROOT)
+        if os.path.isdir(os.path.join(ASSET_ROOT, d))
+    )
+
+
+def builtin(name: str) -> NetworkConfig:
+    """A built-in network by name (`--network`), from the embedded asset
+    dir (built_in_network_configs analog)."""
+    path = os.path.join(ASSET_ROOT, name)
+    if not os.path.isdir(path):
+        raise NetworkConfigError(
+            f"unknown network {name!r}; built-ins: {builtin_names()}"
+        )
+    return load_dir(path, name=name)
+
+
+def write_dir(
+    path: str, spec, genesis_state=None, boot_nodes=()
+) -> None:
+    """Write a network directory (lcli new-testnet's output shape)."""
+    from lighthouse_tpu.types.spec import spec_to_config_yaml
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.yaml"), "w") as f:
+        f.write(spec_to_config_yaml(spec))
+    if genesis_state is not None:
+        with open(os.path.join(path, "genesis.ssz"), "wb") as f:
+            f.write(genesis_state.to_bytes())
+    if boot_nodes:
+        with open(os.path.join(path, "boot_nodes.yaml"), "w") as f:
+            for bn in boot_nodes:
+                f.write(f"- {bn}\n")
