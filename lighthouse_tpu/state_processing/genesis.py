@@ -94,3 +94,143 @@ def interop_genesis_state(
         state.current_sync_committee = get_next_sync_committee(state, spec)
         state.next_sync_committee = get_next_sync_committee(state, spec)
     return state
+
+
+def empty_genesis_state(
+    eth1_block_hash: bytes, eth1_timestamp: int, deposit_count: int,
+    deposit_root: bytes, spec: Spec,
+):
+    """The pre-deposit scaffold shared by the deposit-contract path."""
+    t = types_for(spec)
+    fork, fork_name = genesis_fork(spec, t)
+    state_cls = t.state_classes[fork_name]
+    body_cls = t.block_body_classes[fork_name]
+    header = t.BeaconBlockHeader(
+        slot=0,
+        proposer_index=0,
+        parent_root=ZERO_BYTES32,
+        state_root=ZERO_BYTES32,
+        body_root=body_cls.hash_tree_root(body_cls()),
+    )
+    return state_cls(
+        genesis_time=eth1_timestamp + spec.GENESIS_DELAY,
+        slot=0,
+        fork=fork,
+        latest_block_header=header,
+        eth1_data=t.Eth1Data(
+            deposit_root=deposit_root,
+            deposit_count=deposit_count,
+            block_hash=eth1_block_hash,
+        ),
+        randao_mixes=[eth1_block_hash] * spec.EPOCHS_PER_HISTORICAL_VECTOR,
+    ), fork_name
+
+
+def initialize_beacon_state_from_eth1(
+    eth1_block_hash: bytes, eth1_timestamp: int, deposits, spec: Spec,
+):
+    """Genesis from the deposit contract (`ClientGenesis::DepositContract`,
+    beacon_node/client/src/config.rs:14-34 + beacon_node/genesis): apply
+    every deposit — Merkle proof against the INCREMENTALLY growing
+    deposit root, individually-verified deposit signatures (invalid ones
+    skipped, not fatal) — then activate validators that reached
+    MAX_EFFECTIVE_BALANCE.
+
+    `deposits` are Deposit containers whose proofs were built by
+    eth1.DepositTree (deposit_cache.rs's role)."""
+    from lighthouse_tpu.eth1.deposit_tree import DepositTree
+    from lighthouse_tpu.state_processing.per_block import process_deposit
+    from lighthouse_tpu.state_processing.pubkey_cache import PubkeyCache
+
+    t = types_for(spec)
+    tree = DepositTree()
+    leaves = [type(d.data).hash_tree_root(d.data) for d in deposits]
+    state, fork_name = empty_genesis_state(
+        eth1_block_hash, eth1_timestamp, len(deposits),
+        ZERO_BYTES32, spec,
+    )
+    cache = PubkeyCache()
+    for deposit, leaf in zip(deposits, leaves):
+        # the root grows with each leaf, exactly like the contract the
+        # proofs were built against (phase0 spec initialize_* loop)
+        tree.push(leaf)
+        state.eth1_data.deposit_root = tree.root()
+        process_deposit(state, deposit, spec, fork_name, cache)
+
+    # activate genesis validators that reached full effective balance
+    for v in state.validators:
+        if v.effective_balance >= spec.MAX_EFFECTIVE_BALANCE:
+            v.activation_eligibility_epoch = GENESIS_EPOCH
+            v.activation_epoch = GENESIS_EPOCH
+
+    from lighthouse_tpu import ssz
+
+    validators_type = ssz.List(t.Validator, spec.VALIDATOR_REGISTRY_LIMIT)
+    state.genesis_validators_root = validators_type.hash_tree_root(
+        state.validators
+    )
+    if fork_name == "altair":
+        n = len(state.validators)
+        state.previous_epoch_participation = [0] * n
+        state.current_epoch_participation = [0] * n
+        state.inactivity_scores = [0] * n
+        from lighthouse_tpu.state_processing.sync_committees import (
+            get_next_sync_committee,
+        )
+
+        state.current_sync_committee = get_next_sync_committee(state, spec)
+        state.next_sync_committee = get_next_sync_committee(state, spec)
+    return state
+
+
+def is_valid_genesis_state(state, spec: Spec) -> bool:
+    """Genesis trigger condition (phase0 spec is_valid_genesis_state):
+    enough time past MIN_GENESIS_TIME and enough ACTIVE validators."""
+    if state.genesis_time < spec.MIN_GENESIS_TIME:
+        return False
+    active = sum(
+        1
+        for v in state.validators
+        if v.activation_epoch <= GENESIS_EPOCH < v.exit_epoch
+    )
+    return active >= spec.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+
+
+def genesis_deposits(deposit_datas, spec: Spec):
+    """DepositData list -> Deposit list with INCREMENTAL Merkle proofs:
+    deposit i is proven against the tree holding leaves 0..i, matching
+    the growing root initialize_beacon_state_from_eth1 verifies
+    (deposit_cache.rs builds proofs the same way)."""
+    from lighthouse_tpu.eth1.deposit_tree import DepositTree
+
+    t = types_for(spec)
+    tree = DepositTree()
+    out = []
+    for i, data in enumerate(deposit_datas):
+        tree.push(type(data).hash_tree_root(data))
+        out.append(t.Deposit(proof=tree.proof(i), data=data))
+    return out
+
+
+def genesis_from_eth1_cache(cache, spec: Spec):
+    """Scan cached eth1 blocks oldest-first for the first whose deposit
+    log produces a valid genesis — the eth1-genesis service loop
+    (beacon_node/genesis eth1 path driven by the deposit cache).
+    Blocks that cannot possibly qualify (too few deposits for
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT, too early for MIN_GENESIS_TIME
+    + GENESIS_DELAY) are skipped without building a state."""
+    for block in cache.blocks:
+        if block.deposit_count < spec.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT:
+            continue
+        if block.timestamp + spec.GENESIS_DELAY < spec.MIN_GENESIS_TIME:
+            continue
+        datas = cache.deposit_data[: block.deposit_count]
+        state = initialize_beacon_state_from_eth1(
+            block.hash,
+            block.timestamp,
+            genesis_deposits(datas, spec),
+            spec,
+        )
+        if is_valid_genesis_state(state, spec):
+            return state
+    return None
