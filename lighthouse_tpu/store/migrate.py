@@ -29,12 +29,11 @@ class BackgroundMigrator:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._stop = False
+        # the worker spawns LAZILY on the first threaded notification:
+        # most chains (tests, short sims) never reach a finalization
+        # advance, and an eager thread per BeaconNode accumulates dozens
+        # of idle daemon threads across a test session
         self._thread = None
-        if threaded:
-            self._thread = threading.Thread(
-                target=self._worker, name="store-migrator", daemon=True
-            )
-            self._thread.start()
 
     # ------------------------------------------------------------- driving
 
@@ -51,6 +50,13 @@ class BackgroundMigrator:
             self.runs += 1
             return
         with self._wake:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker,
+                    name="store-migrator",
+                    daemon=True,
+                )
+                self._thread.start()
             prev = self._pending
             if prev is None or finalized_slot > prev[0]:
                 self._pending = (finalized_slot, finalized_epoch)
@@ -104,9 +110,22 @@ class BackgroundMigrator:
                 self._busy = False
                 self._wake.notify_all()
 
+    # compact the KV every Nth migration (migrate.rs:21-26 triggers
+    # LevelDB compaction periodically after finality migrations — every
+    # migration would rewrite the log too often)
+    COMPACTION_PERIOD = 4
+
     def _migrate_store(self, finalized_slot: int):
-        """The store I/O half: hot states below finality → freezer."""
+        """The store I/O half: hot states below finality → freezer,
+        plus periodic log compaction on backends that support it (the
+        native append-log store)."""
         self.chain.store.migrate_to_cold(finalized_slot)
+        kv = self.chain.store.kv
+        if (
+            (self.runs + 1) % self.COMPACTION_PERIOD == 0
+            and hasattr(kv, "compact")
+        ):
+            kv.compact()
 
     def _prune_caches(self, finalized_slot: int, finalized_epoch: int):
         """The in-memory half, ALWAYS on the notifying thread: finalized

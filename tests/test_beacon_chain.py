@@ -412,3 +412,17 @@ def test_pre_slot_state_advance(spec):
     assert chain.metrics["pre_advance_hits"] == 1
     assert chain.head_root == root  # advanced state produced the same
     # post-state (the state-root check inside process_block passed)
+
+
+def test_migrator_compacts_periodically(spec):
+    """Every COMPACTION_PERIOD-th migration compacts KV backends that
+    support it (migrate.rs:21-26 periodic post-finality compaction)."""
+    h = Harness(spec, 8)
+    chain = BeaconChain(h.state.copy(), spec, backend="ref")
+    compactions = []
+    chain.store.kv.compact = lambda: compactions.append(1)
+    period = chain.migrator.COMPACTION_PERIOD
+    for i in range(period * 2):
+        chain.migrator.notify_finalized(8 * (i + 1), i + 1)
+    assert chain.migrator.runs == period * 2
+    assert len(compactions) == 2
