@@ -136,6 +136,7 @@ def _active_metric():
         "sync512": "fast_aggregate_verify_throughput",
         "block": "block_signature_verify_throughput",
         "replay32": "epoch_replay_slots_per_sec",
+        "grouped64": "grouped_verify_throughput",
     }.get(cfg, "verify_signature_sets_throughput")
 
 
@@ -266,37 +267,56 @@ def _measure(jax, platform):
         from lighthouse_tpu import bench_replay
 
         return bench_replay.measure(jax, platform)
+    if config == "grouped64":
+        return _measure_grouped(jax, platform)
     return _measure_sigsets(jax, platform)
 
 
-def _resolve_impl_fn(jax, platform):
+def _resolve_impl_fn(jax, platform, grouped: bool = False):
     """Validate BENCH_IMPL, apply its env side effects, and return
     (impl, jitted verify fn) — shared by every config so an impl added
     in one place cannot be mislabeled in another. Exits 4 on unknown
-    impls (a typo must not measure the xla path under its label)."""
+    impls (a typo must not measure the xla path under its label) and on
+    impls the requested program family does not have (the grouped check
+    has no transposed-XLA or in-kernel-tail program)."""
+    import functools
+
     from lighthouse_tpu.bench_impl import apply_impl_env
     from lighthouse_tpu.ops import batch_verify
 
     impl = os.environ.get("BENCH_IMPL", "xla")
     apply_impl_env(impl)
+    if grouped and impl in ("txla", "ptail"):
+        print(
+            f"bench: grouped64 has no {impl} program; use "
+            "xla|mxu|pallas|predc|predcbf",
+            file=sys.stderr,
+        )
+        sys.exit(4)
     if impl in ("pallas", "ptail", "predc", "predcbf"):
-        import functools
-
         fn = jax.jit(
             functools.partial(
-                batch_verify.verify_signature_sets_pallas,
+                batch_verify.verify_signature_sets_grouped_pallas
+                if grouped
+                else batch_verify.verify_signature_sets_pallas,
                 # on the CPU fallback the TPU kernel cannot lower — run
                 # the kernel body in interpret mode so the JSON line
                 # still lands
                 interpret=(platform == "cpu"),
-                tail=(impl == "ptail"),
+                **({} if grouped else {"tail": impl == "ptail"}),
             )
         )
     elif impl == "txla":
         # fully-transposed batch-on-lanes pipeline, no Pallas
         fn = jax.jit(batch_verify.verify_signature_sets_t)
     else:
-        fn = jax.jit(batch_verify.verify_signature_sets)
+        # xla | mxu (mxu = the xla program with the MXU_CONV env knob
+        # apply_impl_env just set, honored by both program families)
+        fn = jax.jit(
+            batch_verify.verify_signature_sets_grouped
+            if grouped
+            else batch_verify.verify_signature_sets
+        )
     return impl, fn
 
 
@@ -393,6 +413,62 @@ def _measure_block(jax, platform):
         "p50_s": round(p50, 4),
         "compile_s": round(compile_s, 1),
         "valid_for_headline": bool(on_tpu and n_att >= 128),
+    }
+
+
+def _measure_grouped(jax, platform):
+    """The committee-shaped full-slot load: S sets over G distinct
+    messages, verified with the message-grouped pairing merge (G+1
+    Miller loops instead of S+1 — ops.batch_verify.grouped_miller_inputs
+    docstring). This is the honest shape of the 30k-sig mainnet slot:
+    ~64 committees per slot, so the north-star 150k sigs/s applies to
+    THIS config; the plain sigsets config keeps measuring the
+    distinct-message general case.
+
+    BENCH_NSETS = total sets (default 30720), BENCH_GROUPS = distinct
+    messages (default 64)."""
+    from lighthouse_tpu import testing as td
+
+    on_tpu = platform in ("tpu", "axon")
+    if platform == "cpu":
+        n_sets, n_groups, reps = 32, 4, 3  # prove the path only
+    else:
+        n_sets = int(os.environ.get("BENCH_NSETS") or 30720)
+        n_groups = int(os.environ.get("BENCH_GROUPS") or 64)
+        reps = 5
+    if n_sets < n_groups:
+        print(
+            f"bench: grouped64 needs BENCH_NSETS >= BENCH_GROUPS "
+            f"({n_sets} < {n_groups})",
+            file=sys.stderr,
+        )
+        sys.exit(4)
+    sets_per_group = n_sets // n_groups
+    n_sets = n_groups * sets_per_group
+
+    grouped, _ = td.make_grouped_signature_set_batch(
+        n_groups, sets_per_group, max_keys=1, seed=0,
+        fast_sequential=True,
+    )
+    args = jax.device_put(grouped)
+
+    impl, fn = _resolve_impl_fn(jax, platform, grouped=True)
+    p50, compile_s = _compile_and_time(jax, fn, args, reps, "grouped64")
+    sigs_per_sec = n_sets / p50
+    return {
+        "metric": "grouped_verify_throughput",
+        "value": round(sigs_per_sec, 2),
+        "unit": "sigs/sec",
+        "vs_baseline": round(sigs_per_sec / TARGET_SIGS_PER_SEC, 4),
+        "platform": platform,
+        "impl": impl,
+        "n_sets": n_sets,
+        "n_groups": n_groups,
+        "p50_s": round(p50, 4),
+        "compile_s": round(compile_s, 1),
+        "valid_for_headline": bool(
+            on_tpu and n_sets >= 30720 and n_groups <= 64
+        ),
     }
 
 

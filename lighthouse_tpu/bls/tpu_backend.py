@@ -124,6 +124,42 @@ def _indexed_verify(
     )
 
 
+def _grouped_impl(use_pallas: bool):
+    if use_pallas:
+        return batch_verify.verify_signature_sets_grouped_pallas
+    return batch_verify.verify_signature_sets_grouped
+
+
+def _grouped_indexed_verify(
+    use_pallas, msgs, sigs, table_x, table_y, indices, key_mask,
+    rand_bits, set_mask, group_mask,
+):
+    import jax.numpy as jnp
+
+    pk_x = jnp.take(table_x, indices, axis=0)  # (G, Sg, K, 1, NB)
+    pk_y = jnp.take(table_y, indices, axis=0)
+    return _grouped_impl(use_pallas)(
+        msgs, sigs, (pk_x, pk_y), key_mask, rand_bits, set_mask,
+        group_mask,
+    )
+
+
+_jitted_grouped: dict = {}
+
+
+def _get_grouped_fns():
+    import functools
+
+    key = _impl_key()
+    pair = _jitted_grouped.get(key)
+    if pair is None:
+        pair = _jitted_grouped[key] = (
+            jax.jit(_grouped_impl(key[0])),
+            jax.jit(functools.partial(_grouped_indexed_verify, key[0])),
+        )
+    return pair
+
+
 def _get_indexed_fn():
     import functools
 
@@ -254,14 +290,140 @@ class _Marshalled:
         "s_bucket",
         "k_bucket",
         "timings",
+        # message-grouped layout (None/False when flat)
+        "grouped",
+        "group_mask",
+        "n_groups",
     )
 
 
-def _marshal(sets) -> _Marshalled:
+def _grouping_enabled() -> bool:
+    import os
+
+    return os.environ.get("LIGHTHOUSE_TPU_GROUPED") != "0"
+
+
+def _group_plan(sets):
+    """Order-preserving message→set-index grouping, or None when the
+    merge does not pay: grouping must at least HALVE the pair count
+    (G*2 <= S), and the padded (G, Sg) grid must not blow past twice
+    the flat bucket (pathologically skewed group sizes)."""
+    by_msg: dict[bytes, list] = {}
+    for i, s in enumerate(sets):
+        by_msg.setdefault(bytes(s.message), []).append(i)
+    n_sets = len(sets)
+    G = len(by_msg)
+    if G * 2 > n_sets:
+        return None
+    sg_b = _bucket(max(len(ix) for ix in by_msg.values()), 1)
+    g_b = _bucket(G, 1)
+    if g_b * sg_b > 2 * _bucket(n_sets, 4):
+        return None
+    return list(by_msg.items())
+
+
+def _marshal(sets, allow_grouped: bool = True) -> _Marshalled:
+    """Marshal a batch, preferring the message-grouped grid layout
+    (G distinct messages -> G+1 Miller loops instead of S+1; the
+    committee-shaped attestation load has S/G >= 100). The per-set
+    fallback path marshals with allow_grouped=False — per-set verdicts
+    need per-set pairs."""
+    if allow_grouped and _grouping_enabled():
+        plan = _group_plan(sets)
+        if plan is not None:
+            return _marshal_grouped(sets, plan)
+    return _marshal_flat(sets)
+
+
+def _marshal_grouped(sets, groups) -> _Marshalled:
+    """Grid marshal: groups -> (g_bucket, sg_bucket) lanes, messages one
+    per group. Padding lanes carry None sigs + all-False key masks."""
+    t0 = time.perf_counter()
+    m = _Marshalled()
+    G = len(groups)
+    g_b = _bucket(G, 1)
+    sg_b = _bucket(max(len(ix) for _, ix in groups), 1)
+    m.grouped = True
+    m.n_groups = G
+    m.s_bucket = g_b * sg_b
+    m.k_bucket = _bucket(max(len(s.pubkeys) for s in sets), 1)
+
+    group_msgs = [_msg_affine(sets[ix[0]].message) for _, ix in groups]
+    group_msgs += [None] * (g_b - G)
+    m.group_mask = np.array(
+        [True] * G + [False] * (g_b - G), dtype=bool
+    )
+
+    # lane order: group-major, each group padded to sg_b
+    order: list = []
+    for _, ix in groups:
+        order += list(ix) + [None] * (sg_b - len(ix))
+    order += [None] * ((g_b - G) * sg_b)
+
+    sig_aff = batch_to_affine_g2([s.signature.point for s in sets])
+    sigs = [None if i is None else sig_aff[i] for i in order]
+    t1 = time.perf_counter()
+
+    m.set_mask = np.array(
+        [i is not None for i in order], dtype=bool
+    ).reshape(g_b, sg_b)
+    m.key_mask = np.array(
+        [
+            [False] * m.k_bucket
+            if i is None
+            else [True] * len(sets[i].pubkeys)
+            + [False] * (m.k_bucket - len(sets[i].pubkeys))
+            for i in order
+        ],
+        dtype=bool,
+    ).reshape(g_b, sg_b, m.k_bucket)
+
+    m.table = _table_for(sets)
+    if m.table is not None:
+        indices = np.full((len(order), m.k_bucket), -1, dtype=np.int32)
+        for lane, i in enumerate(order):
+            if i is None:
+                continue
+            for k, p in enumerate(sets[i].pubkeys):
+                indices[lane, k] = p.validator_index
+        m.indices = m.table.gather_indices(indices).reshape(
+            g_b, sg_b, m.k_bucket
+        )
+        m.pubkeys = None
+    else:
+        pk_rows = []
+        for i in order:
+            row = (
+                []
+                if i is None
+                else [G1_GROUP.to_affine(p.point) for p in sets[i].pubkeys]
+            )
+            pk_rows.append(row + [None] * (m.k_bucket - len(row)))
+        pk_flat = [p for row in pk_rows for p in row]
+        pk_x, pk_y = _pack_g1_affine(pk_flat)
+        m.indices = None
+        m.pubkeys = (
+            np.asarray(pk_x).reshape(g_b, sg_b, m.k_bucket, 1, fb.NB),
+            np.asarray(pk_y).reshape(g_b, sg_b, m.k_bucket, 1, fb.NB),
+        )
+    m.msgs = _pack_g2_affine(group_msgs)
+    m.sigs = tuple(
+        np.asarray(c).reshape(g_b, sg_b, 2, fb.NB)
+        for c in _pack_g2_affine(sigs)
+    )
+    t2 = time.perf_counter()
+    m.timings = {"points_ms": (t1 - t0) * 1e3, "pack_ms": (t2 - t1) * 1e3}
+    return m
+
+
+def _marshal_flat(sets) -> _Marshalled:
     t0 = time.perf_counter()
     n_sets = len(sets)
     max_keys = max(len(s.pubkeys) for s in sets)
     m = _Marshalled()
+    m.grouped = False
+    m.n_groups = None
+    m.group_mask = None
     m.s_bucket = _bucket(n_sets, 4)
     m.k_bucket = _bucket(max_keys, 1)
 
@@ -319,6 +481,8 @@ def _record_stats(n_sets, m, t_start, t_subgroup, t_marshal, t_end):
         {
             "n_sets": n_sets,
             "indexed_path": m.table is not None,
+            "grouped": bool(m.grouped),
+            "n_groups": m.n_groups,
             "subgroup_ms": (t_subgroup - t_start) * 1e3,
             "points_ms": m.timings["points_ms"],
             "pack_ms": m.timings["pack_ms"],
@@ -357,6 +521,23 @@ def _dispatch(m, rand_bits):
     """Async device dispatch of a marshalled batch — returns the
     unforced device value."""
     CALL_COUNTS["batch"] += 1
+    if m.grouped:
+        # rand bits were sampled for s_bucket lanes; the grouped verify
+        # takes them on the (G, Sg) grid
+        rand_bits = np.asarray(rand_bits).reshape(
+            m.set_mask.shape + (batch_verify.RAND_BITS,)
+        )
+        plain, indexed = _get_grouped_fns()
+        if m.table is not None:
+            tx, ty = m.table.rows()
+            return indexed(
+                m.msgs, m.sigs, tx, ty, m.indices, m.key_mask,
+                rand_bits, m.set_mask, m.group_mask,
+            )
+        return plain(
+            m.msgs, m.sigs, m.pubkeys, m.key_mask, rand_bits,
+            m.set_mask, m.group_mask,
+        )
     if m.table is not None:
         tx, ty = m.table.rows()
         return _get_indexed_fn()(
@@ -468,7 +649,7 @@ def verify_signature_sets_tpu_individual(sets) -> list:
     t_subgroup = time.perf_counter()
 
     subset = [sets[i] for i in live]
-    m = _marshal(subset)
+    m = _marshal(subset, allow_grouped=False)  # per-set pairs needed
     t_marshal = time.perf_counter()
 
     plain_fn, indexed_fn = _get_individual_fns()

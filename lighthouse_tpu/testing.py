@@ -194,3 +194,111 @@ def make_signature_set_batch(
         rand_bits,
         set_mask,
     )
+
+
+def make_grouped_signature_set_batch(
+    n_groups: int,
+    sets_per_group: int,
+    max_keys: int = 1,
+    seed: int = 0,
+    corrupt_indices: tuple = (),
+    fast_sequential: bool = False,
+):
+    """Committee-shaped fixture: `n_groups` distinct messages with
+    `sets_per_group` signature sets each — the gossip attestation load
+    (~64 committees over >=30k sets) that the message-grouped pairing
+    merge collapses to G+1 Miller loops.
+
+    Returns (grouped_args, flat_args): the 7-tuple for
+    verify_signature_sets_grouped and the SAME sets flattened as the
+    6-tuple for verify_signature_sets, so tests can assert verdict
+    equality. `corrupt_indices`: (group, set) pairs whose signature is
+    replaced with a forgery."""
+    rng = random.Random(seed)
+    G, Sg, K = n_groups, sets_per_group, max_keys
+
+    group_msgs = []
+    sigs_grid, pk_grid, km_grid = [], [], []
+    if fast_sequential:
+        # secret keys are 1..Sg within each group; points built by
+        # running additions — O(G*Sg) adds instead of O(G*Sg*255)
+        # doublings (the 30k-set bench shape would otherwise take hours
+        # of pure-Python scalar muls)
+        assert K == 1, "fast_sequential supports single-key sets"
+        for g in range(G):
+            h = RG2.mul_scalar(RG2.generator, rng.randrange(2, C.R))
+            group_msgs.append(RG2.to_affine(h))
+            running_pk = RG1.infinity
+            running_sig = RG2.infinity
+            for s in range(Sg):
+                running_pk = RG1.add(running_pk, RG1.generator)
+                running_sig = RG2.add(running_sig, h)
+                sigs_grid.append(RG2.to_affine(running_sig))
+                pk_grid.append([RG1.to_affine(running_pk)])
+                km_grid.append([True])
+    else:
+        for g in range(G):
+            h = RG2.mul_scalar(RG2.generator, rng.randrange(2, C.R))
+            group_msgs.append(RG2.to_affine(h))
+            for s in range(Sg):
+                n_keys = rng.randrange(1, K + 1)
+                sks = [rng.randrange(2, C.R) for _ in range(n_keys)]
+                agg_sig = RG2.infinity
+                row = []
+                for sk in sks:
+                    row.append(
+                        RG1.to_affine(RG1.mul_scalar(RG1.generator, sk))
+                    )
+                    agg_sig = RG2.add(agg_sig, RG2.mul_scalar(h, sk))
+                sigs_grid.append(RG2.to_affine(agg_sig))
+                pk_grid.append(row + [None] * (K - n_keys))
+                km_grid.append([True] * n_keys + [False] * (K - n_keys))
+    for g, s in corrupt_indices:
+        # forge by adding one extra H to the true signature: always
+        # invalid for this set's keys (a fixed scalar like 7 would
+        # COLLIDE with fast_sequential's secret key 7 and be valid)
+        sigs_grid[g * Sg + s] = RG2.to_affine(
+            RG2.add(
+                RG2.from_affine(sigs_grid[g * Sg + s]),
+                RG2.from_affine(group_msgs[g]),
+            )
+        )
+
+    flat_pks = [p for row in pk_grid for p in row]
+    pk_x, pk_y = _pack_g1_affine(flat_pks)
+    pubkeys_flat = (
+        np.asarray(pk_x).reshape(G * Sg, K, 1, fb.NB),
+        np.asarray(pk_y).reshape(G * Sg, K, 1, fb.NB),
+    )
+    sig_pack = tuple(
+        np.asarray(c) for c in _pack_g2_affine(sigs_grid)
+    )
+    key_mask = np.array(km_grid, dtype=bool)
+    rand_scalars = [
+        rng.randrange(1, 1 << batch_verify.RAND_BITS)
+        for _ in range(G * Sg)
+    ]
+    rand_bits = curve.scalars_to_bits(
+        rand_scalars, batch_verify.RAND_BITS
+    )
+    set_mask = np.ones(G * Sg, dtype=bool)
+
+    grouped = (
+        _pack_g2_affine(group_msgs),
+        tuple(c.reshape(G, Sg, 2, fb.NB) for c in sig_pack),
+        tuple(c.reshape(G, Sg, K, 1, fb.NB) for c in pubkeys_flat),
+        key_mask.reshape(G, Sg, K),
+        rand_bits.reshape(G, Sg, batch_verify.RAND_BITS),
+        set_mask.reshape(G, Sg),
+        np.ones(G, dtype=bool),
+    )
+    flat_msgs = [group_msgs[g] for g in range(G) for _ in range(Sg)]
+    flat = (
+        _pack_g2_affine(flat_msgs),
+        sig_pack,
+        pubkeys_flat,
+        key_mask,
+        rand_bits,
+        set_mask,
+    )
+    return grouped, flat

@@ -66,7 +66,11 @@ MEASURE_TIMEOUT = 1500     # per-config deadline (fresh compile included)
 SWEEP = [
     ("xla", 1024),
     ("pallas", 4096),
+    # the committee-shaped full-slot load (30720 sets over 64 messages,
+    # G+1 Miller loops): the shape the 150k north star actually means —
+    # measured right after the distinct-message headline configs
     ("pallas", 30720),
+    ("pallas", 30720, "grouped64"),
     ("pallas", 64, "sync512"),
     ("pallas", 132, "block"),
     ("pallas", 32, "replay32"),

@@ -234,3 +234,49 @@ def test_native_subgroup_checks_match_python():
         u = hash_to_field_fp2(bytes([i]) + b"probe", 2)
         pt = iso_map(map_to_curve_sswu(u[0]))
         assert g2decomp.g2_in_subgroup(pt[0], pt[1]) is False
+
+
+def test_tpu_backend_grouped_dispatch():
+    """Sets sharing messages route through the message-grouped device
+    path (G+1 pairs): verdicts match the ref backend, forgery fails the
+    batch and the per-set fallback (always flat) isolates it, and
+    LIGHTHOUSE_TPU_GROUPED=0 falls back to the flat layout."""
+    import os
+
+    from lighthouse_tpu.bls import tpu_backend
+
+    pairs = bls.interop_keypairs(8)
+    msgs = [b"\x41" * 32, b"\x42" * 32]  # 2 messages x 4 signers
+    sets = [
+        bls.SignatureSet(p.sk.sign(msgs[i // 4]), [p.pk], msgs[i // 4])
+        for i, p in enumerate(pairs)
+    ]
+
+    assert bls.verify_signature_sets(sets, backend="tpu", seed=3)
+    assert tpu_backend.LAST_HOST_STATS["grouped"] is True
+    assert tpu_backend.LAST_HOST_STATS["n_groups"] == 2
+
+    # forged member -> batch False; per-set fallback isolates it
+    bad = list(sets)
+    bad[5] = bls.SignatureSet(sets[0].signature, [pairs[5].pk], msgs[1])
+    assert not bls.verify_signature_sets(bad, backend="tpu", seed=3)
+    verdicts = tpu_backend.verify_signature_sets_tpu_individual(bad)
+    assert verdicts == [True] * 5 + [False] + [True] * 2
+    assert tpu_backend.LAST_HOST_STATS["grouped"] is False
+
+    # kill switch: flat layout, same verdict
+    os.environ["LIGHTHOUSE_TPU_GROUPED"] = "0"
+    try:
+        assert bls.verify_signature_sets(sets, backend="tpu", seed=3)
+        assert tpu_backend.LAST_HOST_STATS["grouped"] is False
+    finally:
+        del os.environ["LIGHTHOUSE_TPU_GROUPED"]
+
+    # distinct messages never group (the merge must pay >= 2x)
+    distinct = [
+        bls.SignatureSet(p.sk.sign(bytes([i]) * 32), [p.pk],
+                         bytes([i]) * 32)
+        for i, p in enumerate(pairs)
+    ]
+    assert bls.verify_signature_sets(distinct, backend="tpu", seed=3)
+    assert tpu_backend.LAST_HOST_STATS["grouped"] is False
