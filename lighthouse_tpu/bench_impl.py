@@ -5,7 +5,9 @@ added or renamed in one place cannot be silently mislabeled in another."""
 import os
 import sys
 
-KNOWN_IMPLS = ("xla", "mxu", "pallas", "ptail", "txla", "predc", "predcbf")
+KNOWN_IMPLS = (
+    "xla", "mxu", "pallas", "ptail", "txla", "predc", "predcbf", "pw2",
+)
 
 
 def apply_impl_env(impl: str, what: str = "bench") -> None:
@@ -22,3 +24,6 @@ def apply_impl_env(impl: str, what: str = "bench") -> None:
         os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "i8"
     if impl == "predcbf":
         os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "bf16"
+    if impl == "pw2":
+        # pallas kernels with the windowed-2 RLC ladder
+        os.environ["LIGHTHOUSE_TPU_LADDER"] = "w2"

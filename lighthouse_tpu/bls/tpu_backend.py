@@ -76,12 +76,13 @@ def _use_pallas() -> bool:
 
 
 def _impl_key():
-    """(use_pallas, MXU_REDC form, MXU_CONV on) — everything read at
-    trace time that changes the compiled program, NORMALIZED the way the
-    kernels consume it (tfield.use_mxu_redc maps "1"/"i8" to one form;
-    fieldb only tests MXU_CONV == "1") so equivalent spellings share one
-    trace instead of recompiling."""
+    """(use_pallas, MXU_REDC form, MXU_CONV on, windowed ladder) —
+    everything read at trace time that changes the compiled program,
+    NORMALIZED the way the kernels consume it (tfield.use_mxu_redc maps
+    "1"/"i8" to one form; fieldb only tests MXU_CONV == "1") so
+    equivalent spellings share one trace instead of recompiling."""
     from lighthouse_tpu.ops import tfield
+    from lighthouse_tpu.ops.pallas_ladder import use_windowed_ladder
 
     import os
 
@@ -89,6 +90,7 @@ def _impl_key():
         _use_pallas(),
         tfield.use_mxu_redc(),
         os.environ.get("LIGHTHOUSE_TPU_MXU_CONV") == "1",
+        use_windowed_ladder(),
     )
 
 

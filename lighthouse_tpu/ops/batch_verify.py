@@ -207,10 +207,9 @@ def verify_signature_sets_grouped_pallas(
     """The grouped check with the RLC ladders and the (G+1)-pair Miller
     loop running as the same fused Pallas kernels the flat path uses —
     ladders over the flattened (G*Sg) lane axis, Miller over the G+1
-    merged pairs."""
-    from lighthouse_tpu.ops import tcurve, tfield as tf, tower
+    merged pairs (via the shared _pairs_to_verdict_pallas tail)."""
+    from lighthouse_tpu.ops import tcurve, tfield as tf
     from lighthouse_tpu.ops.pallas_ladder import ladder_pallas
-    from lighthouse_tpu.ops.pallas_miller import miller_loop_pallas
 
     G_, Sg = set_mask.shape
     S = G_ * Sg

@@ -118,6 +118,48 @@ class TProjective:
         (acc, _), _ = jax.lax.scan(step, (self.identity(B), pt), bits)
         return acc
 
+    # ------------------------------------------ windowed (w=2) ladder
+
+    def window2_table(self, pt):
+        """{identity, P, 2P, 3P} for the MSB-first 2-bit window ladder.
+        Complete formulas make the identity entry exact, so digit 0
+        needs no conditional."""
+        B = pt[0].shape[-1]
+        p2 = self.double(pt)
+        p3 = self.add(p2, pt)
+        return (self.identity(B), pt, p2, p3)
+
+    def window2_step(self, acc, table, digit):
+        """One MSB-first 2-bit window: acc = 4*acc + table[digit].
+        2 doubles + 1 complete add + selects, vs 2 doubles + 2 adds for
+        two plain ladder steps — the PERF_NOTES '64 adds -> ~33' item."""
+        acc = self.double(self.double(acc))
+        t01 = self.select(digit == 1, table[1], table[0])
+        t23 = self.select(digit == 3, table[3], table[2])
+        cand = self.select(digit >= 2, t23, t01)
+        return self.add(acc, cand)
+
+    def mul_scalar_bits_w2(self, pt, bits):
+        """Windowed-2 variant of mul_scalar_bits — identical result,
+        ~25% fewer group ops. bits (nbits, B) int32 LSB-first; nbits is
+        padded to even internally."""
+        n_bits = bits.shape[0]
+        if n_bits % 2:
+            bits = jnp.concatenate(
+                [bits, jnp.zeros((1,) + bits.shape[1:], bits.dtype)]
+            )
+        # LSB-first pairs -> MSB-first digit sequence
+        digits = bits[0::2] + 2 * bits[1::2]
+        digits = digits[::-1]
+        table = self.window2_table(pt)
+        B = pt[0].shape[-1]
+
+        def step(acc, digit):
+            return self.window2_step(acc, table, digit), None
+
+        acc, _ = jax.lax.scan(step, self.identity(B), digits)
+        return acc
+
     def sum_lanes(self, pt, axis: int = -1):
         """Tree-fold the lane axis down to ONE point (1-lane bundles).
         Lane count must be a power of two (pad with identities first)."""

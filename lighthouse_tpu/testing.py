@@ -203,6 +203,7 @@ def make_grouped_signature_set_batch(
     seed: int = 0,
     corrupt_indices: tuple = (),
     fast_sequential: bool = False,
+    build_flat: bool = True,
 ):
     """Committee-shaped fixture: `n_groups` distinct messages with
     `sets_per_group` signature sets each — the gossip attestation load
@@ -213,7 +214,9 @@ def make_grouped_signature_set_batch(
     verify_signature_sets_grouped and the SAME sets flattened as the
     6-tuple for verify_signature_sets, so tests can assert verdict
     equality. `corrupt_indices`: (group, set) pairs whose signature is
-    replaced with a forgery."""
+    replaced with a forgery. `build_flat=False` skips the flat copy
+    (flat_args is None) — the bench shape repeats 30k message points
+    for nothing."""
     rng = random.Random(seed)
     G, Sg, K = n_groups, sets_per_group, max_keys
 
@@ -292,6 +295,8 @@ def make_grouped_signature_set_batch(
         set_mask.reshape(G, Sg),
         np.ones(G, dtype=bool),
     )
+    if not build_flat:
+        return grouped, None
     flat_msgs = [group_msgs[g] for g in range(G) for _ in range(Sg)]
     flat = (
         _pack_g2_affine(flat_msgs),

@@ -71,6 +71,11 @@ SWEEP = [
     # measured right after the distinct-message headline configs
     ("pallas", 30720),
     ("pallas", 30720, "grouped64"),
+    # windowed-2 RLC ladder A/B: on the grouped shape the ladders ARE
+    # the dominant cost (the Miller loops collapsed to G+1), so the
+    # ~25% ladder-op cut shows up ~proportionally there
+    ("pw2", 30720, "grouped64"),
+    ("pw2", 4096),
     ("pallas", 64, "sync512"),
     ("pallas", 132, "block"),
     ("pallas", 32, "replay32"),

@@ -111,3 +111,56 @@ def test_tcurve_scan_ladder_and_lane_fold():
         tuple(c[None] for c in out_bl), tuple(c[None] for c in ref)
     )
     assert bool(np.asarray(eq)[0])
+
+
+def test_windowed_ladder_matches_double_add():
+    """The w=2 MSB-first windowed ladder (tcurve.mul_scalar_bits_w2 and
+    the LIGHTHOUSE_TPU_LADDER=w2 kernel) is point-equal to the plain
+    double-add chain — including identity lanes, zero scalars, odd bit
+    counts, and max-weight scalars."""
+    import os
+
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.ops import curve, tcurve
+    from lighthouse_tpu.ops.pallas_ladder import ladder_pallas
+
+    args = td.make_signature_set_batch(4, max_keys=1, seed=9)
+    _, sigs, _, _, _, sm = args
+    sx, sy = (tf.from_batchlead(c) for c in sigs)
+    # lane 3 masked out: the identity must ride every variant unchanged
+    mask = np.array([True, True, True, False])
+    pt = tcurve.TPG2.from_affine((sx, sy), jnp.asarray(mask))
+
+    scalars = [0, 1, (1 << 64) - 1, 0xDEADBEEFCAFE1234]
+    bits_t = jnp.asarray(
+        np.array(
+            [[(s >> i) & 1 for s in scalars] for i in range(64)],
+            np.int32,
+        )
+    )
+
+    plain = jax.jit(tcurve.TPG2.mul_scalar_bits)(pt, bits_t)
+    w2 = jax.jit(tcurve.TPG2.mul_scalar_bits_w2)(pt, bits_t)
+    # odd bit count exercises the internal pad
+    w2_odd = jax.jit(tcurve.TPG2.mul_scalar_bits_w2)(pt, bits_t[:63])
+
+    def eq_lanes(a, b):
+        a_bl = tuple(tf.to_batchlead(c) for c in a)
+        b_bl = tuple(tf.to_batchlead(c) for c in b)
+        return np.asarray(curve.PG2.eq(a_bl, b_bl))
+
+    assert eq_lanes(plain, w2).all()
+    assert eq_lanes(
+        jax.jit(tcurve.TPG2.mul_scalar_bits)(pt, bits_t[:63]), w2_odd
+    ).all()
+
+    # the kernel path under the env knob (interpret mode)
+    os.environ["LIGHTHOUSE_TPU_LADDER"] = "w2"
+    try:
+        out = ladder_pallas(
+            pt, bits_t, group_name="G2", block_b=4, interpret=True
+        )
+    finally:
+        del os.environ["LIGHTHOUSE_TPU_LADDER"]
+    assert eq_lanes(plain, out).all()
