@@ -86,41 +86,51 @@ def cmd_bn(args):
             f"{len(cfg.boot_nodes or [])} boot nodes)",
         )
     spec = _spec_for(args.network)
-    if args.checkpoint_state or args.checkpoint_block:
+    if (
+        args.checkpoint_state
+        or args.checkpoint_block
+        or args.checkpoint_sync_url
+    ):
         # weak-subjectivity boot (client/src/config.rs:31-34): trusted
-        # finalized state + matching block from SSZ files; no dev chain
-        if not (args.checkpoint_state and args.checkpoint_block):
+        # finalized state + block, from SSZ files or fetched from a
+        # trusted beacon node over the standard API
+        from lighthouse_tpu.http_api.client import (
+            ApiClientError,
+            decode_checkpoint_pair,
+            fetch_checkpoint,
+        )
+
+        if args.checkpoint_sync_url and (
+            args.checkpoint_state or args.checkpoint_block
+        ):
             print(
-                "--checkpoint-state and --checkpoint-block are required "
-                "together",
+                "--checkpoint-sync-url and --checkpoint-state/"
+                "--checkpoint-block are mutually exclusive",
                 file=sys.stderr,
             )
             return 1
-        from lighthouse_tpu.types.containers import types_for
-
-        t = types_for(spec)
-        with open(args.checkpoint_state, "rb") as f:
-            raw_state = f.read()
-        with open(args.checkpoint_block, "rb") as f:
-            raw_block = f.read()
-        # decode with the newest fork class that round-trips
-        state = block = None
-        for fork in reversed(list(t.state_classes)):
-            try:
-                cand = t.state_classes[fork].decode(raw_state)
-                if spec.fork_name_at_epoch(
-                    spec.slot_to_epoch(cand.slot)
-                ) != fork:
-                    continue
-                block = t.signed_block_classes[fork].decode(raw_block)
-            except Exception:
-                continue
-            state = cand
-            break
-        if state is None:
-            print(
-                "could not decode checkpoint state/block", file=sys.stderr
-            )
+        try:
+            if args.checkpoint_sync_url:
+                state, block = fetch_checkpoint(
+                    args.checkpoint_sync_url, spec
+                )
+            else:
+                if not (args.checkpoint_state and args.checkpoint_block):
+                    print(
+                        "--checkpoint-state and --checkpoint-block are "
+                        "required together",
+                        file=sys.stderr,
+                    )
+                    return 1
+                with open(args.checkpoint_state, "rb") as f:
+                    raw_state = f.read()
+                with open(args.checkpoint_block, "rb") as f:
+                    raw_block = f.read()
+                state, block = decode_checkpoint_pair(
+                    raw_state, raw_block, spec
+                )
+        except ApiClientError as e:
+            print(f"checkpoint sync failed: {e}", file=sys.stderr)
             return 1
         chain = BeaconChain.from_checkpoint(
             state, block, spec, kv=kv, backend=args.bls_backend
@@ -468,6 +478,12 @@ def build_parser():
         "--testnet-dir",
         default=None,
         help="network directory (config.yaml + genesis.ssz) to boot from",
+    )
+    bn.add_argument(
+        "--checkpoint-sync-url",
+        default=None,
+        help="trusted beacon node URL to fetch the finalized "
+        "state/block from (weak-subjectivity boot)",
     )
     bn.set_defaults(fn=cmd_bn)
 

@@ -61,10 +61,42 @@ class BeaconNodeHttpClient:
                 body=err_body,
             ) from e
 
+    def _get_ssz(self, path: str) -> bytes:
+        """GET with SSZ content negotiation (Accept: octet-stream).
+        Connection-level failures (refused, DNS, timeout) surface as
+        ApiClientError too — checkpoint-sync callers must get a clean
+        diagnostic for an unreachable provider, not a raw traceback."""
+        import urllib.error
+
+        req = urllib.request.Request(
+            self.base + path,
+            headers={"Accept": "application/octet-stream"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                if r.headers.get("Content-Type") != (
+                    "application/octet-stream"
+                ):
+                    raise ApiClientError(
+                        f"GET {path}: expected SSZ, got "
+                        f"{r.headers.get('Content-Type')}"
+                    )
+                return r.read()
+        except HTTPError as e:
+            raise ApiClientError(f"GET {path}: {e.code}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise ApiClientError(f"GET {path}: {e}") from e
+
     # ------------------------------------------------------------- routes
 
     def get_version(self) -> str:
         return self._get("/eth/v1/node/version")["data"]["version"]
+
+    def get_block_ssz(self, block_id: str = "finalized") -> bytes:
+        return self._get_ssz(f"/eth/v2/beacon/blocks/{block_id}")
+
+    def get_debug_state_ssz(self, state_id: str = "finalized") -> bytes:
+        return self._get_ssz(f"/eth/v2/debug/beacon/states/{state_id}")
 
     def get_health_ok(self) -> bool:
         try:
@@ -251,3 +283,76 @@ class BeaconNodeHttpClient:
             self.base + "/metrics", timeout=self.timeout
         ) as r:
             return r.read().decode()
+
+
+def _decode_checkpoint_state(raw_state: bytes, spec):
+    """SSZ state bytes -> (state, fork name): try fork classes
+    newest-first, accept the one whose slot matches its fork."""
+    from lighthouse_tpu.types.containers import types_for
+
+    t = types_for(spec)
+    for fork in reversed(list(t.state_classes)):
+        try:
+            cand = t.state_classes[fork].decode(raw_state)
+        except Exception:
+            continue
+        if spec.fork_name_at_epoch(
+            spec.slot_to_epoch(cand.slot)
+        ) == fork:
+            return cand, fork
+    raise ApiClientError("could not decode checkpoint state")
+
+
+def _check_checkpoint_pair(state, block):
+    """A trusted checkpoint provider is still cross-checked: the block
+    must COMMIT to the state (state_root)."""
+    from lighthouse_tpu.ssz.cached_hash import cached_state_root
+
+    if bytes(block.message.state_root) != cached_state_root(state):
+        raise ApiClientError(
+            "checkpoint block does not commit to the checkpoint state"
+        )
+
+
+def decode_checkpoint_pair(raw_state: bytes, raw_block: bytes, spec):
+    """SSZ bytes -> (state, block) for a weak-subjectivity anchor.
+    Shared by --checkpoint-state files and --checkpoint-sync-url."""
+    from lighthouse_tpu.types.containers import types_for
+
+    state, fork = _decode_checkpoint_state(raw_state, spec)
+    try:
+        block = types_for(spec).signed_block_classes[fork].decode(
+            raw_block
+        )
+    except Exception as e:
+        raise ApiClientError(
+            f"could not decode checkpoint block: {e}"
+        ) from e
+    _check_checkpoint_pair(state, block)
+    return state, block
+
+
+def fetch_checkpoint(url: str, spec, timeout: float = 30.0):
+    """The --checkpoint-sync-url flow (client/src/config.rs:31-34 +
+    checkpoint-sync.md): pull the FINALIZED state from a trusted beacon
+    node, then the block AT THE STATE'S SLOT — two independent
+    "finalized" reads could straddle a finalization advance and return
+    a torn pair — cross-check, and return (state, block) ready for
+    BeaconChain.from_checkpoint."""
+    from lighthouse_tpu.types.containers import types_for
+
+    client = BeaconNodeHttpClient(url, timeout=timeout)
+    state, fork = _decode_checkpoint_state(
+        client.get_debug_state_ssz("finalized"), spec
+    )
+    raw_block = client.get_block_ssz(str(state.slot))
+    try:
+        block = types_for(spec).signed_block_classes[fork].decode(
+            raw_block
+        )
+    except Exception as e:
+        raise ApiClientError(
+            f"could not decode checkpoint block: {e}"
+        ) from e
+    _check_checkpoint_pair(state, block)
+    return state, block

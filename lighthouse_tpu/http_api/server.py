@@ -107,7 +107,9 @@ class BeaconApiServer:
                 if self.path.split("?")[0] == "/eth/v1/events":
                     return self._serve_events()
                 try:
-                    out = api.handle_get(self.path)
+                    # self.headers is an HTTPMessage: case-insensitive
+                    # get(), as header lookup must be
+                    out = api.handle_get(self.path, self.headers)
                     if isinstance(out, tuple):
                         self._send(200, out[0], content_type=out[1])
                     else:
@@ -200,7 +202,7 @@ class BeaconApiServer:
 
     # ------------------------------------------------------------ routing
 
-    def handle_get(self, path: str):
+    def handle_get(self, path: str, headers: dict | None = None):
         chain = self.chain
         parts = [p for p in path.split("?")[0].split("/") if p]
         if path == "/metrics":
@@ -551,6 +553,17 @@ class BeaconApiServer:
         if parts[:3] == ["eth", "v2", "beacon"]:
             if parts[3] == "blocks" and len(parts) >= 5:
                 block = self._resolve_block(parts[4])
+                accept = (
+                    headers.get("Accept", "") if headers is not None
+                    else ""
+                )
+                if "application/octet-stream" in accept:
+                    # standard SSZ content negotiation — the checkpoint
+                    # sync client pulls the anchor block this way
+                    return (
+                        block.to_bytes(),
+                        "application/octet-stream",
+                    )
                 return {
                     "version": chain.spec.fork_name_at_epoch(
                         chain.spec.slot_to_epoch(block.message.slot)
@@ -872,10 +885,41 @@ class BeaconApiServer:
             "direction": "outbound",
         }
 
-    def _resolve_state(self, state_id: str):
+    def _checkpoint_root(self, which: str) -> bytes:
         chain = self.chain
-        if state_id in ("head", "justified", "finalized"):
+        cp = (
+            chain.finalized_checkpoint
+            if which == "finalized"
+            else chain.head_state.current_justified_checkpoint
+        )
+        root = bytes(cp.root)
+        return root if cp.epoch else chain.genesis_root
+
+    def _resolve_state(self, state_id: str):
+        """head | finalized | justified | slot — finalized/justified
+        resolve to the CHECKPOINT block's post-state (what a
+        checkpoint-sync client must receive). Before the first
+        finalization the checkpoint IS genesis, where no block object
+        exists — the head state (== the genesis-rooted chain state)
+        keeps those queries answerable."""
+        chain = self.chain
+        if state_id == "head":
             return chain.head_state
+        if state_id in ("justified", "finalized"):
+            cp = (
+                chain.finalized_checkpoint
+                if state_id == "finalized"
+                else chain.head_state.current_justified_checkpoint
+            )
+            if cp.epoch == 0:
+                return chain.head_state
+            block = chain.store.get_block(self._checkpoint_root(state_id))
+            if block is None:
+                raise ApiError(404, f"{state_id} block not found")
+            state = chain.store.state_at_slot(block.message.slot)
+            if state is None:
+                raise ApiError(404, f"{state_id} state not found")
+            return state
         if state_id.startswith("0x"):
             raise ApiError(404, "state lookup by root unsupported")
         state = chain.store.state_at_slot(int(state_id))
@@ -887,6 +931,8 @@ class BeaconApiServer:
         chain = self.chain
         if block_id == "head":
             root = chain.head_root
+        elif block_id in ("justified", "finalized"):
+            root = self._checkpoint_root(block_id)
         elif block_id.startswith("0x"):
             root = bytes.fromhex(block_id[2:])
         else:

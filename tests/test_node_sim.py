@@ -370,3 +370,37 @@ def test_attestation_subnet_plane(spec):
             exclude="node0",
         )
     )
+
+
+def test_checkpoint_sync_url_flow(spec):
+    """--checkpoint-sync-url end to end: a serving node exposes its
+    FINALIZED state + block over the standard API (SSZ content
+    negotiation); fetch_checkpoint pulls and cross-checks them; the
+    fetched pair boots a chain whose head is the provider's finalized
+    checkpoint."""
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.http_api.client import fetch_checkpoint
+
+    h, hub, nodes = build_sim(spec, 1)
+    (a,) = nodes
+    for slot in range(1, spec.SLOTS_PER_EPOCH * 5 + 1):
+        block = h.advance_slot_with_block(slot)
+        a.on_slot(slot)
+        a.chain.process_block(block)
+    assert a.chain.finalized_checkpoint.epoch >= 2
+    srv = a.start_http_api()
+    try:
+        state, block = fetch_checkpoint(
+            f"http://127.0.0.1:{srv.port}", spec
+        )
+    finally:
+        srv.stop()
+    fin_root = bytes(a.chain.finalized_checkpoint.root)
+    assert type(block.message).hash_tree_root(block.message) == fin_root
+    assert state.slot == block.message.slot
+
+    late = BeaconChain.from_checkpoint(
+        state, block, spec, backend="ref"
+    )
+    assert late.head_root == fin_root
+    assert late.anchor_slot == state.slot
