@@ -41,8 +41,12 @@ def _serve_api(chain, args, banner: str) -> int:
     stop — shared by every bn boot path."""
     from lighthouse_tpu.http_api import BeaconApiServer
 
-    srv = BeaconApiServer(chain, port=args.http_port).start()
-    print(f"{banner}; HTTP API on 127.0.0.1:{srv.port}")
+    if args.slots_per_restore_point:
+        chain.store.slots_per_restore_point = args.slots_per_restore_point
+    srv = BeaconApiServer(
+        chain, host=args.http_address, port=args.http_port
+    ).start()
+    print(f"{banner}; HTTP API on {args.http_address}:{srv.port}")
     try:
         if args.serve_seconds:
             time.sleep(args.serve_seconds)
@@ -54,11 +58,29 @@ def _serve_api(chain, args, banner: str) -> int:
 def cmd_bn(args):
     """Run a beacon node: interop genesis, optional self-proposing (dev
     chain), HTTP API, per-slot timer loop."""
+    import os
+
     from lighthouse_tpu.harness import Harness
     from lighthouse_tpu.beacon_chain import BeaconChain
     from lighthouse_tpu.http_api import BeaconApiServer
     from lighthouse_tpu.store import SqliteStore
 
+    if args.purge_db and args.datadir:
+        # fork_revert.rs:14-15 guidance: a node stuck on the wrong side
+        # of a fork starts over. The SQLite WAL/SHM sidecars must go
+        # too — a fresh db next to a stale -wal would REPLAY the purged
+        # chain right back on open
+        purged = False
+        for path in (
+            args.datadir,
+            args.datadir + "-wal",
+            args.datadir + "-shm",
+        ):
+            if os.path.exists(path):
+                os.remove(path)
+                purged = True
+        if purged:
+            print(f"purged {args.datadir}")
     kv = SqliteStore(args.datadir) if args.datadir else None
     if args.testnet_dir:
         # file-driven boot (--testnet-dir: config.yaml + genesis.ssz,
@@ -150,8 +172,12 @@ def cmd_bn(args):
     chain = BeaconChain(
         h.state.copy(), spec, kv=kv, backend=args.bls_backend
     )
-    srv = BeaconApiServer(chain, port=args.http_port).start()
-    print(f"HTTP API on 127.0.0.1:{srv.port}")
+    if args.slots_per_restore_point:
+        chain.store.slots_per_restore_point = args.slots_per_restore_point
+    srv = BeaconApiServer(
+        chain, host=args.http_address, port=args.http_port
+    ).start()
+    print(f"HTTP API on {args.http_address}:{srv.port}")
     try:
         if args.slots:
             for slot in range(1, args.slots + 1):
@@ -461,7 +487,19 @@ def build_parser():
     bn.add_argument("--validators", type=int, default=32)
     bn.add_argument("--slots", type=int, default=8)
     bn.add_argument("--http-port", type=int, default=0)
+    bn.add_argument("--http-address", default="127.0.0.1")
     bn.add_argument("--datadir", default=None)
+    bn.add_argument(
+        "--purge-db",
+        action="store_true",
+        help="delete the datadir before boot (fork-revert recovery)",
+    )
+    bn.add_argument(
+        "--slots-per-restore-point",
+        type=int,
+        default=0,
+        help="freezer restore-point interval (0 = spec default)",
+    )
     bn.add_argument("--bls-backend", default="ref")
     bn.add_argument("--serve-seconds", type=float, default=0)
     bn.add_argument(

@@ -304,55 +304,64 @@ def _decode_checkpoint_state(raw_state: bytes, spec):
 
 
 def _check_checkpoint_pair(state, block):
-    """A trusted checkpoint provider is still cross-checked: the block
-    must COMMIT to the state (state_root)."""
+    """A trusted checkpoint provider is still cross-checked: the STATE
+    must commit to the block through its latest_block_header (the
+    state_root direction would wrongly reject an epoch-boundary state
+    advanced over skipped slots, where state.slot > block.slot)."""
+    root = type(block.message).hash_tree_root(block.message)
+    if root != _anchor_block_root(state):
+        raise ApiClientError(
+            "checkpoint state does not commit to the checkpoint block"
+        )
+
+
+def _decode_and_check_block(raw_block: bytes, fork: str, state, spec):
+    """Block SSZ -> decoded block, cross-checked against the anchor
+    state — the shared back half of both checkpoint sources."""
+    from lighthouse_tpu.types.containers import types_for
+
+    try:
+        block = types_for(spec).signed_block_classes[fork].decode(
+            raw_block
+        )
+    except Exception as e:
+        raise ApiClientError(
+            f"could not decode checkpoint block: {e}"
+        ) from e
+    _check_checkpoint_pair(state, block)
+    return block
+
+
+def _anchor_block_root(state) -> bytes:
+    """The block root the state commits to: its latest_block_header
+    with the state_root filled in (zero inside a state that is the
+    header's own post-state)."""
     from lighthouse_tpu.ssz.cached_hash import cached_state_root
 
-    if bytes(block.message.state_root) != cached_state_root(state):
-        raise ApiClientError(
-            "checkpoint block does not commit to the checkpoint state"
-        )
+    header = state.latest_block_header.copy()
+    if bytes(header.state_root) == b"\x00" * 32:
+        header.state_root = cached_state_root(state)
+    return type(header).hash_tree_root(header)
 
 
 def decode_checkpoint_pair(raw_state: bytes, raw_block: bytes, spec):
     """SSZ bytes -> (state, block) for a weak-subjectivity anchor.
     Shared by --checkpoint-state files and --checkpoint-sync-url."""
-    from lighthouse_tpu.types.containers import types_for
-
     state, fork = _decode_checkpoint_state(raw_state, spec)
-    try:
-        block = types_for(spec).signed_block_classes[fork].decode(
-            raw_block
-        )
-    except Exception as e:
-        raise ApiClientError(
-            f"could not decode checkpoint block: {e}"
-        ) from e
-    _check_checkpoint_pair(state, block)
-    return state, block
+    return state, _decode_and_check_block(raw_block, fork, state, spec)
 
 
 def fetch_checkpoint(url: str, spec, timeout: float = 30.0):
     """The --checkpoint-sync-url flow (client/src/config.rs:31-34 +
     checkpoint-sync.md): pull the FINALIZED state from a trusted beacon
-    node, then the block AT THE STATE'S SLOT — two independent
-    "finalized" reads could straddle a finalization advance and return
-    a torn pair — cross-check, and return (state, block) ready for
-    BeaconChain.from_checkpoint."""
-    from lighthouse_tpu.types.containers import types_for
-
+    node, then the anchor block BY THE ROOT the state itself commits to
+    (latest_block_header) — robust against both skipped boundary slots
+    and a finalization advance between the two requests — cross-check,
+    and return (state, block) ready for BeaconChain.from_checkpoint."""
     client = BeaconNodeHttpClient(url, timeout=timeout)
     state, fork = _decode_checkpoint_state(
         client.get_debug_state_ssz("finalized"), spec
     )
-    raw_block = client.get_block_ssz(str(state.slot))
-    try:
-        block = types_for(spec).signed_block_classes[fork].decode(
-            raw_block
-        )
-    except Exception as e:
-        raise ApiClientError(
-            f"could not decode checkpoint block: {e}"
-        ) from e
-    _check_checkpoint_pair(state, block)
-    return state, block
+    root = _anchor_block_root(state)
+    raw_block = client.get_block_ssz("0x" + root.hex())
+    return state, _decode_and_check_block(raw_block, fork, state, spec)

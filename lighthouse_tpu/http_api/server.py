@@ -885,15 +885,17 @@ class BeaconApiServer:
             "direction": "outbound",
         }
 
-    def _checkpoint_root(self, which: str) -> bytes:
+    def _checkpoint_root(self, which: str) -> tuple:
+        """(root, epoch) for finalized|justified; epoch 0 maps the zero
+        root onto the chain's genesis/anchor root."""
         chain = self.chain
         cp = (
             chain.finalized_checkpoint
             if which == "finalized"
             else chain.head_state.current_justified_checkpoint
         )
-        root = bytes(cp.root)
-        return root if cp.epoch else chain.genesis_root
+        root = bytes(cp.root) if cp.epoch else chain.genesis_root
+        return root, cp.epoch
 
     def _resolve_state(self, state_id: str):
         """head | finalized | justified | slot — finalized/justified
@@ -906,14 +908,16 @@ class BeaconApiServer:
         if state_id == "head":
             return chain.head_state
         if state_id in ("justified", "finalized"):
-            cp = (
-                chain.finalized_checkpoint
-                if state_id == "finalized"
-                else chain.head_state.current_justified_checkpoint
-            )
-            if cp.epoch == 0:
-                return chain.head_state
-            block = chain.store.get_block(self._checkpoint_root(state_id))
+            root, epoch = self._checkpoint_root(state_id)
+            if epoch == 0:
+                # pre-finalization the checkpoint IS genesis; serving
+                # the live head here would hand checkpoint-sync clients
+                # a reorgable anchor
+                state = chain.store.state_at_slot(0)
+                if state is None:
+                    raise ApiError(404, "genesis state not found")
+                return state
+            block = chain.store.get_block(root)
             if block is None:
                 raise ApiError(404, f"{state_id} block not found")
             state = chain.store.state_at_slot(block.message.slot)
@@ -932,7 +936,7 @@ class BeaconApiServer:
         if block_id == "head":
             root = chain.head_root
         elif block_id in ("justified", "finalized"):
-            root = self._checkpoint_root(block_id)
+            root, _ = self._checkpoint_root(block_id)
         elif block_id.startswith("0x"):
             root = bytes.fromhex(block_id[2:])
         else:
