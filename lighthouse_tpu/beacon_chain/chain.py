@@ -229,11 +229,9 @@ class BeaconChain:
         return out
 
     def _header_root(self, state) -> bytes:
-        header = state.latest_block_header
-        if bytes(header.state_root) == ZERO_BYTES32:
-            header = header.copy()
-            header.state_root = cached_state_root(state)
-        return type(header).hash_tree_root(header)
+        from lighthouse_tpu.types.helpers import state_anchor_block_root
+
+        return state_anchor_block_root(state)
 
     def current_slot(self) -> int:
         if self.slot_clock is not None:

@@ -36,13 +36,20 @@ def _spec_for(name: str, altair_epoch=None):
     return spec
 
 
+def _apply_store_flags(chain, args) -> None:
+    """Store flags shared by every bn boot path (applied before any
+    migration can run; slots_per_restore_point is only read at
+    migrate/load time)."""
+    if args.slots_per_restore_point:
+        chain.store.slots_per_restore_point = args.slots_per_restore_point
+
+
 def _serve_api(chain, args, banner: str) -> int:
     """Start the HTTP API, print the banner, serve for --serve-seconds,
     stop — shared by every bn boot path."""
     from lighthouse_tpu.http_api import BeaconApiServer
 
-    if args.slots_per_restore_point:
-        chain.store.slots_per_restore_point = args.slots_per_restore_point
+    _apply_store_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
     ).start()
@@ -172,8 +179,7 @@ def cmd_bn(args):
     chain = BeaconChain(
         h.state.copy(), spec, kv=kv, backend=args.bls_backend
     )
-    if args.slots_per_restore_point:
-        chain.store.slots_per_restore_point = args.slots_per_restore_point
+    _apply_store_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
     ).start()

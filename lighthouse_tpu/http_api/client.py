@@ -317,31 +317,34 @@ def _check_checkpoint_pair(state, block):
 
 def _decode_and_check_block(raw_block: bytes, fork: str, state, spec):
     """Block SSZ -> decoded block, cross-checked against the anchor
-    state — the shared back half of both checkpoint sources."""
+    state — the shared back half of both checkpoint sources.
+
+    The block class is tried from the STATE's fork downward: an anchor
+    state at a fork-activation epoch reached over skipped slots commits
+    to a block from the PREVIOUS fork, and the root cross-check is
+    decisive on which decode was right."""
     from lighthouse_tpu.types.containers import types_for
 
-    try:
-        block = types_for(spec).signed_block_classes[fork].decode(
-            raw_block
-        )
-    except Exception as e:
-        raise ApiClientError(
-            f"could not decode checkpoint block: {e}"
-        ) from e
-    _check_checkpoint_pair(state, block)
-    return block
+    classes = types_for(spec).signed_block_classes
+    forks = list(classes)
+    candidates = forks[: forks.index(fork) + 1][::-1]
+    last_err = None
+    for f in candidates:
+        try:
+            block = classes[f].decode(raw_block)
+            _check_checkpoint_pair(state, block)
+            return block
+        except Exception as e:
+            last_err = e
+    raise ApiClientError(
+        f"could not decode checkpoint block: {last_err}"
+    )
 
 
 def _anchor_block_root(state) -> bytes:
-    """The block root the state commits to: its latest_block_header
-    with the state_root filled in (zero inside a state that is the
-    header's own post-state)."""
-    from lighthouse_tpu.ssz.cached_hash import cached_state_root
+    from lighthouse_tpu.types.helpers import state_anchor_block_root
 
-    header = state.latest_block_header.copy()
-    if bytes(header.state_root) == b"\x00" * 32:
-        header.state_root = cached_state_root(state)
-    return type(header).hash_tree_root(header)
+    return state_anchor_block_root(state)
 
 
 def decode_checkpoint_pair(raw_state: bytes, raw_block: bytes, spec):
@@ -362,6 +365,14 @@ def fetch_checkpoint(url: str, spec, timeout: float = 30.0):
     state, fork = _decode_checkpoint_state(
         client.get_debug_state_ssz("finalized"), spec
     )
+    if state.slot == 0:
+        # pre-finalization the provider serves genesis, which has no
+        # stored block object — and anchoring a new node on an
+        # unfinalized chain would be wrong anyway
+        raise ApiClientError(
+            "provider has not finalized yet; boot from genesis instead "
+            "of checkpoint sync"
+        )
     root = _anchor_block_root(state)
     raw_block = client.get_block_ssz("0x" + root.hex())
     return state, _decode_and_check_block(raw_block, fork, state, spec)

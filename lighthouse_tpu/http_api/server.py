@@ -901,9 +901,10 @@ class BeaconApiServer:
         """head | finalized | justified | slot — finalized/justified
         resolve to the CHECKPOINT block's post-state (what a
         checkpoint-sync client must receive). Before the first
-        finalization the checkpoint IS genesis, where no block object
-        exists — the head state (== the genesis-rooted chain state)
-        keeps those queries answerable."""
+        finalization the checkpoint IS genesis, so the GENESIS state is
+        served (the live head would hand checkpoint clients a
+        reorgable anchor); checkpoint-sync clients detect the slot-0
+        state and report that the provider has not finalized."""
         chain = self.chain
         if state_id == "head":
             return chain.head_state

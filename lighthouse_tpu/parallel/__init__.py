@@ -1,4 +1,5 @@
 from lighthouse_tpu.parallel.mesh import make_mesh  # noqa: F401
 from lighthouse_tpu.parallel.sharded_verify import (  # noqa: F401
     sharded_verify_signature_sets,
+    sharded_verify_signature_sets_grouped,
 )

@@ -73,6 +73,51 @@ def _butterfly_reduce(val, combine, axis_name, axis_size: int):
     return val
 
 
+def _reduce_points_over(mesh, ring, group, pt, axis_name):
+    """Point reduction over a mesh axis: butterfly when ring mode is on
+    and the axis is a power of two, all_gather+fold otherwise."""
+    n = mesh.shape[axis_name]
+    if ring and n & (n - 1) == 0:
+        return _butterfly_reduce(pt, group.add, axis_name, n)
+    return _gather_fold_points(group, pt, axis_name)
+
+
+def _finish_multi_pairing(
+    mesh, ring, local_sig, g1_pairs, g2_pairs, pair_mask,
+    reduce_axis="sets",
+):
+    """The shared batch-closing tail of every sharded verify: reduce the
+    G2 RLC signature sum over the mesh, run this shard's Miller loops,
+    fold the Fp12 products across devices, multiply in the SINGLE
+    signature pair (replicated), final exponentiation."""
+    sig_acc = _reduce_points_over(
+        mesh, ring, curve.PG2, local_sig, reduce_axis
+    )
+    s_x, s_y, s_inf = curve.PG2.to_affine(
+        jax.tree_util.tree_map(lambda t: t[None], sig_acc)
+    )
+
+    f_local = pairing.miller_loop(g1_pairs, g2_pairs, valid_mask=pair_mask)
+    prod_local = tower.fp12_product_axis(f_local, axis=0)
+
+    n_axis = mesh.shape[reduce_axis]
+    if ring and n_axis & (n_axis - 1) == 0:
+        prod = _butterfly_reduce(
+            prod_local, tower.fp12_mul, reduce_axis, n_axis
+        )
+    else:
+        gathered = jax.lax.all_gather(prod_local, reduce_axis)
+        prod = tower.fp12_product_axis(gathered, axis=0)
+
+    neg_g1 = (
+        jnp.asarray(batch_verify.NEG_G1_AFFINE[0])[None],
+        jnp.asarray(batch_verify.NEG_G1_AFFINE[1])[None],
+    )
+    f_sig = pairing.miller_loop(neg_g1, (s_x, s_y), valid_mask=~s_inf)
+    prod = tower.fp12_mul(prod, tower.fp12_product_axis(f_sig, axis=0))
+    return pairing.final_exp_is_one(prod)
+
+
 def sharded_verify_signature_sets(mesh, ring: bool = False):
     """Build the jitted multi-chip verify step for a given mesh.
 
@@ -97,59 +142,81 @@ def sharded_verify_signature_sets(mesh, ring: bool = False):
     )
     out_specs = P()
 
-    def _reduce_points(group, pt, axis_name):
-        n = mesh.shape[axis_name]
-        if ring and n & (n - 1) == 0:
-            return _butterfly_reduce(pt, group.add, axis_name, n)
-        return _gather_fold_points(group, pt, axis_name)
-
     def step(msgs, sigs, pubkeys, key_mask, rand_bits, set_mask):
         # ---- keys-axis: partial pubkey aggregation + reduction
         partial_pk = batch_verify.aggregate_pubkeys(pubkeys, key_mask)
-        agg_pk = _reduce_points(curve.PG1, partial_pk, "keys")
+        agg_pk = _reduce_points_over(
+            mesh, ring, curve.PG1, partial_pk, "keys"
+        )
 
         # ---- per-set RLC scale + affinize
         agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
         pk_x, pk_y, pk_inf = curve.PG1.to_affine(agg_pk_r)
 
-        # ---- sets-axis: global RLC-combined signature
+        # ---- sets-axis: global RLC-combined signature partial
         local_sig = batch_verify.rlc_combined_signature(
             sigs, rand_bits, set_mask
         )
-        sig_acc = _reduce_points(curve.PG2, local_sig, "sets")
-        s_x, s_y, s_inf = curve.PG2.to_affine(
-            jax.tree_util.tree_map(lambda t: t[None], sig_acc)
+        # Fp12 fold over "sets" only: every keys-row computed the same
+        # sets product, so the values are already identical along "keys"
+        return _finish_multi_pairing(
+            mesh, ring, local_sig,
+            (pk_x, pk_y), msgs, set_mask & ~pk_inf,
         )
 
-        # ---- local Miller loops over this shard's sets
-        pair_mask = set_mask & ~pk_inf
-        f_local = pairing.miller_loop(
-            (pk_x, pk_y), msgs, valid_mask=pair_mask
+    return jax.jit(_shard_map(step, mesh, in_specs, out_specs))
+
+
+def sharded_verify_signature_sets_grouped(mesh, ring: bool = False):
+    """Multi-chip MESSAGE-GROUPED verify: shard the GROUP axis over the
+    mesh's "sets" dimension — each device owns G/n whole groups
+    (their per-set ladders, the group MSM fold, and their Miller
+    loops are message-local, so no cross-device traffic until the
+    final reductions). Two collectives close the batch: the global
+    RLC signature sum (G2 point reduction) and the Fp12 pair-product
+    fold; ONE final exponentiation runs replicated.
+
+    Returns fn(group_msgs, sigs, pubkeys, key_mask, rand_bits,
+    set_mask, group_mask) -> bool with the (G, Sg[, K]) grid shapes of
+    ops.batch_verify.verify_signature_sets_grouped; the mesh's "sets"
+    axis size must divide G (each device takes G/n groups)."""
+    g_leaf = P("sets", None, None)              # (G, 2/1, NB) bundles
+    grid2 = P("sets", None, None, None)         # (G, Sg, 2, NB)
+    pk_leaf = P("sets", None, None, None, None)  # (G, Sg, K, 1, NB)
+
+    in_specs = (
+        (g_leaf, g_leaf),               # group msgs (x, y)
+        (grid2, grid2),                 # sigs
+        (pk_leaf, pk_leaf),             # pubkeys
+        P("sets", None, None),          # key_mask (G, Sg, K)
+        P("sets", None, None),          # rand_bits (G, Sg, 64)
+        P("sets", None),                # set_mask (G, Sg)
+        P("sets"),                      # group_mask (G,)
+    )
+    out_specs = P()
+
+    def step(
+        group_msgs, sigs, pubkeys, key_mask, rand_bits, set_mask,
+        group_mask,
+    ):
+        # ---- message-local: per-set aggregate + RLC + group fold
+        agg = curve.PG1.sum_axis(
+            curve.PG1.from_affine(pubkeys, key_mask), axis=2
         )
-        prod_local = tower.fp12_product_axis(f_local, axis=0)
+        agg_r = curve.PG1.mul_scalar_bits(agg, rand_bits)
+        grp_pk = curve.PG1.sum_axis(agg_r, axis=1)  # local (G/n,)
+        pk_x, pk_y, pk_inf = curve.PG1.to_affine(grp_pk)
 
-        # ---- fold per-shard products over BOTH axes (each keys-row computed
-        # the same sets product; gather over "sets" only, then dedupe "keys"
-        # by construction — every device already holds identical values along
-        # "keys", so gathering "sets" suffices).
-        n_sets_axis = mesh.shape["sets"]
-        if ring and n_sets_axis & (n_sets_axis - 1) == 0:
-            prod = _butterfly_reduce(
-                prod_local, tower.fp12_mul, "sets", n_sets_axis
-            )
-        else:
-            gathered = jax.lax.all_gather(prod_local, "sets")
-            prod = tower.fp12_product_axis(gathered, axis=0)
-
-        # ---- the single signature pair, multiplied in once (replicated)
-        neg_g1 = (
-            jnp.asarray(batch_verify.NEG_G1_AFFINE[0])[None],
-            jnp.asarray(batch_verify.NEG_G1_AFFINE[1])[None],
+        # ---- global RLC signature sum partial (both grid axes local)
+        sig_r = curve.PG2.mul_scalar_bits(
+            curve.PG2.from_affine(sigs, set_mask), rand_bits
         )
-        f_sig = pairing.miller_loop(neg_g1, (s_x, s_y), valid_mask=~s_inf)
-        prod = tower.fp12_mul(prod, tower.fp12_product_axis(f_sig, axis=0))
-
-        ok = pairing.final_exp_is_one(prod)
-        return ok
+        local_sig = curve.PG2.sum_axis(
+            curve.PG2.sum_axis(sig_r, axis=1), axis=0
+        )
+        return _finish_multi_pairing(
+            mesh, ring, local_sig,
+            (pk_x, pk_y), group_msgs, group_mask & ~pk_inf,
+        )
 
     return jax.jit(_shard_map(step, mesh, in_specs, out_specs))

@@ -38,3 +38,18 @@ def compute_domain(
 def compute_signing_root(object_root: bytes, domain: bytes) -> bytes:
     """hash_tree_root(SigningData): container of two bytes32 leaves."""
     return hash_concat(object_root, domain)
+
+
+def state_anchor_block_root(state) -> bytes:
+    """The block root a state commits to: its latest_block_header with
+    the state_root filled in (zero inside a state that is the header's
+    own post-state). Shared by the chain's genesis/anchor rooting and
+    the checkpoint-sync client's block lookup."""
+    from lighthouse_tpu.ssz.cached_hash import cached_state_root
+    from lighthouse_tpu.ssz.hashing import ZERO_BYTES32
+
+    header = state.latest_block_header
+    if bytes(header.state_root) == ZERO_BYTES32:
+        header = header.copy()
+        header.state_root = cached_state_root(state)
+    return type(header).hash_tree_root(header)
