@@ -29,6 +29,8 @@ from lighthouse_tpu.beacon_chain.observed import (
     ObservedSyncContributors,
 )
 from lighthouse_tpu.beacon_chain.operation_pool import OperationPool
+from lighthouse_tpu.common.metrics import RegistryBackedMetrics
+from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.fork_choice import ForkChoice
 from lighthouse_tpu.ssz.cached_hash import (
     cached_state_root,
@@ -140,11 +142,18 @@ class BeaconChain:
         self._justified_balances = [
             v.effective_balance for v in genesis_state.validators
         ]
-        self.metrics = {
-            "blocks_imported": 0,
-            "attestations_processed": 0,
-            "pre_advance_hits": 0,
-        }
+        # dict-compatible view mirrored onto lighthouse_tpu_chain_*
+        # registry gauges: chain internals, /metrics scrapes, and the
+        # remote monitoring snapshot all read the same numbers
+        self.metrics = RegistryBackedMetrics(
+            "lighthouse_tpu_chain_",
+            initial={
+                "blocks_imported": 0,
+                "attestations_processed": 0,
+                "pre_advance_hits": 0,
+                "head_slot": int(genesis_state.slot),
+            },
+        )
         # pre-slot state advance result: (head block root, advanced state)
         self._advanced = None
 
@@ -343,21 +352,24 @@ class BeaconChain:
 
         state = self._copy_state(parent_state)
         t0 = time.perf_counter()
-        state = process_slots(state, block.slot, spec)
+        with span("import/slots", slot=int(block.slot)):
+            state = process_slots(state, block.slot, spec)
         engine = _EngineAdapter(self.execution_layer)
         try:
-            per_block_processing(
-                state,
-                signed_block,
-                spec,
-                BlockSignatureStrategy.VERIFY_BULK,
-                self.pubkey_cache,
-                backend=self.backend,
-                execution_engine=engine,
-            )
+            with span("import/block_processing"):
+                per_block_processing(
+                    state,
+                    signed_block,
+                    spec,
+                    BlockSignatureStrategy.VERIFY_BULK,
+                    self.pubkey_cache,
+                    backend=self.backend,
+                    execution_engine=engine,
+                )
         except BlockProcessingError as e:
             raise BlockError(str(e)) from e
-        post_root = cached_state_root(state)
+        with span("import/state_root"):
+            post_root = cached_state_root(state)
         if bytes(block.state_root) != post_root:
             raise BlockError("state root mismatch")
         self.metrics["block_processing_seconds"] = (
@@ -372,21 +384,24 @@ class BeaconChain:
         )
 
         # store + fork choice
-        self.store.put_block(block_root, signed_block)
-        self.store.put_hot_state(state)
-        self.store.set_canonical_block_root(block.slot, block_root)
-        justified = self._fc_checkpoint(state.current_justified_checkpoint)
-        finalized = self._fc_checkpoint(state.finalized_checkpoint)
-        exec_status, exec_hash = self._execution_verdict(block, engine)
-        self.fork_choice.on_block(
-            block.slot,
-            block_root,
-            parent_root,
-            justified,
-            finalized,
-            execution_status=exec_status,
-            execution_block_hash=exec_hash,
-        )
+        with span("import/store_fork_choice"):
+            self.store.put_block(block_root, signed_block)
+            self.store.put_hot_state(state)
+            self.store.set_canonical_block_root(block.slot, block_root)
+            justified = self._fc_checkpoint(
+                state.current_justified_checkpoint
+            )
+            finalized = self._fc_checkpoint(state.finalized_checkpoint)
+            exec_status, exec_hash = self._execution_verdict(block, engine)
+            self.fork_choice.on_block(
+                block.slot,
+                block_root,
+                parent_root,
+                justified,
+                finalized,
+                execution_status=exec_status,
+                execution_block_hash=exec_hash,
+            )
 
         # register the block's attestations with fork choice + monitor
         indexed_atts = []
@@ -426,7 +441,8 @@ class BeaconChain:
             block, indexed_atts, spec
         )
         old_finalized = self.finalized_checkpoint.epoch
-        self.recompute_head()
+        with span("import/head_update"):
+            self.recompute_head()
         self.events.publish(
             "block",
             {"slot": int(block.slot), "root": "0x" + block_root.hex()},
@@ -622,6 +638,9 @@ class BeaconChain:
             )()
             self.head_root = root
             self.head_state = state
+            # the head moved without a recompute_head pass — keep the
+            # mirrored gauge (and remote telemetry) on the new head
+            self.metrics["head_slot"] = int(state.slot)
             self._cache_snapshot(root, state)
             return root
         raise BlockError("no pre-fork block available to revert to")
@@ -1111,6 +1130,7 @@ class BeaconChain:
             self.migrator.notify_finalized(
                 self.spec.epoch_start_slot(fin.epoch), fin.epoch
             )
+        self.metrics["head_slot"] = int(self.head_state.slot)
         return self.head_root
 
     @property

@@ -27,10 +27,31 @@ import secrets
 
 from lighthouse_tpu.bls import point_serde
 from lighthouse_tpu.bls.hash_to_curve import hash_to_g2
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.crypto import ref_pairing
 from lighthouse_tpu.crypto.constants import R
 from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
 from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
+
+_VERIFY_BATCHES = REGISTRY.counter_vec(
+    "lighthouse_tpu_verify_batches_total",
+    "verify_signature_sets batches by backend and verdict",
+    ("backend", "result"),
+)
+_VERIFY_SETS = REGISTRY.counter(
+    "lighthouse_tpu_verify_sets_total",
+    "signature sets entering verify_signature_sets",
+)
+_VERIFY_BATCH_SECONDS = REGISTRY.histogram(
+    "lighthouse_tpu_verify_batch_seconds",
+    "end-to-end wall time of one verify_signature_sets batch",
+)
+_VERIFY_BATCH_SIZE = REGISTRY.histogram(
+    "lighthouse_tpu_verify_batch_size",
+    "signature sets per verify_signature_sets batch",
+    buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384, 65536),
+)
 
 INFINITY_PUBKEY_BYTES = bytes([0xC0]) + b"\x00" * 47
 INFINITY_SIGNATURE_BYTES = bytes([0xC0]) + b"\x00" * 95
@@ -213,16 +234,35 @@ class SignatureSet:
 
 
 def _verify_one_ref(sset: SignatureSet) -> bool:
-    if sset.signature.is_infinity() or not sset.signature.in_subgroup():
+    """Single-set ground-truth check, staged under the tracer: each
+    pipeline phase (subgroup check, aggregation, hash-to-curve, affine
+    conversion, Miller loop, final exponentiation) is its own leaf span
+    so `lighthouse_tpu_verify_stage_seconds{stage=...}` attributes the
+    wall time stage-by-stage."""
+    with span("verify/subgroup_check"):
+        bad = (
+            sset.signature.is_infinity()
+            or not sset.signature.in_subgroup()
+        )
+    if bad:
         return False
-    agg_pk = G1_GROUP.infinity
-    for p in sset.pubkeys:
-        agg_pk = G1_GROUP.add(agg_pk, p.point)
-    h = hash_to_g2(sset.message)
-    return ref_pairing.pairing_check_points(
-        [agg_pk, G1_GROUP.neg(G1_GROUP.generator)],
-        [h, sset.signature.point],
-    )
+    with span("verify/pubkey_aggregation", n_keys=len(sset.pubkeys)):
+        agg_pk = G1_GROUP.infinity
+        for p in sset.pubkeys:
+            agg_pk = G1_GROUP.add(agg_pk, p.point)
+    with span("verify/hash_to_curve"):
+        h = hash_to_g2(sset.message)
+    with span("verify/to_affine"):
+        pairs = [
+            (G1_GROUP.to_affine(p), G2_GROUP.to_affine(q))
+            for p, q in (
+                (agg_pk, h),
+                (G1_GROUP.neg(G1_GROUP.generator), sset.signature.point),
+            )
+        ]
+    # multi_pairing_is_one carries the verify/miller_loop and
+    # verify/final_exp stage spans itself
+    return ref_pairing.multi_pairing_is_one(pairs)
 
 
 def verify(pk: PublicKey, message: bytes, sig: Signature) -> bool:
@@ -271,15 +311,25 @@ def verify_signature_sets(
     if not sets:
         return False
     backend = backend or _DEFAULT_BACKEND
-    if backend == "fake":
-        return True
-    if backend == "ref":
-        return all(_verify_one_ref(s) for s in sets)
-    if backend == "tpu":
-        from lighthouse_tpu.bls.tpu_backend import verify_signature_sets_tpu
+    _VERIFY_SETS.inc(len(sets))
+    _VERIFY_BATCH_SIZE.observe(len(sets))
+    with _VERIFY_BATCH_SECONDS.time(), span(
+        "verify", n_sets=len(sets), backend=backend
+    ):
+        if backend == "fake":
+            result = True
+        elif backend == "ref":
+            result = all(_verify_one_ref(s) for s in sets)
+        elif backend == "tpu":
+            from lighthouse_tpu.bls.tpu_backend import (
+                verify_signature_sets_tpu,
+            )
 
-        return verify_signature_sets_tpu(sets, seed=seed)
-    raise BlsError(f"unknown BLS backend {backend!r}")
+            result = verify_signature_sets_tpu(sets, seed=seed)
+        else:
+            raise BlsError(f"unknown BLS backend {backend!r}")
+    _VERIFY_BATCHES.labels(backend, "ok" if result else "fail").inc()
+    return result
 
 
 def verify_signature_set_batches(

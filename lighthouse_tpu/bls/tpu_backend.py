@@ -23,6 +23,7 @@ blst.rs:72-81.
 """
 
 import secrets
+import threading
 import time
 
 import numpy as np
@@ -30,9 +31,66 @@ import numpy as np
 import jax
 
 from lighthouse_tpu.bls.hash_to_curve import hash_to_g2
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
 from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
 from lighthouse_tpu.ops import batch_verify, curve, fieldb as fb, fp2
+
+# jit-compilation observability: "wrapper" events track the python-side
+# impl-keyed cache (a miss means a NEW jax.jit object); "xla" events
+# track the jitted object's own trace cache (a retrace means a new
+# (shape-bucket, dtype) class compiled — the cost bucketed padding
+# exists to bound)
+_JIT_EVENTS = REGISTRY.counter_vec(
+    "lighthouse_tpu_jit_cache_events_total",
+    "jit cache hits vs (re)traces per jitted verify entry point",
+    ("fn", "layer", "event"),
+)
+_MSG_CACHE_EVENTS = REGISTRY.counter_vec(
+    "lighthouse_tpu_msg_cache_events_total",
+    "hash_to_g2 memo hits vs misses during batch marshalling",
+    ("event",),
+)
+_MARSHAL_SECONDS = REGISTRY.histogram_vec(
+    "lighthouse_tpu_marshal_seconds",
+    "host marshalling wall time per phase (points / pack)",
+    ("phase",),
+)
+
+# last observed XLA trace-cache size per (entry point, jit object):
+# impl-key flips build NEW jax.jit objects whose caches start empty, so
+# the delta must not be computed against the old object's size (the jit
+# objects live forever in the _jitted* caches, so id() cannot be reused)
+_XLA_CACHE_SIZES: dict = {}
+_XLA_CACHE_LOCK = threading.Lock()
+
+
+def _note_wrapper_event(fn_name: str, hit: bool):
+    _JIT_EVENTS.labels(fn_name, "wrapper", "hit" if hit else "trace").inc()
+
+
+def _note_xla_events(fn_name: str, jitted):
+    """Compare the jitted object's trace-cache size against the last
+    observation: growth means this dispatch retraced (new shape class),
+    otherwise it hit a compiled program. The size dict is read-modify-
+    write under a lock — concurrent worker dispatches must not count
+    one compile as two retraces. Version-tolerant — older jax without
+    _cache_size just skips the xla layer."""
+    try:
+        size = jitted._cache_size()
+    except Exception:
+        return
+    key = (fn_name, id(jitted))
+    with _XLA_CACHE_LOCK:
+        prev = _XLA_CACHE_SIZES.get(key, 0)
+        grew = size - prev
+        if grew > 0:
+            _XLA_CACHE_SIZES[key] = size
+    if grew > 0:
+        _JIT_EVENTS.labels(fn_name, "xla", "retrace").inc(grew)
+    else:
+        _JIT_EVENTS.labels(fn_name, "xla", "hit").inc()
 
 # jit caches keyed by the full impl choice — the LIGHTHOUSE_TPU_IMPL
 # selection AND the MXU knobs (MXU_REDC/MXU_CONV) that fieldb reads at
@@ -107,6 +165,7 @@ def _get_fn():
     into the first trace."""
     key = _impl_key()
     fn = _jitted.get(key)
+    _note_wrapper_event("verify", fn is not None)
     if fn is None:
         fn = _jitted[key] = jax.jit(_verify_impl(key[0]))
     return fn
@@ -154,6 +213,7 @@ def _get_grouped_fns():
 
     key = _impl_key()
     pair = _jitted_grouped.get(key)
+    _note_wrapper_event("verify_grouped", pair is not None)
     if pair is None:
         pair = _jitted_grouped[key] = (
             jax.jit(_grouped_impl(key[0])),
@@ -167,6 +227,7 @@ def _get_indexed_fn():
 
     key = _impl_key()
     fn = _jitted_indexed.get(key)
+    _note_wrapper_event("verify_indexed", fn is not None)
     if fn is None:
         fn = _jitted_indexed[key] = jax.jit(
             functools.partial(_indexed_verify, key[0])
@@ -193,10 +254,13 @@ def _msg_affine(message: bytes):
     message = bytes(message)
     hit = _MSG_CACHE.get(message)
     if hit is None:
+        _MSG_CACHE_EVENTS.labels("miss").inc()
         hit = G2_GROUP.to_affine(hash_to_g2(message))
         if len(_MSG_CACHE) >= _MSG_CACHE_MAX:
             _MSG_CACHE.clear()
         _MSG_CACHE[message] = hit
+    else:
+        _MSG_CACHE_EVENTS.labels("hit").inc()
     return hit
 
 
@@ -350,71 +414,75 @@ def _marshal_grouped(sets, groups) -> _Marshalled:
     m.s_bucket = g_b * sg_b
     m.k_bucket = _bucket(max(len(s.pubkeys) for s in sets), 1)
 
-    group_msgs = [_msg_affine(sets[ix[0]].message) for _, ix in groups]
-    group_msgs += [None] * (g_b - G)
-    m.group_mask = np.array(
-        [True] * G + [False] * (g_b - G), dtype=bool
-    )
+    with span("verify/marshal/points"):
+        group_msgs = [_msg_affine(sets[ix[0]].message) for _, ix in groups]
+        group_msgs += [None] * (g_b - G)
+        m.group_mask = np.array(
+            [True] * G + [False] * (g_b - G), dtype=bool
+        )
 
-    # lane order: group-major, each group padded to sg_b
-    order: list = []
-    for _, ix in groups:
-        order += list(ix) + [None] * (sg_b - len(ix))
-    order += [None] * ((g_b - G) * sg_b)
+        # lane order: group-major, each group padded to sg_b
+        order: list = []
+        for _, ix in groups:
+            order += list(ix) + [None] * (sg_b - len(ix))
+        order += [None] * ((g_b - G) * sg_b)
 
-    sig_aff = batch_to_affine_g2([s.signature.point for s in sets])
-    sigs = [None if i is None else sig_aff[i] for i in order]
+        sig_aff = batch_to_affine_g2([s.signature.point for s in sets])
+        sigs = [None if i is None else sig_aff[i] for i in order]
     t1 = time.perf_counter()
 
-    m.set_mask = np.array(
-        [i is not None for i in order], dtype=bool
-    ).reshape(g_b, sg_b)
-    m.key_mask = np.array(
-        [
-            [False] * m.k_bucket
-            if i is None
-            else [True] * len(sets[i].pubkeys)
-            + [False] * (m.k_bucket - len(sets[i].pubkeys))
-            for i in order
-        ],
-        dtype=bool,
-    ).reshape(g_b, sg_b, m.k_bucket)
-
-    m.table = _table_for(sets)
-    if m.table is not None:
-        indices = np.full((len(order), m.k_bucket), -1, dtype=np.int32)
-        for lane, i in enumerate(order):
-            if i is None:
-                continue
-            for k, p in enumerate(sets[i].pubkeys):
-                indices[lane, k] = p.validator_index
-        m.indices = m.table.gather_indices(indices).reshape(
-            g_b, sg_b, m.k_bucket
-        )
-        m.pubkeys = None
-    else:
-        pk_rows = []
-        for i in order:
-            row = (
-                []
+    with span("verify/marshal/pack"):
+        m.set_mask = np.array(
+            [i is not None for i in order], dtype=bool
+        ).reshape(g_b, sg_b)
+        m.key_mask = np.array(
+            [
+                [False] * m.k_bucket
                 if i is None
-                else [G1_GROUP.to_affine(p.point) for p in sets[i].pubkeys]
+                else [True] * len(sets[i].pubkeys)
+                + [False] * (m.k_bucket - len(sets[i].pubkeys))
+                for i in order
+            ],
+            dtype=bool,
+        ).reshape(g_b, sg_b, m.k_bucket)
+
+        m.table = _table_for(sets)
+        if m.table is not None:
+            indices = np.full((len(order), m.k_bucket), -1, dtype=np.int32)
+            for lane, i in enumerate(order):
+                if i is None:
+                    continue
+                for k, p in enumerate(sets[i].pubkeys):
+                    indices[lane, k] = p.validator_index
+            m.indices = m.table.gather_indices(indices).reshape(
+                g_b, sg_b, m.k_bucket
             )
-            pk_rows.append(row + [None] * (m.k_bucket - len(row)))
-        pk_flat = [p for row in pk_rows for p in row]
-        pk_x, pk_y = _pack_g1_affine(pk_flat)
-        m.indices = None
-        m.pubkeys = (
-            np.asarray(pk_x).reshape(g_b, sg_b, m.k_bucket, 1, fb.NB),
-            np.asarray(pk_y).reshape(g_b, sg_b, m.k_bucket, 1, fb.NB),
+            m.pubkeys = None
+        else:
+            pk_rows = []
+            for i in order:
+                row = (
+                    []
+                    if i is None
+                    else [G1_GROUP.to_affine(p.point) for p in sets[i].pubkeys]
+                )
+                pk_rows.append(row + [None] * (m.k_bucket - len(row)))
+            pk_flat = [p for row in pk_rows for p in row]
+            pk_x, pk_y = _pack_g1_affine(pk_flat)
+            m.indices = None
+            m.pubkeys = (
+                np.asarray(pk_x).reshape(g_b, sg_b, m.k_bucket, 1, fb.NB),
+                np.asarray(pk_y).reshape(g_b, sg_b, m.k_bucket, 1, fb.NB),
+            )
+        m.msgs = _pack_g2_affine(group_msgs)
+        m.sigs = tuple(
+            np.asarray(c).reshape(g_b, sg_b, 2, fb.NB)
+            for c in _pack_g2_affine(sigs)
         )
-    m.msgs = _pack_g2_affine(group_msgs)
-    m.sigs = tuple(
-        np.asarray(c).reshape(g_b, sg_b, 2, fb.NB)
-        for c in _pack_g2_affine(sigs)
-    )
     t2 = time.perf_counter()
     m.timings = {"points_ms": (t1 - t0) * 1e3, "pack_ms": (t2 - t1) * 1e3}
+    _MARSHAL_SECONDS.labels("points").observe(t1 - t0)
+    _MARSHAL_SECONDS.labels("pack").observe(t2 - t1)
     return m
 
 
@@ -429,51 +497,55 @@ def _marshal_flat(sets) -> _Marshalled:
     m.s_bucket = _bucket(n_sets, 4)
     m.k_bucket = _bucket(max_keys, 1)
 
-    msgs = [_msg_affine(s.message) for s in sets]
-    sigs = batch_to_affine_g2([s.signature.point for s in sets])
-    msgs += [None] * (m.s_bucket - n_sets)
-    sigs += [None] * (m.s_bucket - n_sets)
+    with span("verify/marshal/points"):
+        msgs = [_msg_affine(s.message) for s in sets]
+        sigs = batch_to_affine_g2([s.signature.point for s in sets])
+        msgs += [None] * (m.s_bucket - n_sets)
+        sigs += [None] * (m.s_bucket - n_sets)
     t1 = time.perf_counter()
 
-    m.set_mask = np.array(
-        [True] * n_sets + [False] * (m.s_bucket - n_sets), dtype=bool
-    )
-    m.key_mask = np.array(
-        [
-            [True] * len(s.pubkeys)
-            + [False] * (m.k_bucket - len(s.pubkeys))
-            for s in sets
-        ]
-        + [[False] * m.k_bucket] * (m.s_bucket - n_sets),
-        dtype=bool,
-    )
-
-    m.table = _table_for(sets)
-    if m.table is not None:
-        indices = np.full((m.s_bucket, m.k_bucket), -1, dtype=np.int32)
-        for i, s in enumerate(sets):
-            for k, p in enumerate(s.pubkeys):
-                indices[i, k] = p.validator_index
-        m.indices = m.table.gather_indices(indices)
-        m.pubkeys = None
-    else:
-        # untagged pubkeys: legacy per-point packing
-        pk_rows = []
-        for s in sets:
-            row = [G1_GROUP.to_affine(p.point) for p in s.pubkeys]
-            pk_rows.append(row + [None] * (m.k_bucket - len(row)))
-        pk_rows += [[None] * m.k_bucket] * (m.s_bucket - n_sets)
-        pk_flat = [p for row in pk_rows for p in row]
-        pk_x, pk_y = _pack_g1_affine(pk_flat)
-        m.indices = None
-        m.pubkeys = (
-            np.asarray(pk_x).reshape(m.s_bucket, m.k_bucket, 1, fb.NB),
-            np.asarray(pk_y).reshape(m.s_bucket, m.k_bucket, 1, fb.NB),
+    with span("verify/marshal/pack"):
+        m.set_mask = np.array(
+            [True] * n_sets + [False] * (m.s_bucket - n_sets), dtype=bool
         )
-    m.msgs = _pack_g2_affine(msgs)
-    m.sigs = _pack_g2_affine(sigs)
+        m.key_mask = np.array(
+            [
+                [True] * len(s.pubkeys)
+                + [False] * (m.k_bucket - len(s.pubkeys))
+                for s in sets
+            ]
+            + [[False] * m.k_bucket] * (m.s_bucket - n_sets),
+            dtype=bool,
+        )
+
+        m.table = _table_for(sets)
+        if m.table is not None:
+            indices = np.full((m.s_bucket, m.k_bucket), -1, dtype=np.int32)
+            for i, s in enumerate(sets):
+                for k, p in enumerate(s.pubkeys):
+                    indices[i, k] = p.validator_index
+            m.indices = m.table.gather_indices(indices)
+            m.pubkeys = None
+        else:
+            # untagged pubkeys: legacy per-point packing
+            pk_rows = []
+            for s in sets:
+                row = [G1_GROUP.to_affine(p.point) for p in s.pubkeys]
+                pk_rows.append(row + [None] * (m.k_bucket - len(row)))
+            pk_rows += [[None] * m.k_bucket] * (m.s_bucket - n_sets)
+            pk_flat = [p for row in pk_rows for p in row]
+            pk_x, pk_y = _pack_g1_affine(pk_flat)
+            m.indices = None
+            m.pubkeys = (
+                np.asarray(pk_x).reshape(m.s_bucket, m.k_bucket, 1, fb.NB),
+                np.asarray(pk_y).reshape(m.s_bucket, m.k_bucket, 1, fb.NB),
+            )
+        m.msgs = _pack_g2_affine(msgs)
+        m.sigs = _pack_g2_affine(sigs)
     t2 = time.perf_counter()
     m.timings = {"points_ms": (t1 - t0) * 1e3, "pack_ms": (t2 - t1) * 1e3}
+    _MARSHAL_SECONDS.labels("points").observe(t1 - t0)
+    _MARSHAL_SECONDS.labels("pack").observe(t2 - t1)
     return m
 
 
@@ -497,18 +569,30 @@ def _record_stats(n_sets, m, t_start, t_subgroup, t_marshal, t_end):
 def verify_signature_sets_tpu(sets, seed: int | None = None) -> bool:
     t_start = time.perf_counter()
     # host-side policy checks (exact reference semantics)
-    for s in sets:
-        if s.signature.is_infinity() or not s.signature.in_subgroup():
-            return False
+    with span("verify/subgroup_check", n_sets=len(sets)):
+        ok = all(
+            not s.signature.is_infinity() and s.signature.in_subgroup()
+            for s in sets
+        )
+    if not ok:
+        return False
     t_subgroup = time.perf_counter()
 
-    m = _marshal(sets)
-    rand_bits = curve.scalars_to_bits(
-        _rlc_scalars(m.s_bucket, seed), batch_verify.RAND_BITS
-    )
+    with span("verify/marshal", n_sets=len(sets)):
+        m = _marshal(sets)
+    with span("verify/rlc_sample"):
+        rand_bits = curve.scalars_to_bits(
+            _rlc_scalars(m.s_bucket, seed), batch_verify.RAND_BITS
+        )
     t_marshal = time.perf_counter()
 
-    result = bool(np.asarray(_dispatch(m, rand_bits)))
+    with span(
+        "verify/device",
+        s_bucket=m.s_bucket,
+        grouped=bool(m.grouped),
+        indexed=m.table is not None,
+    ):
+        result = bool(np.asarray(_dispatch(m, rand_bits)))
     _record_stats(
         len(sets), m, t_start, t_subgroup, t_marshal, time.perf_counter()
     )
@@ -532,23 +616,33 @@ def _dispatch(m, rand_bits):
         plain, indexed = _get_grouped_fns()
         if m.table is not None:
             tx, ty = m.table.rows()
-            return indexed(
+            out = indexed(
                 m.msgs, m.sigs, tx, ty, m.indices, m.key_mask,
                 rand_bits, m.set_mask, m.group_mask,
             )
-        return plain(
-            m.msgs, m.sigs, m.pubkeys, m.key_mask, rand_bits,
-            m.set_mask, m.group_mask,
-        )
+            _note_xla_events("verify_grouped_indexed", indexed)
+        else:
+            out = plain(
+                m.msgs, m.sigs, m.pubkeys, m.key_mask, rand_bits,
+                m.set_mask, m.group_mask,
+            )
+            _note_xla_events("verify_grouped", plain)
+        return out
     if m.table is not None:
         tx, ty = m.table.rows()
-        return _get_indexed_fn()(
+        fn = _get_indexed_fn()
+        out = fn(
             m.msgs, m.sigs, tx, ty, m.indices, m.key_mask, rand_bits,
             m.set_mask,
         )
-    return _get_fn()(
+        _note_xla_events("verify_indexed", fn)
+        return out
+    fn = _get_fn()
+    out = fn(
         m.msgs, m.sigs, m.pubkeys, m.key_mask, rand_bits, m.set_mask
     )
+    _note_xla_events("verify", fn)
+    return out
 
 
 def verify_signature_set_batches_tpu(batches, seed=None) -> list:
@@ -626,6 +720,7 @@ _jitted_individual_indexed = None
 
 def _get_individual_fns():
     global _jitted_individual, _jitted_individual_indexed
+    _note_wrapper_event("verify_individual", _jitted_individual is not None)
     if _jitted_individual is None:
         _jitted_individual = jax.jit(
             batch_verify.verify_signature_sets_individual
@@ -641,29 +736,36 @@ def verify_signature_sets_tpu_individual(sets) -> list:
     t_start = time.perf_counter()
     verdicts = [True] * len(sets)
     live = []
-    for i, s in enumerate(sets):
-        if s.signature.is_infinity() or not s.signature.in_subgroup():
-            verdicts[i] = False
-        else:
-            live.append(i)
+    with span("verify/subgroup_check", n_sets=len(sets)):
+        for i, s in enumerate(sets):
+            if s.signature.is_infinity() or not s.signature.in_subgroup():
+                verdicts[i] = False
+            else:
+                live.append(i)
     if not live:
         return verdicts
     t_subgroup = time.perf_counter()
 
     subset = [sets[i] for i in live]
-    m = _marshal(subset, allow_grouped=False)  # per-set pairs needed
+    with span("verify/marshal", n_sets=len(subset)):
+        m = _marshal(subset, allow_grouped=False)  # per-set pairs needed
     t_marshal = time.perf_counter()
 
     plain_fn, indexed_fn = _get_individual_fns()
     CALL_COUNTS["individual"] += 1
-    if m.table is not None:
-        tx, ty = m.table.rows()
-        ok = indexed_fn(
-            m.msgs, m.sigs, tx, ty, m.indices, m.key_mask, m.set_mask
-        )
-    else:
-        ok = plain_fn(m.msgs, m.sigs, m.pubkeys, m.key_mask, m.set_mask)
-    ok = np.asarray(ok)
+    with span("verify/device", s_bucket=m.s_bucket, individual=True):
+        if m.table is not None:
+            tx, ty = m.table.rows()
+            ok = indexed_fn(
+                m.msgs, m.sigs, tx, ty, m.indices, m.key_mask, m.set_mask
+            )
+            _note_xla_events("verify_individual_indexed", indexed_fn)
+        else:
+            ok = plain_fn(
+                m.msgs, m.sigs, m.pubkeys, m.key_mask, m.set_mask
+            )
+            _note_xla_events("verify_individual", plain_fn)
+        ok = np.asarray(ok)
     for j, i in enumerate(live):
         verdicts[i] = bool(ok[j])
     _record_stats(

@@ -44,6 +44,25 @@ def _apply_store_flags(chain, args) -> None:
         chain.store.slots_per_restore_point = args.slots_per_restore_point
 
 
+def _apply_trace_flags(args) -> None:
+    """Size (or disable, with 0) the data-plane span tracer before any
+    chain work runs."""
+    from lighthouse_tpu.common import tracing
+
+    capacity = getattr(args, "trace_buffer", tracing.DEFAULT_CAPACITY)
+    tracing.configure(enabled=capacity > 0, capacity=max(capacity, 1))
+
+
+def _export_trace(args) -> None:
+    """Dump the buffered span trees as JSONL on shutdown when asked."""
+    path = getattr(args, "trace_jsonl", None)
+    if path:
+        from lighthouse_tpu.common.tracing import TRACER
+
+        n = TRACER.export_jsonl(path)
+        print(f"wrote {n} span trees to {path}")
+
+
 def _serve_api(chain, args, banner: str) -> int:
     """Start the HTTP API, print the banner, serve for --serve-seconds,
     stop — shared by every bn boot path."""
@@ -59,6 +78,7 @@ def _serve_api(chain, args, banner: str) -> int:
             time.sleep(args.serve_seconds)
     finally:
         srv.stop()
+        _export_trace(args)
     return 0
 
 
@@ -72,6 +92,7 @@ def cmd_bn(args):
     from lighthouse_tpu.http_api import BeaconApiServer
     from lighthouse_tpu.store import SqliteStore
 
+    _apply_trace_flags(args)
     if args.purge_db and args.datadir:
         # fork_revert.rs:14-15 guidance: a node stuck on the wrong side
         # of a fork starts over. The SQLite WAL/SHM sidecars must go
@@ -203,6 +224,7 @@ def cmd_bn(args):
                 time.sleep(spec.SECONDS_PER_SLOT)
     finally:
         srv.stop()
+        _export_trace(args)
     return 0
 
 
@@ -528,6 +550,20 @@ def build_parser():
         default=None,
         help="trusted beacon node URL to fetch the finalized "
         "state/block from (weak-subjectivity boot)",
+    )
+    bn.add_argument(
+        "--trace-buffer",
+        type=int,
+        default=256,
+        help="span-tracer ring capacity in root spans, served at GET "
+        "/lighthouse/spans (0 disables span-tree buffering; the "
+        "*_stage_seconds histograms keep recording)",
+    )
+    bn.add_argument(
+        "--trace-jsonl",
+        default=None,
+        help="write the buffered span trees to this JSONL file on "
+        "shutdown (bench attribution input)",
     )
     bn.set_defaults(fn=cmd_bn)
 

@@ -60,11 +60,21 @@ class MonitoringService:
         }
         base.update(collect_process_metrics())
         if self.chain is not None:
-            base["sync_beacon_head_slot"] = getattr(
-                self.chain.head_state, "slot", 0
+            # beacon-node fields come from the chain's metrics mapping —
+            # a RegistryBackedMetrics view mirrored onto the same
+            # lighthouse_tpu_chain_* gauges the /metrics scrape serves,
+            # so telemetry and scrape cannot diverge (reading THIS
+            # chain's view rather than the global gauge keeps multi-
+            # chain processes honest); head-state attribute is only the
+            # pre-first-write fallback
+            base["sync_beacon_head_slot"] = int(
+                self.chain.metrics.get(
+                    "head_slot",
+                    getattr(self.chain.head_state, "slot", 0),
+                )
             )
-            base["slasher_attestations"] = self.chain.metrics.get(
-                "attestations_processed", 0
+            base["slasher_attestations"] = int(
+                self.chain.metrics.get("attestations_processed", 0)
             )
         return [base]
 

@@ -10,6 +10,15 @@ import threading
 
 from lighthouse_tpu.common.metrics import REGISTRY
 
+# one labeled family for every executor instance (the per-executor
+# f-string gauges it replaces could not satisfy the one-name-one-
+# registration rule scripts/check_metric_names.py enforces)
+_TASKS_RUNNING = REGISTRY.gauge_vec(
+    "lighthouse_tpu_executor_tasks_running",
+    "live executor tasks",
+    ("executor",),
+)
+
 
 class ShutdownReason(enum.Enum):
     SUCCESS = "success"
@@ -23,9 +32,7 @@ class TaskExecutor:
         self._shutdown = threading.Event()
         self._reason: ShutdownReason | None = None
         self._reason_msg = ""
-        self._gauge = REGISTRY.gauge(
-            f"{name}_tasks_running", "live executor tasks"
-        )
+        self._gauge = _TASKS_RUNNING.labels(name)
 
     @property
     def shutdown_requested(self) -> bool:
@@ -44,13 +51,13 @@ class TaskExecutor:
         """Run fn(stop_event) on a tracked daemon thread."""
 
         def runner():
-            self._gauge.set(self._gauge.value + 1)
+            self._gauge.inc()
             try:
                 fn(self._shutdown)
             except Exception as e:
                 self.shutdown(ShutdownReason.FAILURE, f"{name}: {e}")
             finally:
-                self._gauge.set(self._gauge.value - 1)
+                self._gauge.dec()
 
         th = threading.Thread(target=runner, name=name, daemon=True)
         th.start()

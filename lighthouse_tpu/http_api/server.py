@@ -41,12 +41,58 @@ Endpoints (the operative subset):
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.tracing import TRACER
 from lighthouse_tpu.http_api.json_codec import from_json, to_json
 
 VERSION = "lighthouse-tpu/0.1.0"
+
+_HTTP_SECONDS = REGISTRY.histogram_vec(
+    "lighthouse_tpu_http_request_seconds",
+    "REST API request latency by method and endpoint template",
+    ("method", "endpoint"),
+)
+_CACHE_STATS = REGISTRY.gauge_vec(
+    "lighthouse_tpu_attestation_cache_stat",
+    "attestation-production cache statistics",
+    ("cache", "stat"),
+)
+
+
+# the route vocabulary: any path segment outside it becomes {id}, so
+# the latency family's cardinality is bounded by real routes no matter
+# what a scanner throws at the port
+_ROUTE_SEGMENTS = frozenset(
+    """
+    eth lighthouse v1 v2 metrics spans health tpu stats node beacon
+    config validator debug events genesis states headers blocks blinded
+    pool duties liveness register_validator blinded_blocks
+    aggregate_and_proofs contribution_and_proofs aggregate_attestation
+    attestation_data sync_committee_contribution
+    beacon_committee_subscriptions attestations sync_committees
+    voluntary_exits proposer_slashings attester_slashings committees
+    validators validator_balances finality_checkpoints fork
+    fork_schedule spec deposit_contract root attester proposer sync
+    identity peers peer_count syncing version heads fork_choice
+    head finalized justified genesis_state
+    """.split()
+)
+
+
+def _endpoint_label(path: str) -> str:
+    """Collapse everything outside the route vocabulary (slots, roots,
+    hex blobs, scanner garbage) to {id} so the latency family stays
+    low-cardinality; named route words (head, finalized, ...) stay
+    literal."""
+    parts = [p for p in path.split("?")[0].split("/") if p]
+    out = [
+        p if p in _ROUTE_SEGMENTS else "{id}"
+        for p in parts[:6]
+    ]
+    return "/" + "/".join(out)
 
 
 class ApiError(Exception):
@@ -105,7 +151,10 @@ class BeaconApiServer:
 
             def do_GET(self):
                 if self.path.split("?")[0] == "/eth/v1/events":
+                    # SSE streams stay open for minutes — excluded from
+                    # the request-latency histogram by design
                     return self._serve_events()
+                t0 = time.perf_counter()
                 try:
                     # self.headers is an HTTPMessage: case-insensitive
                     # get(), as header lookup must be
@@ -120,6 +169,10 @@ class BeaconApiServer:
                     )
                 except Exception as e:  # pragma: no cover
                     self._send(500, {"code": 500, "message": str(e)})
+                finally:
+                    _HTTP_SECONDS.labels(
+                        "GET", _endpoint_label(self.path)
+                    ).observe(time.perf_counter() - t0)
 
             def _serve_events(self):
                 """Server-sent events stream (/eth/v1/events?topics=…,
@@ -184,6 +237,7 @@ class BeaconApiServer:
                     api.chain.events.unsubscribe(sub)
 
             def do_POST(self):
+                t0 = time.perf_counter()
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(length)
@@ -195,6 +249,10 @@ class BeaconApiServer:
                     )
                 except Exception as e:
                     self._send(400, {"code": 400, "message": str(e)})
+                finally:
+                    _HTTP_SECONDS.labels(
+                        "POST", _endpoint_label(self.path)
+                    ).observe(time.perf_counter() - t0)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port
@@ -207,18 +265,15 @@ class BeaconApiServer:
         parts = [p for p in path.split("?")[0].split("/") if p]
         if path == "/metrics":
             # refresh the attestation-cache gauges at scrape time
-            for name, value in (
-                ("attester_cache_hits", chain.attester_cache.hits),
-                ("attester_cache_misses", chain.attester_cache.misses),
-                ("early_attester_cache_hits",
+            for cache, stat, value in (
+                ("attester", "hits", chain.attester_cache.hits),
+                ("attester", "misses", chain.attester_cache.misses),
+                ("early_attester", "hits",
                  chain.early_attester_cache.hits),
-                ("proposer_cache_hits", chain.proposer_cache.hits),
-                ("proposer_cache_misses", chain.proposer_cache.misses),
+                ("proposer", "hits", chain.proposer_cache.hits),
+                ("proposer", "misses", chain.proposer_cache.misses),
             ):
-                REGISTRY.gauge(
-                    f"lighthouse_tpu_{name}",
-                    "attestation-production cache statistics",
-                ).set(value)
+                _CACHE_STATS.labels(cache, stat).set(value)
             return (REGISTRY.render().encode(), "text/plain; version=0.0.4")
         if parts[:3] == ["eth", "v1", "node"] and len(parts) >= 4:
             if parts[3] == "version":
@@ -531,6 +586,19 @@ class BeaconApiServer:
                         "address": "0x" + "00" * 20,
                     }
                 }
+        if parts[:2] == ["lighthouse", "spans"]:
+            # recent span trees from the data-plane tracer (JSON sibling
+            # of the /metrics scrape; ?limit=N bounds the response)
+            q = self._query(path)
+            limit = self._int_q(q, "limit")
+            return {
+                "data": TRACER.recent(limit),
+                "meta": {
+                    "enabled": TRACER.enabled,
+                    "capacity": TRACER.capacity,
+                    "completed_roots": TRACER.completed_roots,
+                },
+            }
         if parts[:3] == ["lighthouse", "tpu", "stats"] or parts[:2] == [
             "lighthouse",
             "health",

@@ -17,8 +17,34 @@ import heapq
 import threading
 
 from lighthouse_tpu.common.locks import TimedLock
+from lighthouse_tpu.common.metrics import REGISTRY
 import time
 from dataclasses import dataclass, field
+
+# queue-plane observability (the reference exports the same shape from
+# beacon_processor/mod.rs via lighthouse_metrics): depth per kind,
+# submit/drop/process events, time-in-queue, and handler wall time
+_QUEUE_DEPTH = REGISTRY.gauge_vec(
+    "lighthouse_tpu_beacon_processor_queue_depth",
+    "queued work items per kind",
+    ("kind",),
+)
+_QUEUE_EVENTS = REGISTRY.counter_vec(
+    "lighthouse_tpu_beacon_processor_events_total",
+    "beacon processor queue events (submitted/dropped/reprocess_"
+    "scheduled/processed) per kind",
+    ("kind", "event"),
+)
+_QUEUE_WAIT_SECONDS = REGISTRY.histogram_vec(
+    "lighthouse_tpu_beacon_processor_wait_seconds",
+    "time a work item spent queued before a worker picked it up",
+    ("kind",),
+)
+_WORK_SECONDS = REGISTRY.histogram_vec(
+    "lighthouse_tpu_beacon_processor_work_seconds",
+    "handler wall time per drained batch, by kind",
+    ("kind",),
+)
 
 
 @dataclass(order=True)
@@ -27,6 +53,7 @@ class WorkItem:
     seq: int
     kind: str = field(compare=False)
     payload: object = field(compare=False)
+    t_submit: float = field(compare=False, default=0.0)
 
 
 # priority per work kind (lower = more urgent), mirroring the reference's
@@ -86,9 +113,17 @@ class BeaconProcessor:
             if len(q) >= self.bounds[kind]:
                 self._dropped[kind] += 1
                 self.metrics["dropped"] += 1
+                _QUEUE_EVENTS.labels(kind, "dropped").inc()
                 return False
             self._seq += 1
-            q.append(WorkItem(PRIORITIES[kind], self._seq, kind, payload))
+            q.append(
+                WorkItem(
+                    PRIORITIES[kind], self._seq, kind, payload,
+                    time.monotonic(),
+                )
+            )
+            _QUEUE_EVENTS.labels(kind, "submitted").inc()
+            _QUEUE_DEPTH.labels(kind).set(len(q))
             self._work_available.notify()
         return True
 
@@ -102,6 +137,7 @@ class BeaconProcessor:
             )
             self._seq += 1
             self.metrics["reprocessed"] += 1
+            _QUEUE_EVENTS.labels(kind, "reprocess_scheduled").inc()
 
     # --------------------------------------------------------------- drain
 
@@ -117,15 +153,20 @@ class BeaconProcessor:
             if not q:
                 continue
             if kind == "gossip_attestation":
-                batch = [w.payload for w in q[:ATTESTATION_BATCH_MAX]]
-                del q[: len(batch)]
-                return kind, batch
-            if kind == "gossip_aggregate":
-                batch = [w.payload for w in q[:AGGREGATE_BATCH_MAX]]
-                del q[: len(batch)]
-                return kind, batch
-            w = q.pop(0)
-            return kind, w.payload
+                items = q[:ATTESTATION_BATCH_MAX]
+            elif kind == "gossip_aggregate":
+                items = q[:AGGREGATE_BATCH_MAX]
+            else:
+                items = q[:1]
+            del q[: len(items)]
+            wait_hist = _QUEUE_WAIT_SECONDS.labels(kind)
+            for w in items:
+                if w.t_submit:
+                    wait_hist.observe(now - w.t_submit)
+            _QUEUE_DEPTH.labels(kind).set(len(q))
+            if kind in ("gossip_attestation", "gossip_aggregate"):
+                return kind, [w.payload for w in items]
+            return kind, items[0].payload
         return None
 
     def process_pending(self, max_items: int | None = None):
@@ -138,8 +179,10 @@ class BeaconProcessor:
             if nxt is None:
                 return n
             kind, payload = nxt
-            self.handlers[kind](payload)
+            with _WORK_SECONDS.labels(kind).time():
+                self.handlers[kind](payload)
             self.metrics["processed"] += 1
+            _QUEUE_EVENTS.labels(kind, "processed").inc()
             n += 1
         return n
 
@@ -171,7 +214,9 @@ class BeaconProcessor:
                     continue
             kind, payload = nxt
             try:
-                self.handlers[kind](payload)
+                with _WORK_SECONDS.labels(kind).time():
+                    self.handlers[kind](payload)
             except Exception:  # worker errors must not kill the pool
                 pass
             self.metrics["processed"] += 1
+            _QUEUE_EVENTS.labels(kind, "processed").inc()

@@ -1,12 +1,16 @@
 """Node notifier: periodic human-readable status line.
 
 Role of beacon_node/client/src/notifier.rs: per-slot summary of head slot,
-sync state, peers, finalization — emitted through the structured logger.
+sync state, peers, finalization — emitted through the structured logger,
+plus the data-plane headline number: signature sets verified per second
+since the previous tick (from the registry's verify counters).
 """
 
-from lighthouse_tpu.common.logging import TimeLatch, get_logger, kv
-
 import logging
+import time
+
+from lighthouse_tpu.common.logging import TimeLatch, get_logger, kv
+from lighthouse_tpu.common.metrics import REGISTRY
 
 
 class Notifier:
@@ -15,6 +19,8 @@ class Notifier:
         self.sync = sync
         self.latch = TimeLatch(interval_s)
         self.log = get_logger("notifier")
+        # (verify_sets_total, monotonic time) at the previous tick
+        self._verify_mark: tuple[float, float] | None = None
 
     def tick(self, slot: int):
         if not self.latch.elapsed():
@@ -30,8 +36,25 @@ class Notifier:
             justified=chain.head_state.current_justified_checkpoint.epoch,
             finalized=chain.finalized_checkpoint.epoch,
             peers=len(self.sync.peers) if self.sync else 0,
-            blocks=chain.metrics["blocks_imported"],
+            # .get: a fresh (or checkpoint-synced) chain may not have
+            # imported anything yet — a missing key is 0, not a crash
+            blocks=chain.metrics.get("blocks_imported", 0),
+            verify_sps=self.verify_throughput(),
         )
+
+    def verify_throughput(self) -> float:
+        """Signature sets verified per second since the previous tick,
+        from the registry's lighthouse_tpu_verify_sets_total counter
+        (0.0 on the first tick or when no time has passed)."""
+        now = time.monotonic()
+        total = REGISTRY.get_value(
+            "lighthouse_tpu_verify_sets_total", default=0.0
+        )
+        mark = self._verify_mark
+        self._verify_mark = (total, now)
+        if mark is None or now <= mark[1]:
+            return 0.0
+        return round((total - mark[0]) / (now - mark[1]), 1)
 
     def _synced(self, slot: int) -> bool:
         return chainable(self.chain.head_state.slot, slot)

@@ -25,6 +25,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.crypto.constants import G1_X, G1_Y, P, R
 from lighthouse_tpu.ops import curve, fieldb as fb, pairing
 
@@ -92,12 +93,16 @@ def miller_inputs(
     msgs_g2_aff, sigs_g2_aff, pubkeys_g1_aff, key_mask, rand_bits, set_mask
 ):
     """Build the (S+1)-pair multi-pairing inputs; shared with the sharded
-    path."""
-    agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)
-    agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
+    path. The `trace/*` spans attribute JAX TRACE time per stage — they
+    fire once per (re)compile of the enclosing jit, not per dispatch."""
+    with span("trace/pubkey_aggregation"):
+        agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)
+    with span("trace/rlc_ladder_g1"):
+        agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
     pk_aff = curve.PG1.to_affine(agg_pk_r)
 
-    sig_acc = rlc_combined_signature(sigs_g2_aff, rand_bits, set_mask)
+    with span("trace/rlc_ladder_g2"):
+        sig_acc = rlc_combined_signature(sigs_g2_aff, rand_bits, set_mask)
     sig_aff = curve.PG2.to_affine(_expand0(sig_acc))
     return _assemble_pairs(msgs_g2_aff, set_mask, pk_aff, sig_aff)
 
@@ -154,20 +159,24 @@ def grouped_miller_inputs(
 
     # per-set aggregate over K keys, then the per-set RLC ladder — all
     # on the (G, Sg) grid (the group primitives take any leading batch)
-    agg_pk = curve.PG1.sum_axis(
-        curve.PG1.from_affine(pubkeys_g1_aff, key_mask), axis=2
-    )
-    agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
+    with span("trace/pubkey_aggregation"):
+        agg_pk = curve.PG1.sum_axis(
+            curve.PG1.from_affine(pubkeys_g1_aff, key_mask), axis=2
+        )
+    with span("trace/rlc_ladder_g1"):
+        agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
     # fold each group's RLC'd pubkeys into one point per message
-    grp_pk = curve.PG1.sum_axis(agg_pk_r, axis=1)  # (G,)
+    with span("trace/msm_group_fold"):
+        grp_pk = curve.PG1.sum_axis(agg_pk_r, axis=1)  # (G,)
     pk_aff = curve.PG1.to_affine(grp_pk)
 
     # signature side is unchanged by grouping: one global RLC sum
-    sig_proj = curve.PG2.from_affine(sigs_g2_aff, set_mask)
-    sig_r = curve.PG2.mul_scalar_bits(sig_proj, rand_bits)
-    sig_acc = curve.PG2.sum_axis(
-        curve.PG2.sum_axis(sig_r, axis=1), axis=0
-    )
+    with span("trace/rlc_ladder_g2"):
+        sig_proj = curve.PG2.from_affine(sigs_g2_aff, set_mask)
+        sig_r = curve.PG2.mul_scalar_bits(sig_proj, rand_bits)
+        sig_acc = curve.PG2.sum_axis(
+            curve.PG2.sum_axis(sig_r, axis=1), axis=0
+        )
     sig_aff = curve.PG2.to_affine(_expand0(sig_acc))
     return _grouped_pair_inputs(
         pk_aff, sig_aff, group_msgs_g2_aff, group_mask

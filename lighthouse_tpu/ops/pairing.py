@@ -288,7 +288,13 @@ def pairing(p_g1_affine, q_g2_affine):
 
 def multi_pairing_is_one(p_g1_affine, q_g2_affine, valid_mask=None):
     """prod_i e(P_i, Q_i) == 1 over the leading pair axis, one shared
-    final exponentiation."""
-    f = miller_loop(p_g1_affine, q_g2_affine, valid_mask=valid_mask)
-    prod = tower.fp12_product_axis(f, axis=0)
-    return final_exp_is_one(prod)
+    final exponentiation. The trace/* spans attribute JAX trace time to
+    the two dominant graph stages for every caller (flat, grouped,
+    sharded) — they fire once per (re)compile, not per dispatch."""
+    from lighthouse_tpu.common.tracing import span
+
+    with span("trace/miller_loop"):
+        f = miller_loop(p_g1_affine, q_g2_affine, valid_mask=valid_mask)
+    with span("trace/final_exp"):
+        prod = tower.fp12_product_axis(f, axis=0)
+        return final_exp_is_one(prod)

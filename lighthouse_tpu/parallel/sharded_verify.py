@@ -41,7 +41,23 @@ def _shard_map(f, mesh, in_specs, out_specs):
             check_rep=False,
         )
 
+from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.ops import batch_verify, curve, pairing, tower
+
+# trace-time observability: which reduction strategy each sharded
+# program was built with (fires once per trace, not per dispatch) and
+# how many sharded verify programs this process has constructed
+_REDUCTIONS = REGISTRY.counter_vec(
+    "lighthouse_tpu_sharded_reductions_total",
+    "collective reductions traced into sharded verify programs, "
+    "by strategy",
+    ("kind",),
+)
+_SHARDED_BUILDS = REGISTRY.counter_vec(
+    "lighthouse_tpu_sharded_verify_builds_total",
+    "sharded verify program constructions, by layout",
+    ("layout",),
+)
 
 
 def _gather_fold_points(group, pt, axis_name):
@@ -78,7 +94,9 @@ def _reduce_points_over(mesh, ring, group, pt, axis_name):
     and the axis is a power of two, all_gather+fold otherwise."""
     n = mesh.shape[axis_name]
     if ring and n & (n - 1) == 0:
+        _REDUCTIONS.labels("butterfly").inc()
         return _butterfly_reduce(pt, group.add, axis_name, n)
+    _REDUCTIONS.labels("gather_fold").inc()
     return _gather_fold_points(group, pt, axis_name)
 
 
@@ -102,10 +120,12 @@ def _finish_multi_pairing(
 
     n_axis = mesh.shape[reduce_axis]
     if ring and n_axis & (n_axis - 1) == 0:
+        _REDUCTIONS.labels("butterfly").inc()
         prod = _butterfly_reduce(
             prod_local, tower.fp12_mul, reduce_axis, n_axis
         )
     else:
+        _REDUCTIONS.labels("gather_fold").inc()
         gathered = jax.lax.all_gather(prod_local, reduce_axis)
         prod = tower.fp12_product_axis(gathered, axis=0)
 
@@ -164,6 +184,7 @@ def sharded_verify_signature_sets(mesh, ring: bool = False):
             (pk_x, pk_y), msgs, set_mask & ~pk_inf,
         )
 
+    _SHARDED_BUILDS.labels("flat").inc()
     return jax.jit(_shard_map(step, mesh, in_specs, out_specs))
 
 
@@ -219,4 +240,5 @@ def sharded_verify_signature_sets_grouped(mesh, ring: bool = False):
             (pk_x, pk_y), group_msgs, group_mask & ~pk_inf,
         )
 
+    _SHARDED_BUILDS.labels("grouped").inc()
     return jax.jit(_shard_map(step, mesh, in_specs, out_specs))

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Metric-name lint for the process registry.
+
+Statically enforces the observability contract over the whole
+`lighthouse_tpu` package:
+
+  * every metric registered on the global REGISTRY uses a LITERAL name
+    (dynamic names defeat grep, dashboards, and this lint);
+  * every name matches ``lighthouse_tpu_[a-z0-9_]+``;
+  * every name is registered at exactly ONE call site (one family, one
+    owner — lookups go through Registry.get/get_value, which have no
+    registration side effect).
+
+The registry-infrastructure module (common/metrics.py) is exempt from
+the literal-name rule: the RegistryBackedMetrics view derives gauge
+names from mapping keys by design (they still share the enforced
+``lighthouse_tpu_`` prefix).
+
+Run directly (exit 1 on violations) or via tests/test_metric_name_lint.py,
+which wires it into the tier-1 suite.
+"""
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+REGISTRATION_METHODS = {
+    "counter",
+    "gauge",
+    "histogram",
+    "counter_vec",
+    "gauge_vec",
+    "histogram_vec",
+}
+NAME_RE = re.compile(r"^lighthouse_tpu_[a-z0-9_]+$")
+# registry plumbing: name synthesis from mapping keys is the point
+EXEMPT_FILES = {"common/metrics.py"}
+
+
+def _registry_call_name(node: ast.Call):
+    """'REGISTRY.<method>' call -> method name, else None."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    if fn.attr not in REGISTRATION_METHODS:
+        return None
+    if isinstance(fn.value, ast.Name) and fn.value.id == "REGISTRY":
+        return fn.attr
+    return None
+
+
+def collect(package_root) -> tuple[dict, list]:
+    """Scan the package; returns (name -> [(file, line), ...], violations)."""
+    package_root = Path(package_root)
+    sites: dict[str, list] = {}
+    violations: list[str] = []
+    for path in sorted(package_root.rglob("*.py")):
+        rel = path.relative_to(package_root).as_posix()
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            violations.append(f"{rel}: unparseable: {e}")
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _registry_call_name(node) is None:
+                continue
+            if rel in EXEMPT_FILES:
+                continue
+            if not node.args:
+                violations.append(
+                    f"{rel}:{node.lineno}: registry call without a name"
+                )
+                continue
+            first = node.args[0]
+            if not (
+                isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+            ):
+                violations.append(
+                    f"{rel}:{node.lineno}: metric name must be a string "
+                    "literal"
+                )
+                continue
+            name = first.value
+            if not NAME_RE.match(name):
+                violations.append(
+                    f"{rel}:{node.lineno}: {name!r} does not match "
+                    "lighthouse_tpu_[a-z0-9_]+"
+                )
+            sites.setdefault(name, []).append((rel, node.lineno))
+    for name, where in sorted(sites.items()):
+        if len(where) > 1:
+            locs = ", ".join(f"{f}:{ln}" for f, ln in where)
+            violations.append(
+                f"{name!r} registered at {len(where)} sites ({locs}); "
+                "register once and share the object"
+            )
+    return sites, violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = (
+        Path(argv[0])
+        if argv
+        else Path(__file__).resolve().parent.parent / "lighthouse_tpu"
+    )
+    sites, violations = collect(root)
+    if violations:
+        print(f"{len(violations)} metric-name violation(s):")
+        for v in violations:
+            print(f"  {v}")
+        return 1
+    print(f"{len(sites)} metric families OK under {root}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,67 @@
+"""Tier-1 wiring for scripts/check_metric_names.py: every registry
+metric name in the package matches lighthouse_tpu_[a-z0-9_]+, is a
+string literal, and is registered at exactly one call site."""
+
+import importlib.util
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_linter():
+    path = os.path.join(_ROOT, "scripts", "check_metric_names.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_names", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_package_metric_names_lint_clean():
+    linter = _load_linter()
+    sites, violations = linter.collect(
+        os.path.join(_ROOT, "lighthouse_tpu")
+    )
+    assert violations == []
+    # the observability layer is actually present
+    assert "lighthouse_tpu_verify_stage_seconds" in sites
+    assert "lighthouse_tpu_http_request_seconds" in sites
+
+
+def test_linter_flags_bad_registrations(tmp_path):
+    linter = _load_linter()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "from lighthouse_tpu.common.metrics import REGISTRY\n"
+        'REGISTRY.counter("BadName")\n'
+        'REGISTRY.gauge(f"lighthouse_tpu_{x}")\n'
+        'REGISTRY.counter("lighthouse_tpu_dup_total")\n'
+    )
+    (pkg / "b.py").write_text(
+        "from lighthouse_tpu.common.metrics import REGISTRY\n"
+        'REGISTRY.counter("lighthouse_tpu_dup_total")\n'
+    )
+    _sites, violations = linter.collect(pkg)
+    text = "\n".join(violations)
+    assert "does not match" in text
+    assert "string literal" in text
+    assert "registered at 2 sites" in text
+
+
+def test_linter_cli_exit_codes(tmp_path):
+    linter = _load_linter()
+    assert (
+        linter.main([os.path.join(_ROOT, "lighthouse_tpu")]) == 0
+    )
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "m.py").write_text(
+        'import x\nx.REGISTRY\n'
+    )
+    (bad / "n.py").write_text(
+        "REGISTRY = None\n"
+        'REGISTRY.counter("nope")\n'
+    )
+    assert linter.main([str(bad)]) == 1

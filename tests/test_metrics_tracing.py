@@ -1,0 +1,474 @@
+"""Observability layer: labeled metric families, span tracer, data-plane
+stage instrumentation, and the /metrics + /lighthouse/spans endpoints."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.common import tracing
+from lighthouse_tpu.common.metrics import (
+    REGISTRY,
+    Registry,
+    RegistryBackedMetrics,
+)
+from lighthouse_tpu.common.tracing import TRACER
+
+
+# ------------------------------------------------------- labeled families
+
+
+def test_labeled_families_exposition():
+    reg = Registry()
+    c = reg.counter_vec("rpc_total", "requests", ("method", "code"))
+    c.labels("GET", "200").inc()
+    c.labels("GET", "200").inc(2)
+    c.labels(method="POST", code="400").inc()
+    g = reg.gauge_vec("depth", "", ("kind",))
+    g.labels("att").set(7)
+    h = reg.histogram_vec("lat", "", ("ep",), buckets=(0.1, 1.0))
+    h.labels("/x").observe(0.05)
+    out = reg.render()
+    assert 'rpc_total{method="GET",code="200"} 3.0' in out
+    assert 'rpc_total{method="POST",code="400"} 1.0' in out
+    assert out.count("# TYPE rpc_total counter") == 1
+    assert 'depth{kind="att"} 7.0' in out
+    assert 'lat_bucket{ep="/x",le="0.1"} 1' in out
+    assert 'lat_bucket{ep="/x",le="+Inf"} 1' in out
+    assert 'lat_sum{ep="/x"} 0.05' in out
+    assert 'lat_count{ep="/x"} 1' in out
+
+
+def test_label_values_escaped_and_validated():
+    reg = Registry()
+    c = reg.counter_vec("esc_total", "", ("what",))
+    c.labels('say "hi"\n').inc()
+    assert 'esc_total{what="say \\"hi\\"\\n"} 1.0' in reg.render()
+    with pytest.raises(ValueError):
+        c.labels("a", "b")  # wrong arity
+    with pytest.raises(ValueError):
+        c.labels(wrong="kw")  # unknown label name
+
+
+def test_histogram_buckets_are_cumulative():
+    reg = Registry()
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    lines = h.render()
+    counts = [int(l.split()[-1]) for l in lines if "_bucket" in l]
+    assert counts == [1, 2, 3, 4]  # cumulative; +Inf equals n
+    assert f"h_seconds_count 4" in "\n".join(lines)
+
+
+def test_registry_rejects_conflicting_registration():
+    reg = Registry()
+    reg.counter("x_total")
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")  # kind conflict
+    with pytest.raises(ValueError):
+        reg.counter_vec("x_total", "", ("a",))  # plain vs labeled
+    reg.counter_vec("y_total", "", ("a",))
+    with pytest.raises(ValueError):
+        reg.counter_vec("y_total", "", ("b",))  # label-schema conflict
+    # identical re-registration returns the same object
+    assert reg.counter("x_total") is reg.counter("x_total")
+
+
+def test_get_value_reads_without_registering():
+    reg = Registry()
+    assert reg.get_value("absent", default=3.5) == 3.5
+    assert "absent" not in reg.names()
+    reg.counter("present_total").inc(2)
+    assert reg.get_value("present_total") == 2.0
+    v = reg.counter_vec("lab_total", "", ("k",))
+    v.labels("a").inc(4)
+    assert reg.get_value("lab_total", labels=("a",)) == 4.0
+    assert reg.get_value("lab_total", labels=("zz",), default=-1) == -1
+
+
+def test_histogram_vec_concurrency_smoke():
+    reg = Registry()
+    h = reg.histogram_vec("conc_seconds", "", ("t",), buckets=(0.5, 1.0))
+    errors = []
+
+    def work():
+        try:
+            for _ in range(500):
+                h.labels("x").observe(0.25)
+                reg.render()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=work) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert h.labels("x").n == 1000
+    assert 'conc_seconds_count{t="x"} 1000' in reg.render()
+
+
+def test_gauge_set_is_thread_safe_smoke():
+    reg = Registry()
+    g = reg.gauge("g")
+    done = threading.Event()
+
+    def setter():
+        while not done.is_set():
+            g.set(1.0)
+            g.inc()
+
+    th = threading.Thread(target=setter)
+    th.start()
+    try:
+        for _ in range(200):
+            reg.render()
+    finally:
+        done.set()
+        th.join()
+
+
+def test_registry_backed_metrics_is_dict_compatible():
+    m = RegistryBackedMetrics(
+        "lighthouse_tpu_testview_", initial={"a": 0}
+    )
+    m["a"] += 1
+    m["b"] = 2.5
+    assert m["a"] == 1 and m.get("b") == 2.5
+    assert m.get("missing", 9) == 9
+    with pytest.raises(KeyError):
+        m["missing"]
+    assert dict(m) == {"a": 1, "b": 2.5}
+    # mirrored onto registry gauges
+    assert REGISTRY.get_value("lighthouse_tpu_testview_a") == 1.0
+    assert REGISTRY.get_value("lighthouse_tpu_testview_b") == 2.5
+    # a second view does not bleed into the first's reads
+    m2 = RegistryBackedMetrics(
+        "lighthouse_tpu_testview_", initial={"a": 0}
+    )
+    assert m["a"] == 1 and m2["a"] == 0
+
+
+# --------------------------------------------------------------- tracer
+
+
+def test_span_nesting_and_jsonl_export(tmp_path):
+    tr = tracing.Tracer(capacity=8)
+    with tr.span("verify", n_sets=2):
+        with tr.span("verify/a"):
+            pass
+        with tr.span("verify/b"):
+            with tr.span("verify/b/inner"):
+                pass
+    roots = tr.recent()
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["name"] == "verify"
+    assert root["attrs"] == {"n_sets": 2}
+    assert [c["name"] for c in root["children"]] == [
+        "verify/a", "verify/b",
+    ]
+    assert root["children"][1]["children"][0]["name"] == "verify/b/inner"
+    # parent duration covers its children
+    child_sum = sum(c["duration_s"] for c in root["children"])
+    assert root["duration_s"] >= child_sum
+
+    out = tmp_path / "spans.jsonl"
+    assert tr.export_jsonl(out) == 1
+    docs = [json.loads(l) for l in out.read_text().splitlines()]
+    assert docs[0]["name"] == "verify"
+    assert docs[0]["children"][1]["children"][0]["name"] == "verify/b/inner"
+
+
+def test_tracer_ring_buffer_and_configure():
+    tr = tracing.Tracer(capacity=2)
+    for i in range(5):
+        with tr.span(f"s{i}"):
+            pass
+    roots = tr.recent()
+    assert [r["name"] for r in roots] == ["s3", "s4"]
+    assert tr.completed_roots == 5
+    assert tr.recent(limit=1) == [roots[1]]
+    assert tr.recent(limit=0) == []
+    tr.configure(enabled=False)
+    with tr.span("ignored"):
+        pass
+    assert [r["name"] for r in tr.recent()] == ["s3", "s4"]
+    tr.configure(enabled=True, capacity=1)
+    with tr.span("kept"):
+        pass
+    assert [r["name"] for r in tr.recent()] == ["kept"]
+
+
+def test_tracer_threads_do_not_share_stacks():
+    tr = tracing.Tracer(capacity=16)
+    barrier = threading.Barrier(2)
+
+    def work(label):
+        with tr.span(f"root_{label}"):
+            barrier.wait(timeout=5)
+            with tr.span(f"root_{label}/leaf"):
+                pass
+
+    threads = [
+        threading.Thread(target=work, args=(i,)) for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    roots = tr.recent()
+    assert len(roots) == 2
+    for r in roots:
+        assert len(r["children"]) == 1
+        assert r["children"][0]["name"] == f'{r["name"]}/leaf'
+
+
+def test_leaf_spans_mirror_into_stage_histograms():
+    with TRACER.span("verify/unittest_stage"):
+        pass
+    with TRACER.span("unfamilied_span"):
+        pass
+    out = REGISTRY.render()
+    assert (
+        'lighthouse_tpu_verify_stage_seconds_count{stage="unittest_stage"}'
+        in out
+    )
+    assert 'lighthouse_tpu_span_seconds_count{span="unfamilied_span"}' in out
+
+
+def test_parent_stage_spans_mirror_too():
+    """A stage span with children (import/block_processing wrapping the
+    nested verify) must still land in its stage histogram."""
+    fam = REGISTRY.get("lighthouse_tpu_import_stage_seconds")
+    before = fam.labels("unittest_parent").n
+    with TRACER.span("import/unittest_parent"):
+        with TRACER.span("verify/unittest_inner"):
+            pass
+    assert fam.labels("unittest_parent").n == before + 1
+
+
+def test_disabled_ring_still_feeds_stage_histograms():
+    """--trace-buffer 0 turns off tree buffering, not the /metrics
+    stage histograms."""
+    fam = REGISTRY.get("lighthouse_tpu_verify_stage_seconds")
+    tr = tracing.Tracer(capacity=4, enabled=False)
+    before = fam.labels("disabled_probe").n
+    with tr.span("verify/disabled_probe"):
+        pass
+    assert fam.labels("disabled_probe").n == before + 1
+    assert tr.recent() == []
+
+
+def test_span_children_are_bounded():
+    tr = tracing.Tracer(capacity=4)
+    cap = tracing.MAX_CHILDREN_PER_SPAN
+    with tr.span("verify"):
+        for i in range(cap + 25):
+            with tr.span("verify/leafy"):
+                pass
+    root = tr.recent()[-1]
+    assert len(root["children"]) == cap
+    assert root["attrs"]["children_dropped"] == 25
+
+
+# ------------------------------------------- data-plane instrumentation
+
+
+def test_ref_verify_populates_stage_histograms_and_span_tree():
+    """Acceptance: one verify_signature_sets run under the tracer yields
+    labeled per-stage histograms and a span tree whose leaf-span sum is
+    within 20% of the top-level duration."""
+    from lighthouse_tpu import bls
+
+    TRACER.configure(enabled=True)
+    TRACER.reset()
+    kps = bls.interop_keypairs(2)
+    sets = [
+        bls.SignatureSet(
+            kp.sk.sign(bytes([i]) * 32), [kp.pk], bytes([i]) * 32
+        )
+        for i, kp in enumerate(kps)
+    ]
+    stage_fam = REGISTRY.get("lighthouse_tpu_verify_stage_seconds")
+    before = {
+        k: h.n for k, h in stage_fam.children().items()
+    }
+    assert bls.verify_signature_sets(sets, backend="ref")
+
+    # labeled per-stage histograms populated
+    out = REGISTRY.render()
+    for stage in (
+        "subgroup_check", "pubkey_aggregation", "hash_to_curve",
+        "miller_loop", "final_exp",
+    ):
+        assert (
+            f'lighthouse_tpu_verify_stage_seconds_count{{stage="{stage}"}}'
+            in out
+        ), stage
+    after = {k: h.n for k, h in stage_fam.children().items()}
+    assert after[("miller_loop",)] == before.get(("miller_loop",), 0) + 2
+
+    # span tree: root "verify" with per-set stage leaves
+    roots = [r for r in TRACER.recent() if r["name"] == "verify"]
+    assert roots, "no verify root span recorded"
+    root = roots[-1]
+    assert root["attrs"]["n_sets"] == 2
+    assert root["attrs"]["backend"] == "ref"
+
+    def leaves(node):
+        if not node["children"]:
+            return [node]
+        return [l for c in node["children"] for l in leaves(c)]
+
+    leaf_sum = sum(l["duration_s"] for l in leaves(root))
+    assert leaf_sum <= root["duration_s"] * 1.01
+    assert leaf_sum >= 0.8 * root["duration_s"], (
+        f"leaf sum {leaf_sum} vs root {root['duration_s']}"
+    )
+
+    # batch counters moved too
+    assert REGISTRY.get_value(
+        "lighthouse_tpu_verify_batches_total", labels=("ref", "ok")
+    ) >= 1
+    assert REGISTRY.get_value("lighthouse_tpu_verify_sets_total") >= 2
+
+
+def test_verify_jsonl_roundtrip(tmp_path):
+    from lighthouse_tpu import bls
+
+    TRACER.configure(enabled=True)
+    TRACER.reset()
+    kp = bls.interop_keypairs(1)[0]
+    msg = b"jsonl" * 6 + b"xx"
+    assert bls.verify_signature_sets(
+        [bls.SignatureSet(kp.sk.sign(msg), [kp.pk], msg)], backend="ref"
+    )
+    out = tmp_path / "verify.jsonl"
+    TRACER.export_jsonl(out)
+    docs = [json.loads(l) for l in out.read_text().splitlines()]
+    names = {d["name"] for d in docs}
+    assert "verify" in names
+
+
+# -------------------------------------------------------- HTTP endpoints
+
+
+@pytest.fixture(scope="module")
+def obs_server():
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.http_api.server import BeaconApiServer
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec()
+    h = Harness(spec, 8)
+    chain = BeaconChain(h.state.copy(), spec, backend="ref")
+    srv = BeaconApiServer(chain).start()
+    yield chain, srv
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}{path}", timeout=10
+    ) as r:
+        return r.read().decode()
+
+
+def test_metrics_endpoint_serves_labeled_families(obs_server):
+    chain, srv = obs_server
+    body = _get(srv, "/metrics")
+    assert "# TYPE lighthouse_tpu_verify_stage_seconds histogram" in body
+    assert 'lighthouse_tpu_attestation_cache_stat{cache="attester",stat="hits"}' in body
+    # the chain metrics mapping is mirrored onto registry gauges
+    assert "lighthouse_tpu_chain_blocks_imported" in body
+    assert "lighthouse_tpu_chain_head_slot" in body
+    # second scrape shows the first scrape's request latency, by endpoint
+    body2 = _get(srv, "/metrics")
+    assert (
+        'lighthouse_tpu_http_request_seconds_count'
+        '{method="GET",endpoint="/metrics"}'
+    ) in body2
+
+
+def test_spans_endpoint_serves_recent_trees(obs_server):
+    chain, srv = obs_server
+    TRACER.configure(enabled=True)
+    with TRACER.span("verify/spans_endpoint_probe"):
+        pass
+    doc = json.loads(_get(srv, "/lighthouse/spans?limit=500"))
+    assert doc["meta"]["enabled"] is True
+    assert doc["meta"]["capacity"] >= 1
+    names = {d["name"] for d in doc["data"]}
+    assert "verify/spans_endpoint_probe" in names
+    # limit bounds the response
+    doc1 = json.loads(_get(srv, "/lighthouse/spans?limit=1"))
+    assert len(doc1["data"]) <= 1
+    # bad limit is a 400, not a 500
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(srv, "/lighthouse/spans?limit=nope")
+    assert ei.value.code == 400
+
+
+def test_http_latency_endpoint_label_collapses_ids():
+    from lighthouse_tpu.http_api.server import _endpoint_label
+
+    assert _endpoint_label("/metrics") == "/metrics"
+    assert (
+        _endpoint_label("/eth/v1/beacon/states/123/validators?id=4")
+        == "/eth/v1/beacon/states/{id}/validators"
+    )
+    assert (
+        _endpoint_label("/eth/v1/beacon/states/head/root")
+        == "/eth/v1/beacon/states/head/root"
+    )
+    assert (
+        _endpoint_label("/eth/v2/beacon/blocks/0xdeadbeef")
+        == "/eth/v2/beacon/blocks/{id}"
+    )
+    # scanner garbage collapses instead of minting label series
+    assert _endpoint_label("/wp-login.php") == "/{id}"
+    assert (
+        _endpoint_label("/admin/../../etc/passwd")
+        == "/{id}/{id}/{id}/{id}/{id}"
+    )
+
+
+# ------------------------------------------------- notifier / monitoring
+
+
+def test_notifier_tolerates_fresh_chain_without_blocks_imported():
+    from types import SimpleNamespace
+
+    from lighthouse_tpu.notifier import Notifier
+
+    chain = SimpleNamespace(
+        head_state=SimpleNamespace(
+            slot=0,
+            current_justified_checkpoint=SimpleNamespace(epoch=0),
+        ),
+        head_root=b"\x00" * 32,
+        finalized_checkpoint=SimpleNamespace(epoch=0),
+        metrics={},  # fresh chain: no blocks_imported key
+    )
+    n = Notifier(chain)
+    n.tick(0)  # must not raise KeyError
+    # throughput: first call marks, second measures a non-negative rate
+    assert n.verify_throughput() >= 0.0
+
+
+def test_monitoring_snapshot_sources_registry(obs_server):
+    from lighthouse_tpu.common.monitoring import MonitoringService
+
+    chain, _srv = obs_server
+    chain.metrics["attestations_processed"] += 3
+    chain.metrics["head_slot"] = 7
+    mon = MonitoringService("http://127.0.0.1:1/x", chain=chain)
+    snap = mon.snapshot()[0]
+    assert snap["sync_beacon_head_slot"] == 7
+    assert snap["slasher_attestations"] == 3
+    assert snap["process"] == "beaconnode"
