@@ -139,6 +139,24 @@ class BeaconChain:
         self.observed_sync_aggregators = ObservedSyncAggregators()
         self.observed_sync_contributions = ObservedAggregates()
 
+        # blob data-availability plane: blocks committing to blobs wait
+        # here until every sidecar's KZG proof verifies
+        # (data_availability_checker.rs role; KZG checks share the BLS
+        # backend selection so "tpu" rides the device pairing plane)
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            DataAvailabilityChecker,
+        )
+
+        self.da_checker = DataAvailabilityChecker(
+            spec,
+            backend=backend,
+            current_slot_fn=self.current_slot,
+        )
+        # a released block that fails import for NON-DA reasons (e.g.
+        # unknown parent) is handed here; the node wires in its
+        # parent-lookup recovery so the block is not silently lost
+        self.da_release_failure_handler = None
+
         self._justified_balances = [
             v.effective_balance for v in genesis_state.validators
         ]
@@ -317,6 +335,32 @@ class BeaconChain:
 
         if block_root in self._snapshots:
             raise BlockError("block already known")
+
+        # data-availability gate (BEFORE the equivocation observation so
+        # a released block can re-enter this pipeline, and BEFORE any
+        # state work — an unavailable block must cost nothing): a block
+        # committing to blobs waits in the DA checker until every
+        # committed sidecar arrived with a verified KZG proof
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            DataAvailabilityError,
+        )
+
+        try:
+            missing = self.da_checker.put_block(block_root, signed_block)
+        except DataAvailabilityError as e:
+            # structurally invalid on the DA axis (e.g. more commitments
+            # than MAX_BLOBS_PER_BLOCK) — a hard reject, not a hold
+            raise BlockError(str(e)) from e
+        if missing:
+            self.metrics["da_blocks_held"] = (
+                self.metrics.get("da_blocks_held", 0) + 1
+            )
+            raise BlockError(
+                f"data unavailable: missing blob sidecars {sorted(missing)}"
+            )
+        # only an available block may advance the fork-choice clock —
+        # before the DA gate a far-future block would drag the
+        # checker's own horizon along with it
         if self.fork_choice.current_slot < block.slot:
             self.fork_choice.set_slot(block.slot)
 
@@ -386,6 +430,11 @@ class BeaconChain:
         # store + fork choice
         with span("import/store_fork_choice"):
             self.store.put_block(block_root, signed_block)
+            # persistence point for blob sidecars: only blocks that
+            # actually import get their (verified) sidecars on disk, so
+            # unsolicited gossip can never grow the store
+            for sc in self.da_checker.verified_sidecars(block_root):
+                self.store.put_blob_sidecar(block_root, sc)
             self.store.put_hot_state(state)
             self.store.set_canonical_block_root(block.slot, block_root)
             justified = self._fc_checkpoint(
@@ -528,11 +577,53 @@ class BeaconChain:
             roots.append(root)
         return roots
 
+    def process_blob_sidecar(self, sidecar):
+        """Gossip blob-sidecar entry point: verify + record through the
+        DA checker, then import any block the sidecar completed.
+        Returns the roots of blocks imported as a result (usually
+        empty); raises DataAvailabilityError on invalid/duplicate
+        sidecars (the gossip layer maps that onto peer scoring)."""
+        released = self.da_checker.put_sidecar(sidecar)
+        self.metrics["blob_sidecars_processed"] = (
+            self.metrics.get("blob_sidecars_processed", 0) + 1
+        )
+        imported = []
+        for blk in released:
+            try:
+                imported.append(self.process_block(blk))
+            except BlockError as e:
+                # the block became importable but failed for its own
+                # reasons (the sidecars themselves were valid) — hand
+                # it to the recovery hook so e.g. an unknown parent
+                # triggers the node's lookup instead of silent loss
+                if self.da_release_failure_handler is not None:
+                    self.da_release_failure_handler(blk, e)
+        return imported
+
     def _import_verified(self, signed_block):
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            DataAvailabilityError,
+        )
+
         spec = self.spec
         block = signed_block.message
         block_root = type(block).hash_tree_root(block)
         parent_root = bytes(block.parent_root)
+        # the availability invariant holds on the sync path too: a
+        # segment block committing to blobs imports only if its
+        # sidecars already verified (arrived via gossip). Fetching
+        # missing ones needs the blobs_by_range/by_root RPC — a
+        # ROADMAP item; until then the serving peer's segment is
+        # rejected rather than imported unavailable.
+        try:
+            missing = self.da_checker.put_block(block_root, signed_block)
+        except DataAvailabilityError as e:
+            raise BlockError(str(e)) from e
+        if missing:
+            raise BlockError(
+                f"segment block data unavailable: missing blob "
+                f"sidecars {sorted(missing)}"
+            )
         parent_state = self._snapshots.get(parent_root)
         if parent_state is None:
             raise BlockError("unknown parent")
@@ -551,6 +642,8 @@ class BeaconChain:
         if bytes(block.state_root) != cached_state_root(state):
             raise BlockError("state root mismatch")
         self.store.put_block(block_root, signed_block)
+        for sc in self.da_checker.verified_sidecars(block_root):
+            self.store.put_blob_sidecar(block_root, sc)
         self.store.put_hot_state(state)
         self.store.set_canonical_block_root(block.slot, block_root)
         if self.fork_choice.current_slot < block.slot:
@@ -1061,6 +1154,7 @@ class BeaconChain:
             attester_slashings=list(bb.attester_slashings),
             sync_aggregate=bb.sync_aggregate,
             execution_payload=payload,
+            blob_kzg_commitments=list(bb.blob_kzg_commitments),
         )
         full_block = self.t.block_classes[fork_name](
             slot=blinded.slot,

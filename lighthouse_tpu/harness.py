@@ -166,6 +166,7 @@ class Harness:
         proposer_slashings=(),
         attester_slashings=(),
         sync_aggregate=None,
+        blob_kzg_commitments=(),
     ):
         """Produce a signed block for `slot` on top of the current state."""
         spec = self.spec
@@ -212,6 +213,14 @@ class Harness:
             )
         if fork_name == "bellatrix" and self.payload_builder is not None:
             body.execution_payload = self.payload_builder(state)
+        if blob_kzg_commitments:
+            if fork_name != "bellatrix":
+                raise ValueError(
+                    "blob commitments need a bellatrix-or-later body"
+                )
+            body.blob_kzg_commitments = [
+                bytes(c) for c in blob_kzg_commitments
+            ]
 
         block_cls = t.block_classes[fork_name]
         block = block_cls(
@@ -291,6 +300,41 @@ class Harness:
             self.make_attestations(self.state, slot)
         )
         return block
+
+    def make_blob_sidecars(self, signed_block, blobs):
+        """Build the sidecars for a block produced with
+        blob_kzg_commitments (one per blob, in index order): KZG proofs
+        against the dev trusted setup plus the signed header binding
+        each sidecar to the block root."""
+        from lighthouse_tpu import kzg
+
+        t = self.t
+        msg = signed_block.message
+        header = t.SignedBeaconBlockHeader(
+            message=t.BeaconBlockHeader(
+                slot=msg.slot,
+                proposer_index=msg.proposer_index,
+                parent_root=bytes(msg.parent_root),
+                state_root=bytes(msg.state_root),
+                body_root=type(msg.body).hash_tree_root(msg.body),
+            ),
+            signature=bytes(signed_block.signature),
+        )
+        out = []
+        for i, blob in enumerate(blobs):
+            commitment = bytes(msg.body.blob_kzg_commitments[i])
+            out.append(
+                t.BlobSidecar(
+                    index=i,
+                    blob=bytes(blob),
+                    kzg_commitment=commitment,
+                    kzg_proof=kzg.compute_blob_kzg_proof(
+                        bytes(blob), commitment
+                    ),
+                    signed_block_header=header,
+                )
+            )
+        return out
 
     def run_slots(self, n: int):
         start = self.state.slot + 1

@@ -69,7 +69,7 @@ _ROUTE_SEGMENTS = frozenset(
     """
     eth lighthouse v1 v2 metrics spans health tpu stats node beacon
     config validator debug events genesis states headers blocks blinded
-    pool duties liveness register_validator blinded_blocks
+    blob_sidecars pool duties liveness register_validator blinded_blocks
     aggregate_and_proofs contribution_and_proofs aggregate_attestation
     attestation_data sync_committee_contribution
     beacon_committee_subscriptions attestations sync_committees
@@ -542,6 +542,30 @@ class BeaconApiServer:
                             }
                         )
                     return {"data": out}
+            if parts[3] == "blob_sidecars" and len(parts) >= 5:
+                # GET /eth/v1/beacon/blob_sidecars/{block_id}[?indices=..]
+                # (deneb beacon API): sidecars are served from the store
+                # within the retention window; an importable block with
+                # no blobs returns an empty list, not a 404
+                block = self._resolve_block(parts[4])
+                root = type(block.message).hash_tree_root(block.message)
+                sidecars = chain.store.get_blob_sidecars(root)
+                q = self._query(path)
+                if "indices" in q:
+                    try:
+                        wanted = {
+                            int(i) for i in q["indices"].split(",") if i
+                        }
+                    except ValueError:
+                        raise ApiError(400, "invalid indices") from None
+                    sidecars = [
+                        sc for sc in sidecars if int(sc.index) in wanted
+                    ]
+                return {
+                    "data": [
+                        to_json(type(sc), sc) for sc in sidecars
+                    ]
+                }
             if parts[3] == "headers" and len(parts) >= 5:
                 block = self._resolve_block(parts[4])
                 header = self._header_json(block)

@@ -1,0 +1,117 @@
+"""TPU backend for `verify_blob_kzg_proof_batch`: host marshal -> device.
+
+Host work (bigint, policy): challenge hashing, polynomial evaluation,
+point decompression + subgroup checks, RLC sampling, and the single
+fixed-base -[sum r_i y_i]G1 mul. Device work (ops/kzg_verify): the 3N
+RLC scalar ladders, the two pair folds, and the two-pair Miller loop +
+final exponentiation.
+
+Lane counts are bucketed to powers of two so recompiles stay
+logarithmic in batch size (same policy as bls/tpu_backend).
+"""
+
+import numpy as np
+
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.tracing import span
+from lighthouse_tpu.crypto.constants import P, R
+from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
+from lighthouse_tpu.kzg import api as _api
+from lighthouse_tpu.ops import fieldb as fb
+from lighthouse_tpu.ops.kzg_verify import SCALAR_BITS
+
+_DEVICE_BATCHES = REGISTRY.counter_vec(
+    "lighthouse_tpu_kzg_device_batches_total",
+    "KZG device batch dispatches by bucketed lane count",
+    ("lanes",),
+)
+
+MIN_BUCKET = 2
+
+_JIT = None
+
+
+def _get_fn():
+    global _JIT
+    if _JIT is None:
+        import jax
+
+        from lighthouse_tpu.ops.kzg_verify import verify_kzg_proof_batch
+
+        _JIT = jax.jit(verify_kzg_proof_batch)
+    return _JIT
+
+
+def _bucket(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pack_g1(affs):
+    """Affine int pairs (None = infinity) -> ((L,1,NB), (L,1,NB)) Mont
+    bundles + (L,) validity mask."""
+    xs = np.stack([fb.pack_ints([a[0] if a else 0]) for a in affs])
+    ys = np.stack([fb.pack_ints([a[1] if a else 0]) for a in affs])
+    mask = np.array([a is not None for a in affs], dtype=bool)
+    return (fb.to_mont(xs), fb.to_mont(ys)), mask
+
+
+def _pack_g2_point(aff):
+    """One affine twist point -> ((1,2,NB), (1,2,NB)) Mont bundles."""
+    (x0, x1), (y0, y1) = aff
+    x = np.stack([fb._limbs(x0 % P, fb.NB), fb._limbs(x1 % P, fb.NB)])
+    y = np.stack([fb._limbs(y0 % P, fb.NB), fb._limbs(y1 % P, fb.NB)])
+    return fb.to_mont(x[None]), fb.to_mont(y[None])
+
+
+def _scalar_bits(scalars) -> np.ndarray:
+    """(L, SCALAR_BITS) LSB-first int32 bit matrix."""
+    return np.array(
+        [[(s >> i) & 1 for i in range(SCALAR_BITS)] for s in scalars],
+        dtype=np.int32,
+    )
+
+
+def verify_blob_kzg_proof_batch_tpu(
+    blobs, commitments, proofs, setup=None, seed=None
+) -> bool:
+    s, zs, ys, cs, ws = _api._batch_inputs(
+        blobs, commitments, proofs, setup
+    )
+    n = len(blobs)
+    rs = _api._rlc_scalars(n, seed)
+
+    with span("kzg/marshal", n_proofs=n):
+        bucket = _bucket(n)
+        pad = bucket - n
+        c_affs = [G1_GROUP.to_affine(c) for c in cs]
+        w_affs = [G1_GROUP.to_affine(w) for w in ws]
+        # lane layout: [C | pad] + [W (rz) | pad] + [W (r) | pad]
+        lane_affs = (
+            c_affs + [None] * pad
+            + w_affs + [None] * pad
+            + w_affs + [None] * pad
+        )
+        lane_scalars = (
+            rs + [0] * pad
+            + [r * z % R for r, z in zip(rs, zs)] + [0] * pad
+            + rs + [0] * pad
+        )
+        pts_aff, lane_mask = _pack_g1(lane_affs)
+        bits = _scalar_bits(lane_scalars)
+
+        ry_total = sum(r * y for r, y in zip(rs, ys)) % R
+        aux_pt = G1_GROUP.mul_scalar(
+            G1_GROUP.generator, (-ry_total) % R
+        )
+        aux_aff, aux_mask = _pack_g1([G1_GROUP.to_affine(aux_pt)])
+        tau_g2 = _pack_g2_point(s.tau_g2)
+
+    _DEVICE_BATCHES.labels(str(3 * bucket)).inc()
+    with span("kzg/device", lanes=3 * bucket):
+        ok = _get_fn()(
+            pts_aff, bits, lane_mask, aux_aff, aux_mask, tau_g2
+        )
+        return bool(np.asarray(ok))

@@ -1,0 +1,73 @@
+"""Deterministic INSECURE dev trusted setup for the KZG subsystem.
+
+A real deployment loads the ceremony output (c-kzg's
+trusted_setup.txt — the reference embeds it via the `c-kzg` crate).
+Zero-egress testing cannot fetch it, and a ceremony's whole point is
+that nobody knows tau — so here tau is DERIVED FROM A FIXED PUBLIC
+SECRET and the powers are computed on the fly. Anyone can forge proofs
+against this setup; it exists so the verification *data plane* (MSM
+commitment, quotient proofs, RLC-folded multi-pairings) is exercised
+end to end with hermetic, committed vectors.
+
+Setups are built lazily per polynomial size and cached: the minimal
+preset's 4-element blobs cost 4 host scalar muls, while a
+mainnet-sized 4096 setup is only ever built if something asks for it.
+"""
+
+import hashlib
+from dataclasses import dataclass
+
+from lighthouse_tpu.crypto.constants import R
+from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
+from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
+
+# fixed public "secret" — insecure by construction, see module docstring
+DEV_SECRET_SEED = b"lighthouse-tpu insecure dev kzg trusted setup"
+DEV_TAU = (
+    int.from_bytes(hashlib.sha256(DEV_SECRET_SEED).digest(), "big") % R
+)
+
+
+@dataclass(frozen=True)
+class TrustedSetup:
+    """Powers of tau: [tau^i]G1 for the commitment MSM, [tau]G2 for the
+    verification pairing. Points are affine int tuples (reference
+    representation); the TPU backend packs them into limb bundles at
+    marshal time."""
+
+    size: int
+    g1_powers: tuple  # affine (x, y) int pairs, length `size`
+    tau_g2: tuple  # affine twist point ((x0,x1),(y0,y1))
+
+    @property
+    def g1_generator(self):
+        return self.g1_powers[0]
+
+
+_CACHE: dict[int, TrustedSetup] = {}
+
+
+def dev_setup(size: int, tau: int = DEV_TAU) -> TrustedSetup:
+    """Build (and cache) the size-`size` dev setup. Successive powers
+    are one scalar mul each: P_{i} = [tau]P_{i-1}."""
+    if size < 1:
+        raise ValueError("trusted setup needs at least one G1 power")
+    key = size if tau == DEV_TAU else -1
+    hit = _CACHE.get(key)
+    if hit is not None and hit.size == size:
+        return hit
+    powers = [G1_GROUP.generator]
+    for _ in range(size - 1):
+        powers.append(G1_GROUP.mul_scalar(powers[-1], tau))
+    setup = TrustedSetup(
+        size=size,
+        g1_powers=tuple(
+            G1_GROUP.to_affine(p) for p in powers
+        ),
+        tau_g2=G2_GROUP.to_affine(
+            G2_GROUP.mul_scalar(G2_GROUP.generator, tau)
+        ),
+    )
+    if tau == DEV_TAU:
+        _CACHE[size] = setup
+    return setup

@@ -61,6 +61,9 @@ class WorkItem:
 # then the long tail.
 PRIORITIES = {
     "gossip_block": 0,
+    # sidecars drain right after blocks: a held block's import latency
+    # is bounded by its slowest sidecar (deneb queue ordering)
+    "gossip_blob_sidecar": 1,
     "chain_segment": 1,
     "gossip_aggregate": 2,
     "gossip_attestation": 3,
@@ -72,6 +75,7 @@ PRIORITIES = {
 
 DEFAULT_BOUNDS = {
     "gossip_block": 1024,
+    "gossip_blob_sidecar": 4096,
     "chain_segment": 64,
     "gossip_aggregate": 4096,
     "gossip_attestation": 16384,

@@ -25,6 +25,17 @@ def topic(fork_digest: bytes, name: str) -> str:
     return f"/eth2/{fork_digest.hex()}/{name}/ssz_snappy"
 
 
+def blob_sidecar_topic_name(subnet_id: int) -> str:
+    """`blob_sidecar_{subnet_id}` — the deneb p2p sidecar topics; a
+    sidecar's subnet is its index modulo BLOB_SIDECAR_SUBNET_COUNT
+    (compute_subnet_for_blob_sidecar)."""
+    return f"blob_sidecar_{subnet_id}"
+
+
+def compute_blob_subnet(index: int, subnet_count: int) -> int:
+    return int(index) % max(int(subnet_count), 1)
+
+
 def message_id(data: bytes) -> bytes:
     return hashlib.sha256(data).digest()[:20]
 

@@ -13,8 +13,11 @@ from lighthouse_tpu.common.slot_clock import ManualSlotClock
 from lighthouse_tpu.network.beacon_processor import BeaconProcessor
 from lighthouse_tpu.network.gossip import (
     GossipHub,
+    SCORE_DUPLICATE,
     SCORE_INVALID_MESSAGE,
     SCORE_VALID,
+    blob_sidecar_topic_name,
+    compute_blob_subnet,
     decode_gossip,
     encode_gossip,
     topic,
@@ -77,9 +80,13 @@ class BeaconNode:
         self.chain.migrator = BackgroundMigrator(self.chain, threaded=True)
         self.rpc = RpcServer(self.chain, node_id, self.fork_digest)
         self.sync = SyncManager(self.chain, spec)
+        # a DA-released block whose import fails on an unknown parent
+        # re-enters through the same recovery as a gossip block
+        self.chain.da_release_failure_handler = self._on_release_failure
         self.processor = BeaconProcessor(
             handlers={
                 "gossip_block": self._on_block,
+                "gossip_blob_sidecar": self._on_blob_sidecar,
                 "chain_segment": self._on_segment,
                 "gossip_aggregate": self._on_aggregates,
                 "gossip_attestation": self._on_attestations,
@@ -99,12 +106,17 @@ class BeaconNode:
 
     def _gossip_topics(self):
         # attestation subnets are NOT here: the AttestationSubnetService
-        # owns the 64-topic plane (long-lived backbone + duty-driven)
+        # owns the 64-topic plane (long-lived backbone + duty-driven).
+        # Every node follows all blob-sidecar subnets (full DA custody —
+        # the deneb default for a full node).
         return (
             "beacon_block",
             "beacon_aggregate_and_proof",
             "voluntary_exit",
             "attester_slashing",
+        ) + tuple(
+            blob_sidecar_topic_name(i)
+            for i in range(self.spec.BLOB_SIDECAR_SUBNET_COUNT)
         )
 
     def _init_subnet_service(self):
@@ -172,10 +184,26 @@ class BeaconNode:
             self.hub.report(from_peer, SCORE_INVALID_MESSAGE)
             return
         if name == "beacon_block":
-            fork = self.spec.fork_name_at_epoch(0)
+            # pick the decode class by the block's OWN slot, not epoch 0
+            # — a block gossiped after a fork boundary has a different
+            # body shape. SignedBeaconBlock wire layout is fixed:
+            # [message offset (4)][signature (96)][message...], and slot
+            # is the message's first field.
+            if len(data) < 108:
+                self.hub.report(from_peer, SCORE_INVALID_MESSAGE)
+                return
+            slot = int.from_bytes(data[100:108], "little")
+            fork = self.spec.fork_name_at_epoch(
+                self.spec.slot_to_epoch(slot)
+            )
             block = self.chain.t.signed_block_classes[fork].decode(data)
             self.processor.submit(
                 "gossip_block", (block, from_peer)
+            )
+        elif name.startswith("blob_sidecar"):
+            sidecar = self.chain.t.BlobSidecar.decode(data)
+            self.processor.submit(
+                "gossip_blob_sidecar", (sidecar, from_peer)
             )
         elif name == "beacon_aggregate_and_proof":
             sap = self.chain.t.SignedAggregateAndProof.decode(data)
@@ -197,6 +225,20 @@ class BeaconNode:
             self.node_id,
             topic(self.fork_digest, "beacon_block"),
             encode_gossip(signed_block.to_bytes()),
+        )
+
+    def publish_blob_sidecar(self, sidecar):
+        """Route a sidecar onto its index's subnet topic
+        (compute_subnet_for_blob_sidecar)."""
+        if self.hub is None:
+            return
+        sub = compute_blob_subnet(
+            int(sidecar.index), self.spec.BLOB_SIDECAR_SUBNET_COUNT
+        )
+        self.hub.publish(
+            self.node_id,
+            topic(self.fork_digest, blob_sidecar_topic_name(sub)),
+            encode_gossip(sidecar.to_bytes()),
         )
 
     def publish_attestation(self, att):
@@ -262,8 +304,47 @@ class BeaconNode:
                     self.processor.submit(
                         "gossip_block", (block, from_peer)
                     )
-            elif self.hub is not None and "already" not in msg:
+            elif (
+                self.hub is not None
+                and "already" not in msg
+                and "data unavailable" not in msg
+            ):
+                # a DA-held block is not peer misbehavior — its sidecars
+                # are simply still in flight
                 self.hub.report(from_peer, SCORE_INVALID_MESSAGE)
+
+    def _on_release_failure(self, block, err):
+        """A DA-released block failed import for a non-DA reason. The
+        interesting case is an unknown parent: the original gossip
+        delivery raised 'data unavailable' before the parent check ever
+        ran, so the lookup in _on_block never fired — run it now and
+        requeue the block. Known gap (ROADMAP): a parent that ITSELF
+        commits to blobs cannot import from blocks_by_root alone — that
+        needs the blob_sidecars_by_root RPC."""
+        if "unknown parent" in str(err):
+            if self.sync.lookup_parent(bytes(block.message.parent_root)):
+                self.processor.submit(
+                    "gossip_block", (block, self.node_id)
+                )
+
+    def _on_blob_sidecar(self, payload):
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            DataAvailabilityError,
+        )
+
+        sidecar, from_peer = payload
+        try:
+            self.chain.process_blob_sidecar(sidecar)
+            if self.hub is not None:
+                self.hub.report(from_peer, SCORE_VALID)
+        except DataAvailabilityError as e:
+            if self.hub is not None:
+                self.hub.report(
+                    from_peer,
+                    SCORE_DUPLICATE
+                    if "duplicate" in str(e)
+                    else SCORE_INVALID_MESSAGE,
+                )
 
     def _on_segment(self, payload):
         blocks, _from = payload

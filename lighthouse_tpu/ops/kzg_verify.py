@@ -1,0 +1,104 @@
+"""Device data plane for batched KZG proof verification.
+
+Same shape discipline as `ops.batch_verify`, different workload: N blob
+proof checks fold into ONE two-pair multi-pairing via per-proof RLC
+scalars r_i,
+
+    e( sum_i r_i C_i + sum_i [r_i z_i] W_i - [sum_i r_i y_i] G1,  G2 )
+      * e( -sum_i r_i W_i,  [tau]G2 )  ==  1.
+
+The lane layout carries every scalar-multiplied point of the identity
+through ONE projective double-add ladder (complete RCB formulas — all
+inputs are host-subgroup-checked at decompression, so the r-torsion
+precondition holds):
+
+    lanes [0,   N)   : C_i  with scalar r_i
+    lanes [N,  2N)   : W_i  with scalar r_i * z_i mod r
+    lanes [2N, 3N)   : W_i  with scalar r_i
+
+then two tree folds (lanes [0, 2N) + the host-computed -[sum r_i y_i]G1
+auxiliary lane -> the left pair; lanes [2N, 3N) negated -> the right
+pair) and the shared Miller/final-exp plane. Masked lanes ride as the
+identity, exact under e(inf, .) == 1.
+
+Host-side policy (decompression, subgroup checks, challenge hashing,
+polynomial evaluation, RLC sampling) lives in `lighthouse_tpu.kzg`.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from lighthouse_tpu.common.tracing import span
+from lighthouse_tpu.crypto.constants import G2_X, G2_Y, P, R
+from lighthouse_tpu.ops import curve, fieldb as fb, pairing
+
+NB = fb.NB
+
+SCALAR_BITS = R.bit_length()  # 255: full-width r_i * z_i products
+
+
+def _mont2(v2) -> np.ndarray:
+    """Fp2 int pair -> (1, 2, NB) Montgomery bundle."""
+    return np.stack(
+        [fb._limbs((c << 384) % P, NB) for c in v2]
+    )[None, :, :]
+
+
+# G2 generator, affine Montgomery — the static right side of the first
+# pair (the verification equation is always against G2, only the tau
+# side depends on the trusted setup).
+G2_GEN_AFFINE = (_mont2(G2_X), _mont2(G2_Y))
+
+
+def _expand0(pt):
+    return tuple(c[None] for c in pt)
+
+
+def verify_kzg_proof_batch(
+    pts_g1_aff, scalar_bits, lane_mask, aux_g1_aff, aux_mask, tau_g2_aff
+):
+    """Scalar bool: the folded batch identity over 3N+1 G1 lanes.
+
+    pts_g1_aff: (x, y) bundles (3N, 1, NB) in the lane layout above.
+    scalar_bits: (3N, SCALAR_BITS) int32, LSB-first per lane.
+    lane_mask: (3N,) bool; False lanes enter the ladder as identity.
+    aux_g1_aff: (x, y) bundles (1, 1, NB) — the -[sum r_i y_i]G1 point.
+    aux_mask: (1,) bool (False when sum r_i y_i == 0 -> infinity).
+    tau_g2_aff: (x, y) bundles (1, 2, NB) — [tau]G2 from the setup.
+    """
+    L = lane_mask.shape[0]
+    n = L // 3
+    pts = curve.PG1.from_affine(pts_g1_aff, lane_mask)
+    with span("trace/kzg_rlc_ladder"):
+        pts_r = curve.PG1.mul_scalar_bits(pts, scalar_bits)
+
+    aux = curve.PG1.from_affine(aux_g1_aff, aux_mask)
+    with span("trace/kzg_pair_fold"):
+        lhs_lanes = tuple(
+            jnp.concatenate([c[: 2 * n], a], axis=0)
+            for c, a in zip(pts_r, aux)
+        )
+        lhs = curve.PG1.sum_axis(lhs_lanes, axis=0)
+        w_sum = curve.PG1.sum_axis(
+            tuple(c[2 * n :] for c in pts_r), axis=0
+        )
+    lhs_x, lhs_y, lhs_inf = curve.PG1.to_affine(_expand0(lhs))
+    w_x, w_y, w_inf = curve.PG1.to_affine(
+        _expand0(curve.PG1.neg(w_sum))
+    )
+
+    g2_gen = (
+        jnp.asarray(G2_GEN_AFFINE[0]),
+        jnp.asarray(G2_GEN_AFFINE[1]),
+    )
+    g1_side = (
+        jnp.concatenate([lhs_x, w_x], axis=0),
+        jnp.concatenate([lhs_y, w_y], axis=0),
+    )
+    g2_side = (
+        jnp.concatenate([g2_gen[0], tau_g2_aff[0]], axis=0),
+        jnp.concatenate([g2_gen[1], tau_g2_aff[1]], axis=0),
+    )
+    pair_mask = jnp.concatenate([~lhs_inf, ~w_inf], axis=0)
+    return pairing.multi_pairing_is_one(g1_side, g2_side, pair_mask)

@@ -33,7 +33,10 @@ def _shard_map(f, mesh, in_specs, out_specs):
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False,
         )
-    except TypeError:
+    except (TypeError, AttributeError):
+        # TypeError: newer jax without the check_vma kwarg;
+        # AttributeError: jax builds with no top-level jax.shard_map at
+        # all — both fall back to the experimental entry point
         from jax.experimental.shard_map import shard_map as _sm
 
         return _sm(

@@ -25,10 +25,13 @@ COL_BLOCK = b"blk"
 COL_HOT_STATE = b"hst"
 COL_COLD_STATE = b"cst"
 COL_BLOCK_ROOTS = b"bri"  # slot -> block root (canonical chain index)
+COL_BLOB_SIDECAR = b"bsc"  # block_root + index -> sidecar SSZ
+COL_BLOB_INDEX = b"bsi"  # slot + block_root + index -> b"" (prune index)
 COL_META = b"meta"
 
 SPLIT_KEY = b"split_slot"
 GENESIS_STATE_KEY = b"genesis_state"
+BLOB_MIN_SLOT_KEY = b"blob_min_slot"  # watermark: oldest indexed sidecar
 
 
 def _u64(v: int) -> bytes:
@@ -90,6 +93,60 @@ class HotColdDB:
     def clear_canonical_block_root(self, slot: int) -> None:
         self.kv.delete(COL_BLOCK_ROOTS, _u64(slot))
 
+    # ------------------------------------------------------ blob sidecars
+
+    def put_blob_sidecar(self, block_root: bytes, sidecar) -> None:
+        """Persist one verified sidecar (blob_sidecar.rs storage role)
+        plus a slot-keyed index row, so retention pruning walks keys
+        only — it never reads a blob."""
+        key = bytes(block_root) + _u64(int(sidecar.index))
+        slot = int(sidecar.signed_block_header.message.slot)
+        self.kv.put(COL_BLOB_SIDECAR, key, sidecar.to_bytes())
+        self.kv.put(COL_BLOB_INDEX, _u64(slot) + key, b"")
+        cur = self.kv.get(COL_META, BLOB_MIN_SLOT_KEY)
+        if cur is None or slot < int.from_bytes(cur, "big"):
+            self.kv.put(COL_META, BLOB_MIN_SLOT_KEY, _u64(slot))
+
+    def get_blob_sidecars(self, block_root: bytes) -> list:
+        """Stored sidecars for a block root, ordered by index — at most
+        MAX_BLOBS_PER_BLOCK direct keyed gets, no column scan."""
+        root = bytes(block_root)
+        out = []
+        for i in range(self.spec.MAX_BLOBS_PER_BLOCK):
+            data = self.kv.get(COL_BLOB_SIDECAR, root + _u64(i))
+            if data is not None:
+                out.append(self.t.BlobSidecar.decode(data))
+        return out
+
+    def prune_blob_sidecars(self, cutoff_slot: int) -> int:
+        """Drop sidecars below `cutoff_slot`; returns the count removed.
+        Driven by the finality migration with the
+        MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS retention window. Walks
+        the slot-keyed index — blob values are never read — and a
+        min-slot watermark skips the key scan entirely when nothing can
+        be below the cutoff (a range-scan KV extension would make the
+        remaining per-epoch scan key-bounded; the interface is get/put/
+        delete/keys today)."""
+        cur = self.kv.get(COL_META, BLOB_MIN_SLOT_KEY)
+        if cur is not None and int.from_bytes(cur, "big") >= cutoff_slot:
+            return 0
+        removed = 0
+        remaining_min = None
+        for key in list(self.kv.keys(COL_BLOB_INDEX)):
+            slot = int.from_bytes(key[:8], "big")
+            if slot < cutoff_slot:
+                self.kv.delete(COL_BLOB_SIDECAR, key[8:])
+                self.kv.delete(COL_BLOB_INDEX, key)
+                removed += 1
+            elif remaining_min is None or slot < remaining_min:
+                remaining_min = slot
+        self.kv.put(
+            COL_META,
+            BLOB_MIN_SLOT_KEY,
+            _u64(remaining_min if remaining_min is not None else cutoff_slot),
+        )
+        return removed
+
     # ------------------------------------------------------------- states
 
     def put_hot_state(self, state) -> None:
@@ -128,6 +185,14 @@ class HotColdDB:
                 self.kv.put(COL_COLD_STATE, key, data)
             self.kv.delete(COL_HOT_STATE, key)
         self.kv.put(COL_META, SPLIT_KEY, _u64(finalized_slot))
+        # blob retention window: sidecars are a serving obligation, not
+        # history — prune everything older than the window behind
+        # finality (deneb MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS)
+        retention_slots = (
+            self.spec.MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS
+            * self.spec.SLOTS_PER_EPOCH
+        )
+        self.prune_blob_sidecars(max(0, finalized_slot - retention_slots))
 
     # -------------------------------------------------- state reconstruction
 

@@ -145,3 +145,4 @@ class BackgroundMigrator:
         ]
         chain.op_pool.prune_attestations(finalized_epoch)
         chain.observed_attesters.prune(finalized_epoch)
+        chain.da_checker.prune(finalized_slot)

@@ -9,7 +9,7 @@ records its schema version; on open, registered migrations run stepwise
 META_COLUMN = b"meta"
 SCHEMA_KEY = b"schema_version"
 
-CURRENT_SCHEMA_VERSION = 2
+CURRENT_SCHEMA_VERSION = 3
 
 
 class SchemaError(Exception):
@@ -87,4 +87,30 @@ def _v2_to_v1(kv):
         if len(key) == 9 and key[:1] == b"s":
             val = kv.get(col, key)
             kv.put(col, key[1:], val)
+            kv.delete(col, key)
+
+
+# ---------------------------------------------------------- v2 <-> v3
+# v3 adds the blob-sidecar columns (b"bsc" data + b"bsi" slot index).
+# New columns need no data transform on upgrade; the downgrade drops
+# them so a v2 reader never sees keys it cannot interpret.
+#
+# v3 also changed the BELLATRIX block/body wire shape (the
+# blob_kzg_commitments field). No stored-block rewrite is needed: every
+# shipped network config (mainnet/minimal/gnosis config.yaml) pins
+# BELLATRIX_FORK_EPOCH at FAR_FUTURE, so a durable v2 store cannot
+# contain bellatrix-encoded blocks — phase0/altair encodings are
+# untouched. A future PR that activates bellatrix on a persistent
+# network must ship a block-rewriting migration alongside it.
+
+
+@register_migration(2, 3)
+def _v2_to_v3(kv):
+    pass
+
+
+@register_migration(3, 2)
+def _v3_to_v2(kv):
+    for col in (b"bsc", b"bsi"):
+        for key in list(kv.keys(col)):
             kv.delete(col, key)

@@ -31,6 +31,8 @@ Domain = ssz.bytes32
 BLSPubkey = ssz.bytes48
 BLSSignature = ssz.bytes96
 ParticipationFlags = ssz.uint8
+KZGCommitment = ssz.bytes48
+KZGProof = ssz.bytes48
 
 _CACHE: dict[str, SimpleNamespace] = {}
 
@@ -242,6 +244,9 @@ def types_for(spec: Spec) -> SimpleNamespace:
         )
         sync_aggregate: SyncAggregate
         execution_payload: ExecutionPayload
+        blob_kzg_commitments: ssz.List(
+            KZGCommitment, spec.MAX_BLOB_COMMITMENTS_PER_BLOCK
+        )
 
     class BlindedBeaconBlockBodyBellatrix(ssz.Container):
         """Bellatrix body with the payload replaced by its header — the
@@ -264,6 +269,9 @@ def types_for(spec: Spec) -> SimpleNamespace:
         )
         sync_aggregate: SyncAggregate
         execution_payload_header: ExecutionPayloadHeader
+        blob_kzg_commitments: ssz.List(
+            KZGCommitment, spec.MAX_BLOB_COMMITMENTS_PER_BLOCK
+        )
 
     # ------------------------------------------------------- builder types
 
@@ -472,12 +480,39 @@ def types_for(spec: Spec) -> SimpleNamespace:
         block_number: ssz.uint64
         index: ssz.uint64
 
+    # ------------------------------------------------ blob data availability
+
+    Blob = ssz.ByteVector(
+        spec.FIELD_ELEMENTS_PER_BLOB * spec.BYTES_PER_FIELD_ELEMENT
+    )
+
+    class BlobSidecar(ssz.Container):
+        """Deneb-shaped blob sidecar (consensus/types/src/blob_sidecar.rs):
+        one blob + its KZG commitment/proof, bound to a block by the
+        signed header. Gossiped on `blob_sidecar_{subnet}` topics and
+        gated through the DataAvailabilityChecker before the block it
+        belongs to may import."""
+
+        index: ssz.uint64
+        blob: Blob
+        kzg_commitment: KZGCommitment
+        kzg_proof: KZGProof
+        signed_block_header: SignedBeaconBlockHeader
+
+    class BlobIdentifier(ssz.Container):
+        """(block_root, index) — the by-root RPC request key for a
+        sidecar (deneb p2p spec BlobIdentifier)."""
+
+        block_root: Root
+        index: ssz.uint64
+
     ns = SimpleNamespace(**{
         k: v
         for k, v in locals().items()
         if isinstance(v, type) and issubclass(v, ssz.Container)
     })
     ns.spec = spec
+    ns.Blob = Blob
 
     # fork dispatch tables
     ns.block_body_classes = {

@@ -126,6 +126,19 @@ class Spec:
     # 64 `beacon_attestation_{id}` topics)
     ATTESTATION_SUBNET_COUNT: int = 64
 
+    # blob data-availability plane (EIP-4844 / deneb-shaped, served by
+    # the in-repo KZG subsystem — lighthouse_tpu.kzg). Blob size must be
+    # a power of two; the dev trusted setup is built lazily per size.
+    FIELD_ELEMENTS_PER_BLOB: int = 4096
+    BYTES_PER_FIELD_ELEMENT: int = 32
+    MAX_BLOBS_PER_BLOCK: int = 6
+    MAX_BLOB_COMMITMENTS_PER_BLOCK: int = 4096
+    BLOB_SIDECAR_SUBNET_COUNT: int = 6
+    # retention window: sidecars older than this many epochs behind the
+    # finalized slot are pruned from the store (deneb
+    # MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS)
+    MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS: int = 4096
+
     # bellatrix (merge) — execution payload sizes + penalty variants
     # (consensus/types/src/eth_spec.rs MaxBytesPerTransaction etc.,
     # chain_spec.rs *_bellatrix fields)
@@ -296,6 +309,13 @@ def minimal_spec(**overrides) -> Spec:
         GENESIS_FORK_VERSION=bytes.fromhex("00000001"),
         ALTAIR_FORK_VERSION=bytes.fromhex("01000001"),
         BELLATRIX_FORK_VERSION=bytes.fromhex("02000001"),
+        # tiny blobs keep the dev trusted setup and the KZG data plane
+        # fast enough for in-process testing (minimal-preset role)
+        FIELD_ELEMENTS_PER_BLOB=4,
+        MAX_BLOBS_PER_BLOCK=4,
+        MAX_BLOB_COMMITMENTS_PER_BLOCK=16,
+        BLOB_SIDECAR_SUBNET_COUNT=4,
+        MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS=4,
     )
     return replace(base, **overrides) if overrides else base
 

@@ -76,7 +76,10 @@ def non_subgroup_signature() -> bytes:
 
 
 def main():
-    shutil.rmtree(VECTOR_ROOT, ignore_errors=True)
+    # rewrite only the runners THIS script owns — tests/vectors/external
+    # holds hand-committed RFC/EIP vectors from independent sources
+    for runner in ("bls", "hash_to_curve", "serialization", "kzg"):
+        shutil.rmtree(os.path.join(VECTOR_ROOT, runner), ignore_errors=True)
 
     # ---- bls/sign -------------------------------------------------------
     messages = [b"", b"\x5a" * 32, b"lighthouse-tpu conformance", b"\xff"]
@@ -363,6 +366,104 @@ def main():
         "meta",
         "dst",
         {"dst": "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"},
+    )
+
+    # ---- kzg: blob -> commitment -> proof against the dev setup ---------
+    # Byte-pinned like the bls tree: any drift in the dev trusted setup,
+    # the challenge DST, the MSM, or the quotient construction changes
+    # these files. The TPU batch verifier is checked against the same
+    # cases (valid AND corrupted) in tests/test_kzg.py.
+    from lighthouse_tpu import kzg  # noqa: E402
+
+    kzg_n = 8  # vector blob size: 8 field elements (independent of spec)
+
+    def mk_blob(seed: int) -> bytes:
+        return b"".join(
+            ((seed * 1000003 + i * 7919 + 1) % (2**200)).to_bytes(32, "big")
+            for i in range(kzg_n)
+        )
+
+    for i in range(3):
+        blob = mk_blob(i)
+        comm = kzg.blob_to_kzg_commitment(blob)
+        proof = kzg.compute_blob_kzg_proof(blob, comm)
+        write_case(
+            "kzg",
+            "blob_to_commitment",
+            f"blob_{i}",
+            {"input": {"blob": hx(blob)}, "output": hx(comm)},
+        )
+        write_case(
+            "kzg",
+            "verify_blob_proof",
+            f"valid_{i}",
+            {
+                "input": {
+                    "blob": hx(blob),
+                    "commitment": hx(comm),
+                    "proof": hx(proof),
+                },
+                "output": True,
+            },
+        )
+    blob = mk_blob(0)
+    comm = kzg.blob_to_kzg_commitment(blob)
+    proof = kzg.compute_blob_kzg_proof(blob, comm)
+    other_blob = mk_blob(1)
+    other_comm = kzg.blob_to_kzg_commitment(other_blob)
+    other_proof = kzg.compute_blob_kzg_proof(other_blob, other_comm)
+    corrupt_cases = [
+        # a valid G1 point that is not the right opening proof
+        ("wrong_proof", blob, comm, other_proof, False),
+        # commitment/blob mismatch (proof bound to the other pair)
+        ("wrong_commitment", blob, other_comm, proof, False),
+        # blob tampered after proving (first element replaced)
+        (
+            "tampered_blob",
+            (99).to_bytes(32, "big") + blob[32:],
+            comm,
+            proof,
+            False,
+        ),
+        # zero polynomial: commitment and proof are both infinity
+        (
+            "zero_blob",
+            b"\x00" * (32 * kzg_n),
+            kzg.blob_to_kzg_commitment(b"\x00" * (32 * kzg_n)),
+            kzg.compute_blob_kzg_proof(
+                b"\x00" * (32 * kzg_n),
+                kzg.blob_to_kzg_commitment(b"\x00" * (32 * kzg_n)),
+            ),
+            True,
+        ),
+    ]
+    for name, b, c, pr, expect in corrupt_cases:
+        write_case(
+            "kzg",
+            "verify_blob_proof",
+            name,
+            {
+                "input": {
+                    "blob": hx(b),
+                    "commitment": hx(c),
+                    "proof": hx(pr),
+                },
+                "output": expect,
+            },
+        )
+    write_case(
+        "kzg",
+        "meta",
+        "setup",
+        {
+            "dev_secret_seed": kzg.trusted_setup.DEV_SECRET_SEED.decode(),
+            "size": kzg_n,
+            "tau_g2": {
+                "x_re": hex(kzg.dev_setup(kzg_n).tau_g2[0][0]),
+                "x_im": hex(kzg.dev_setup(kzg_n).tau_g2[0][1]),
+            },
+            "challenge_dst": kzg.api.CHALLENGE_DST.decode(),
+        },
     )
 
     n = sum(len(fs) for _, _, fs in os.walk(VECTOR_ROOT))

@@ -311,6 +311,49 @@ def test_external_eip2335_scrypt_keystore():
         ks.decrypt(password + "x")
 
 
+def test_kzg_blob_to_commitment_vectors():
+    """kzg runner: blob -> commitment MSM against the committed dev-
+    setup vectors (gen_vectors.py kzg section)."""
+    from lighthouse_tpu import kzg
+
+    for name, case in _load("kzg", "blob_to_commitment"):
+        got = kzg.blob_to_kzg_commitment(_unhex(case["input"]["blob"]))
+        assert got == _unhex(case["output"]), name
+
+
+def test_kzg_verify_blob_proof_vectors():
+    """kzg runner: reference verification over the valid + corrupted
+    proof cases (the TPU backend is checked against the same files in
+    tests/test_kzg.py's slow tier)."""
+    from lighthouse_tpu import kzg
+
+    cases = _load("kzg", "verify_blob_proof")
+    assert any(case["output"] for _, case in cases)
+    assert any(not case["output"] for _, case in cases)
+    for name, case in cases:
+        i = case["input"]
+        got = kzg.verify_blob_kzg_proof(
+            _unhex(i["blob"]), _unhex(i["commitment"]), _unhex(i["proof"])
+        )
+        assert got is case["output"], name
+
+
+def test_kzg_meta_setup():
+    """kzg meta: the committed dev-setup parameters match the in-tree
+    derivation (a drifted DEV_SECRET_SEED or challenge DST rewrites
+    this file)."""
+    from lighthouse_tpu import kzg
+
+    (_, case), = _load("kzg", "meta")
+    assert case["dev_secret_seed"] == (
+        kzg.trusted_setup.DEV_SECRET_SEED.decode()
+    )
+    assert case["challenge_dst"] == kzg.api.CHALLENGE_DST.decode()
+    s = kzg.dev_setup(case["size"])
+    assert hex(s.tau_g2[0][0]) == case["tau_g2"]["x_re"]
+    assert hex(s.tau_g2[0][1]) == case["tau_g2"]["x_im"]
+
+
 def test_zz_all_vector_files_consumed():
     """check_all_files_accessed.py analog (Makefile:105). Named zz_ so it
     runs after every handler in this module."""

@@ -1,0 +1,132 @@
+"""KZG polynomial-commitment subsystem: proof round-trips, RLC batch
+folding, validation errors, and reference-vs-TPU agreement on the
+committed vectors.
+
+The vector-vs-reference byte checks live in
+tests/test_conformance_vectors.py (kzg runner, where the
+every-vector-consumed gate tracks the files); here the same committed
+cases feed the slow-tier TPU agreement test."""
+
+import json
+import os
+
+import pytest
+
+from lighthouse_tpu import kzg
+from lighthouse_tpu.kzg.api import KzgError
+
+VECTOR_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "vectors", "kzg"
+)
+
+
+def _load(handler):
+    d = os.path.join(VECTOR_DIR, handler)
+    out = {}
+    for name in sorted(os.listdir(d)):
+        with open(os.path.join(d, name)) as f:
+            out[name.removesuffix(".json")] = json.load(f)
+    return out
+
+
+def _unhex(s):
+    return bytes.fromhex(s[2:])
+
+
+def test_proof_roundtrip_at_arbitrary_point():
+    n = 4
+    blob = b"".join((3 * i + 2).to_bytes(32, "big") for i in range(n))
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    z = 0xDEADBEEF
+    proof, y = kzg.compute_kzg_proof(blob, z)
+    assert y == kzg.evaluate_polynomial(kzg.blob_to_polynomial(blob), z)
+    assert kzg.verify_kzg_proof(commitment, z, y, proof)
+    # a wrong claimed evaluation fails
+    assert not kzg.verify_kzg_proof(commitment, z, y + 1, proof)
+
+
+def test_batch_rejects_single_bad_proof():
+    """The RLC fold must not let one forged proof hide behind N-1 valid
+    ones (the soundness property the per-set RLC of the signature batch
+    verifier relies on)."""
+    n = 4
+    blobs, comms, proofs = [], [], []
+    for k in range(3):
+        blob = b"".join(
+            ((7 * k + i + 1) % 97).to_bytes(32, "big") for i in range(n)
+        )
+        comm = kzg.blob_to_kzg_commitment(blob)
+        blobs.append(blob)
+        comms.append(comm)
+        proofs.append(kzg.compute_blob_kzg_proof(blob, comm))
+    assert kzg.verify_blob_kzg_proof_batch(blobs, comms, proofs, seed=11)
+    bad = list(proofs)
+    bad[1] = proofs[0]  # valid G1 point, wrong opening
+    assert not kzg.verify_blob_kzg_proof_batch(blobs, comms, bad, seed=11)
+    # empty batch is trivially available
+    assert kzg.verify_blob_kzg_proof_batch([], [], [])
+
+
+def test_blob_validation_errors():
+    with pytest.raises(KzgError):
+        kzg.blob_to_polynomial(b"\x00" * 33)  # not a multiple of 32
+    with pytest.raises(KzgError):
+        kzg.blob_to_polynomial(b"\xff" * 32)  # >= r, non-canonical
+    with pytest.raises(KzgError):
+        kzg.verify_blob_kzg_proof_batch([b"\x00" * 32], [], [])
+    # malformed compressed points are a KzgError, not a crash
+    blob = (5).to_bytes(32, "big") * 2
+    comm = kzg.blob_to_kzg_commitment(blob)
+    proof = kzg.compute_blob_kzg_proof(blob, comm)
+    with pytest.raises(KzgError):
+        kzg.verify_blob_kzg_proof(blob, b"\x00" * 48, proof)
+
+
+def test_dev_setup_is_deterministic_and_cached():
+    a = kzg.dev_setup(4)
+    b = kzg.dev_setup(4)
+    assert a is b
+    assert a.g1_powers[0] is not None
+    # the committed meta vector is checked against the derivation in
+    # tests/test_conformance_vectors.py::test_kzg_meta_setup
+
+
+@pytest.mark.slow
+def test_tpu_batch_matches_reference():
+    """Device RLC fold + two-pair multi-pairing agrees with the
+    reference on the committed vectors — valid sets, a corrupted set,
+    and the mixed singles. Slow tier: the first call compiles the
+    255-bit ladder + Miller graph (cached in .jax_cache afterwards)."""
+    cases = _load("verify_blob_proof")
+    valid = [c["input"] for c in cases.values() if c["output"]]
+    blobs = [_unhex(i["blob"]) for i in valid]
+    comms = [_unhex(i["commitment"]) for i in valid]
+    proofs = [_unhex(i["proof"]) for i in valid]
+    for backend in ("ref", "tpu"):
+        assert kzg.verify_blob_kzg_proof_batch(
+            blobs, comms, proofs, backend=backend, seed=3
+        ), backend
+    bad = list(proofs)
+    bad[0], bad[1] = bad[1], bad[0]
+    for backend in ("ref", "tpu"):
+        assert not kzg.verify_blob_kzg_proof_batch(
+            blobs, comms, bad, backend=backend, seed=3
+        ), backend
+    # per-case agreement including the corrupted singles
+    for name, case in cases.items():
+        i = case["input"]
+        ref = kzg.verify_blob_kzg_proof_batch(
+            [_unhex(i["blob"])],
+            [_unhex(i["commitment"])],
+            [_unhex(i["proof"])],
+            backend="ref",
+            seed=5,
+        )
+        tpu = kzg.verify_blob_kzg_proof_batch(
+            [_unhex(i["blob"])],
+            [_unhex(i["commitment"])],
+            [_unhex(i["proof"])],
+            backend="tpu",
+            seed=5,
+        )
+        assert ref is tpu is case["output"], name
