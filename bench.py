@@ -28,6 +28,7 @@ Env knobs:
   BENCH_REQUIRE_TPU=1       exit(3) instead of any CPU fallback/replay
   BENCH_SMOKE=1             small batch
   BENCH_CONFIG=oppool32k|sync512|block|replay32   BASELINE configs #4/#2/#3/#5
+  BENCH_CONFIG=kzg|kzgfold  KZG producer MSM / verify fold-factor configs
 """
 
 import json
@@ -137,6 +138,8 @@ def _active_metric():
         "block": "block_signature_verify_throughput",
         "replay32": "epoch_replay_slots_per_sec",
         "grouped64": "grouped_verify_throughput",
+        "kzg": "kzg_commit_msm_throughput",
+        "kzgfold": "kzg_batch_fold_factor",
     }.get(cfg, "verify_signature_sets_throughput")
 
 
@@ -269,6 +272,10 @@ def _measure(jax, platform):
         return bench_replay.measure(jax, platform)
     if config == "grouped64":
         return _measure_grouped(jax, platform)
+    if config == "kzg":
+        return _measure_kzg_msm(jax, platform)
+    if config == "kzgfold":
+        return _measure_kzg_fold(jax, platform)
     return _measure_sigsets(jax, platform)
 
 
@@ -471,6 +478,119 @@ def _measure_grouped(jax, platform):
         "valid_for_headline": bool(
             on_tpu and n_sets >= 30720 and n_groups >= 64
         ),
+    }
+
+
+def _measure_kzg_msm(jax, platform):
+    """KZG producer-path commit MSM: blob -> commitment on the
+    fixed-base windowed device graph (ops/msm.py) at blob size
+    BENCH_NSETS field elements (default 4096, the mainnet shape; the
+    watcher also sweeps 4 — the minimal preset). Warm-up pays the
+    one-time setup/table build and compile; timed reps measure the
+    steady-state dispatch the block producer sees (one MSM per blob
+    plus one per proof)."""
+    from lighthouse_tpu import kzg
+
+    if platform == "cpu":
+        n, reps = 8, 3  # prove the path only
+    else:
+        n = int(os.environ.get("BENCH_NSETS") or 4096)
+        reps = 5
+    setup = kzg.dev_setup(n)
+    blob = b"".join(
+        ((i * 2654435761 + 11) % (2**200)).to_bytes(32, "big")
+        for i in range(n)
+    )
+    t0 = time.perf_counter()
+    first = kzg.blob_to_kzg_commitment(blob, setup, backend="tpu")
+    compile_s = time.perf_counter() - t0
+    assert first == kzg.blob_to_kzg_commitment(blob, setup), (
+        "kzg: device commitment disagrees with the host oracle"
+    )
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        kzg.blob_to_kzg_commitment(blob, setup, backend="tpu")
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[len(times) // 2]
+    on_tpu = platform in ("tpu", "axon")
+    return {
+        "metric": "kzg_commit_msm_throughput",
+        "value": round(n / p50, 2),
+        "unit": "points/sec",
+        "vs_baseline": 0.0,  # no published reference number for this shape
+        "platform": platform,
+        "impl": "msm_fixed_base",
+        "n_sets": n,
+        "p50_s": round(p50, 4),
+        "compile_s": round(compile_s, 1),
+        "valid_for_headline": bool(on_tpu and n >= 4096),
+    }
+
+
+def _measure_kzg_fold(jax, platform):
+    """ops/kzg_verify fold factor on device (the ROADMAP's pending
+    hardware numbers): N sidecar proof checks folded into ONE two-pair
+    multi-pairing vs N independent N=1 batch checks, both on the tpu
+    backend. BENCH_NSETS = N (default 8; PERF_NOTES has the
+    ref-backend curve: 0.89x/2.69x/5.10x at N=1/4/8)."""
+    from lighthouse_tpu import kzg
+
+    if platform == "cpu":
+        n, blob_n, reps = 2, 4, 2  # prove the path only
+    else:
+        n = int(os.environ.get("BENCH_NSETS") or 8)
+        blob_n, reps = 4, 5
+    setup = kzg.dev_setup(blob_n)
+    blobs, comms, proofs = [], [], []
+    for k in range(n):
+        blob = b"".join(
+            ((k * 997 + i * 31 + 1) % (2**128)).to_bytes(32, "big")
+            for i in range(blob_n)
+        )
+        comm = kzg.blob_to_kzg_commitment(blob, setup)
+        blobs.append(blob)
+        comms.append(comm)
+        proofs.append(kzg.compute_blob_kzg_proof(blob, comm, setup))
+
+    def batch_once():
+        assert kzg.verify_blob_kzg_proof_batch(
+            blobs, comms, proofs, backend="tpu", setup=setup, seed=7
+        )
+
+    def singles_once():
+        for b, c, p in zip(blobs, comms, proofs):
+            assert kzg.verify_blob_kzg_proof_batch(
+                [b], [c], [p], backend="tpu", setup=setup, seed=7
+            )
+
+    t0 = time.perf_counter()
+    batch_once()
+    singles_once()
+    compile_s = time.perf_counter() - t0
+    batch_t, singles_t = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        batch_once()
+        batch_t.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        singles_once()
+        singles_t.append(time.perf_counter() - t0)
+    batch_p50 = sorted(batch_t)[len(batch_t) // 2]
+    singles_p50 = sorted(singles_t)[len(singles_t) // 2]
+    on_tpu = platform in ("tpu", "axon")
+    return {
+        "metric": "kzg_batch_fold_factor",
+        "value": round(singles_p50 / batch_p50, 3),
+        "unit": "x",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "impl": "kzg_rlc_fold",
+        "n_sets": n,
+        "p50_s": round(batch_p50, 4),
+        "singles_p50_s": round(singles_p50, 4),
+        "compile_s": round(compile_s, 1),
+        "valid_for_headline": bool(on_tpu and n >= 8),
     }
 
 

@@ -66,6 +66,11 @@ _COMMITMENTS = REGISTRY.counter(
     "lighthouse_tpu_kzg_commitments_computed_total",
     "blob -> commitment MSMs computed",
 )
+_MSM_SECONDS = REGISTRY.histogram_vec(
+    "lighthouse_tpu_kzg_msm_seconds",
+    "KZG commitment/proof MSM wall time by backend and op",
+    ("backend", "op"),
+)
 
 
 class KzgError(Exception):
@@ -114,8 +119,11 @@ def _setup_for(poly_len: int, setup: TrustedSetup | None) -> TrustedSetup:
     return s
 
 
-def _g1_lincomb(points_affine, scalars):
-    """Reference MSM: sum [s_i]P_i (host bigint; None = infinity)."""
+def _g1_lincomb_naive(points_affine, scalars):
+    """Pre-Pippenger reference MSM: one full double-add ladder per
+    point (~255N doublings + ~128N adds). Kept as the
+    oracle-of-the-oracle: tests pin `_g1_lincomb` against it, and
+    scripts/bench_msm.py measures the Pippenger speedup against it."""
     acc = G1_GROUP.infinity
     for aff, s in zip(points_affine, scalars, strict=True):
         if aff is None or s % R == 0:
@@ -126,25 +134,113 @@ def _g1_lincomb(points_affine, scalars):
     return acc
 
 
+def _pippenger_window_bits(n: int) -> int:
+    """Window width minimizing the bucketed work model
+    ceil(255/c) * (n point inserts + 2*2^c bucket-aggregation adds)."""
+    return min(
+        range(2, 16),
+        key=lambda c: -(-255 // c) * (n + 2 * (1 << c)),
+    )
+
+
+def _g1_lincomb(points_affine, scalars):
+    """Reference MSM: sum [s_i]P_i (host bigint; None = infinity).
+
+    Windowed Pippenger: per c-bit window, each point lands in the
+    bucket of its window digit (n adds), buckets aggregate by the
+    double running sum (2*(2^c - 1) adds), and windows combine
+    MSB-first with c doublings each — ~ceil(255/c)*(n + 2^(c+1)) group
+    ops against the naive ladder's ~383n (8.6x measured at n = 4096,
+    PERF_NOTES.md). Stays pure host bigint: this is the oracle the
+    device MSM graphs (ops/msm.py) are verified against."""
+    pts, ss = [], []
+    for aff, s in zip(points_affine, scalars, strict=True):
+        s %= R
+        if aff is None or s == 0:
+            continue
+        pts.append(G1_GROUP.from_affine(aff))
+        ss.append(s)
+    n = len(pts)
+    if n == 0:
+        return G1_GROUP.infinity
+    c = _pippenger_window_bits(n)
+    n_windows = -(-255 // c)
+    digit_mask = (1 << c) - 1
+    acc = G1_GROUP.infinity
+    for w in reversed(range(n_windows)):
+        if w != n_windows - 1:
+            for _ in range(c):
+                acc = G1_GROUP.double(acc)
+        buckets = [None] * (1 << c)
+        for pt, s in zip(pts, ss):
+            d = (s >> (c * w)) & digit_mask
+            if d:
+                b = buckets[d]
+                buckets[d] = pt if b is None else G1_GROUP.add(b, pt)
+        # window sum = sum_d d * bucket_d via the double running sum
+        running = G1_GROUP.infinity
+        window = G1_GROUP.infinity
+        started = False
+        for d in range(digit_mask, 0, -1):
+            b = buckets[d]
+            if b is not None:
+                running = G1_GROUP.add(running, b)
+                started = True
+            if started:
+                window = G1_GROUP.add(window, running)
+        acc = G1_GROUP.add(acc, window)
+    return acc
+
+
+def _msm_backend(scalars, setup: TrustedSetup, backend: str):
+    """Producer-side MSM dispatch over the setup's G1 powers — the same
+    ref|tpu|fake selection surface as `verify_blob_kzg_proof_batch`.
+    Returns a Jacobian point (compression happens at the caller)."""
+    n = len(scalars)
+    if backend == "ref":
+        return _g1_lincomb(setup.g1_powers[:n], scalars)
+    if backend == "tpu":
+        from lighthouse_tpu.kzg.tpu_backend import g1_msm_fixed_base_tpu
+
+        return g1_msm_fixed_base_tpu(scalars, setup)
+    if backend == "fake":
+        # fake crypto plane: commitments/proofs are structural bytes
+        # only (the fake verifier accepts everything), so the identity
+        # point — cheap and round-trippable — stands in
+        return G1_GROUP.infinity
+    raise KzgError(f"unknown KZG backend {backend!r}")
+
+
 # ----------------------------------------------------- commitment / proof
 
 
 def blob_to_kzg_commitment(
-    blob: bytes, setup: TrustedSetup | None = None
+    blob: bytes,
+    setup: TrustedSetup | None = None,
+    backend: str = "ref",
 ) -> bytes:
-    """Commit to the blob: C = sum_i b_i [tau^i]G1, compressed."""
+    """Commit to the blob: C = sum_i b_i [tau^i]G1, compressed. The MSM
+    runs on the selected backend (ref = host Pippenger oracle, tpu =
+    fixed-base windowed device graph, fake = identity); all real
+    backends produce identical bytes."""
     poly = blob_to_polynomial(blob)
     s = _setup_for(len(poly), setup)
     _COMMITMENTS.inc()
-    with span("kzg/commit_msm", n=len(poly)):
-        return g1_compress(_g1_lincomb(s.g1_powers[: len(poly)], poly))
+    with _MSM_SECONDS.labels(backend, "commit").time(), span(
+        "kzg/commit_msm", n=len(poly), backend=backend
+    ):
+        return g1_compress(_msm_backend(poly, s, backend))
 
 
 def compute_kzg_proof(
-    blob: bytes, z: int, setup: TrustedSetup | None = None
+    blob: bytes,
+    z: int,
+    setup: TrustedSetup | None = None,
+    backend: str = "ref",
 ) -> tuple:
     """KZG opening proof at z: W = commit((p(X) - p(z)) / (X - z)).
-    Returns (proof_bytes48, y = p(z))."""
+    Returns (proof_bytes48, y = p(z)). The quotient MSM runs on the
+    selected backend, like `blob_to_kzg_commitment`."""
     poly = blob_to_polynomial(blob)
     s = _setup_for(len(poly), setup)
     z %= R
@@ -155,8 +251,10 @@ def compute_kzg_proof(
     for i in range(len(poly) - 1, 0, -1):
         carry = (carry * z + poly[i]) % R
         q[i - 1] = carry
-    with span("kzg/proof_msm", n=len(q)):
-        proof = g1_compress(_g1_lincomb(s.g1_powers[: len(q)], q))
+    with _MSM_SECONDS.labels(backend, "proof").time(), span(
+        "kzg/proof_msm", n=len(q), backend=backend
+    ):
+        proof = g1_compress(_msm_backend(q, s, backend))
     return proof, y
 
 
@@ -172,12 +270,15 @@ def compute_challenge(blob: bytes, commitment: bytes) -> int:
 
 
 def compute_blob_kzg_proof(
-    blob: bytes, commitment: bytes, setup: TrustedSetup | None = None
+    blob: bytes,
+    commitment: bytes,
+    setup: TrustedSetup | None = None,
+    backend: str = "ref",
 ) -> bytes:
     """Proof for the blob at its own Fiat-Shamir challenge point — the
     sidecar-production path (c-kzg compute_blob_kzg_proof)."""
     proof, _ = compute_kzg_proof(
-        blob, compute_challenge(blob, commitment), setup
+        blob, compute_challenge(blob, commitment), setup, backend=backend
     )
     return proof
 

@@ -1,10 +1,20 @@
-"""TPU backend for `verify_blob_kzg_proof_batch`: host marshal -> device.
+"""TPU backend for the KZG data plane: host marshal -> device.
 
-Host work (bigint, policy): challenge hashing, polynomial evaluation,
-point decompression + subgroup checks, RLC sampling, and the single
-fixed-base -[sum r_i y_i]G1 mul. Device work (ops/kzg_verify): the 3N
-RLC scalar ladders, the two pair folds, and the two-pair Miller loop +
-final exponentiation.
+Two device workloads share this boundary:
+
+* `verify_blob_kzg_proof_batch` (consumer side): challenge hashing,
+  polynomial evaluation, decompression + subgroup checks, RLC sampling
+  on the host; the 3N RLC scalar ladders, the two pair folds, and the
+  two-pair Miller loop + final exponentiation on device
+  (ops/kzg_verify).
+
+* `blob_to_kzg_commitment` / `compute_kzg_proof` MSMs (producer side):
+  the commitment/quotient multi-scalar multiplications dispatched to
+  the ops/msm graphs — fixed-base windowed over the trusted setup's
+  cached digit-multiple table (`g1_msm_fixed_base_tpu`), variable-base
+  Pippenger for arbitrary point sets (`g1_msm_tpu`). Host work is
+  signed-digit decomposition plus the one-time table pack (traced as
+  `kzg/msm_table`; dispatches as `kzg/msm_device`).
 
 Lane counts are bucketed to powers of two so recompiles stay
 logarithmic in batch size (same policy as bls/tpu_backend).
@@ -24,6 +34,11 @@ _DEVICE_BATCHES = REGISTRY.counter_vec(
     "lighthouse_tpu_kzg_device_batches_total",
     "KZG device batch dispatches by bucketed lane count",
     ("lanes",),
+)
+_MSM_DEVICE_BATCHES = REGISTRY.counter_vec(
+    "lighthouse_tpu_kzg_msm_device_batches_total",
+    "KZG device MSM dispatches by kind and bucketed lane count",
+    ("kind", "lanes"),
 )
 
 MIN_BUCKET = 2
@@ -115,3 +130,132 @@ def verify_blob_kzg_proof_batch_tpu(
             pts_aff, bits, lane_mask, aux_aff, aux_mask, tau_g2
         )
         return bool(np.asarray(ok))
+
+
+# ------------------------------------------------------------- MSM plane
+
+
+_MSM_JIT: dict = {}
+
+
+def _get_msm_fn(kind: str, c: int):
+    """Jitted MSM graph + affine conversion, one jit object per
+    (graph kind, window width); shape buckets retrace inside it."""
+    key = (kind, c)
+    fn = _MSM_JIT.get(key)
+    if fn is None:
+        import jax
+
+        from lighthouse_tpu.ops import curve
+        from lighthouse_tpu.ops import msm as msm_ops
+
+        graph = (
+            msm_ops.msm_fixed_base if kind == "fixed"
+            else msm_ops.msm_pippenger
+        )
+
+        def run(*args, _graph=graph, _c=c):
+            pt = _graph(*args, c=_c)
+            x, y, inf = curve.PG1.to_affine(pt)
+            return fb.from_mont(x), fb.from_mont(y), inf
+
+        fn = _MSM_JIT[key] = jax.jit(run)
+    return fn
+
+
+def _unpack_affine(x, y, inf):
+    """Device affine canonical limbs -> host Jacobian int point."""
+    if bool(np.asarray(inf).reshape(())):
+        return G1_GROUP.infinity
+    xv = fb.unpack_ints(np.asarray(x))[0]
+    yv = fb.unpack_ints(np.asarray(y))[0]
+    return G1_GROUP.from_affine((xv, yv))
+
+
+def _packed_window_table(setup, bucket: int, c: int):
+    """Device-packed digit-multiple table for `setup`'s first
+    min(bucket, size) G1 powers, padded to `bucket` lanes; cached on
+    the setup alongside the host table it packs. Keyed on the BUCKET,
+    not the exact MSM length: the commitment (n) and quotient-proof
+    (n-1) MSMs share one bucket, so the producer path builds one table
+    per setup, not two — unused tail lanes ride as identity (their
+    padded scalars decompose to all-zero digits, which gather the
+    invalid d=0 row)."""
+    key = ("device", bucket, c)
+    hit = setup._window_tables.get(key)
+    if hit is not None:
+        return hit
+    n_points = min(bucket, setup.size)
+    with span("kzg/msm_table", n=n_points, bucket=bucket, c=c):
+        table = setup.g1_window_table(n_points, c)
+        b1 = len(table[0])  # 2^(c-1) + 1 entries per point
+        xs = np.zeros((bucket, b1, 1, fb.NB), np.int32)
+        ys = np.zeros((bucket, b1, 1, fb.NB), np.int32)
+        valid = np.zeros((bucket, b1), dtype=bool)
+        for i, row in enumerate(table):
+            for d, aff in enumerate(row):
+                if aff is None:
+                    continue
+                xs[i, d, 0] = fb._limbs(aff[0] % P, fb.NB)
+                ys[i, d, 0] = fb._limbs(aff[1] % P, fb.NB)
+                valid[i, d] = True
+        packed = (fb.to_mont(xs), fb.to_mont(ys), valid)
+    setup._window_tables[key] = packed
+    return packed
+
+
+def g1_msm_fixed_base_tpu(scalars, setup, c: int | None = None):
+    """Fixed-base windowed device MSM: sum [s_i] setup.g1_powers[i].
+    Returns a host Jacobian point (the api layer compresses). The
+    per-setup digit-multiple table amortizes over every commitment and
+    proof against the same setup."""
+    from lighthouse_tpu.ops import msm as msm_ops
+
+    if c is None:
+        c = msm_ops.WINDOW_BITS
+    scalars = [s % R for s in scalars]
+    n = len(scalars)
+    if n > setup.size:
+        # the table pack clamps to the setup size; without this guard
+        # extra scalars would silently fold as identity (the ref
+        # backend raises via zip(strict=True) — match it)
+        raise ValueError(
+            f"MSM has {n} scalars but the setup has {setup.size} points"
+        )
+    if n == 0 or all(s == 0 for s in scalars):
+        return G1_GROUP.infinity
+    bucket = _bucket(n)
+    with span("kzg/msm_marshal", kind="fixed", n=n):
+        tx, ty, tv = _packed_window_table(setup, bucket, c)
+        mags, negs = msm_ops.signed_digit_arrays(
+            scalars + [0] * (bucket - n), c
+        )
+    _MSM_DEVICE_BATCHES.labels("fixed", str(bucket)).inc()
+    with span("kzg/msm_device", kind="fixed", lanes=bucket):
+        out = _get_msm_fn("fixed", c)(tx, ty, tv, mags, negs)
+        return _unpack_affine(*out)
+
+
+def g1_msm_tpu(points_affine, scalars, c: int | None = None):
+    """Variable-base Pippenger device MSM over arbitrary affine int
+    points (None = infinity). Returns a host Jacobian point."""
+    from lighthouse_tpu.ops import msm as msm_ops
+
+    if c is None:
+        c = msm_ops.WINDOW_BITS
+    points_affine = list(points_affine)
+    scalars = [s % R for s in scalars]
+    if len(points_affine) != len(scalars):
+        raise ValueError("MSM points and scalars must have equal lengths")
+    n = len(scalars)
+    if n == 0:
+        return G1_GROUP.infinity
+    bucket = _bucket(n)
+    with span("kzg/msm_marshal", kind="pippenger", n=n):
+        pad = bucket - n
+        (px, py), mask = _pack_g1(points_affine + [None] * pad)
+        mags, negs = msm_ops.signed_digit_arrays(scalars + [0] * pad, c)
+    _MSM_DEVICE_BATCHES.labels("pippenger", str(bucket)).inc()
+    with span("kzg/msm_device", kind="pippenger", lanes=bucket):
+        out = _get_msm_fn("pippenger", c)(px, py, mask, mags, negs)
+        return _unpack_affine(*out)

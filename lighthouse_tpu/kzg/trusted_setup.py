@@ -15,7 +15,7 @@ mainnet-sized 4096 setup is only ever built if something asks for it.
 """
 
 import hashlib
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from lighthouse_tpu.crypto.constants import R
 from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
@@ -38,10 +38,98 @@ class TrustedSetup:
     size: int
     g1_powers: tuple  # affine (x, y) int pairs, length `size`
     tau_g2: tuple  # affine twist point ((x0,x1),(y0,y1))
+    # fixed-base MSM digit-multiple tables, keyed (n_points, window c);
+    # a mutable cache field, excluded from equality/hash (the frozen
+    # dataclass freezes the binding, not the dict)
+    _window_tables: dict = field(
+        default_factory=dict, compare=False, repr=False
+    )
 
     @property
     def g1_generator(self):
         return self.g1_powers[0]
+
+    def g1_window_table(self, n_points: int, c: int) -> tuple:
+        """Digit-multiple table for the device fixed-base MSM
+        (`ops.msm.msm_fixed_base`): entry [i][d] is the affine int pair
+        of [d] g1_powers[i] for d = 0..2^(c-1) (d = 0 is None, the
+        identity — signed digits need only magnitudes, the device graph
+        negates y for negative digits). Built ONCE per (n_points, c) on
+        the host and cached on the setup — the setup points are static,
+        which is the whole point of the fixed-base path.
+
+        Cost: n_points * (2^(c-1) - 1) group adds, amortized over every
+        commitment/proof MSM against this setup.
+        """
+        if n_points > self.size:
+            raise ValueError(
+                f"window table wants {n_points} points, setup has "
+                f"{self.size}"
+            )
+        key = (n_points, c)
+        hit = self._window_tables.get(key)
+        if hit is not None:
+            return hit
+        b_max = 1 << (c - 1)
+        jac = []  # the [2]P..[B]P multiples, Jacobian, point-major
+        for aff in self.g1_powers[:n_points]:
+            base = G1_GROUP.from_affine(aff)
+            acc = base
+            for _ in range(b_max - 1):
+                acc = G1_GROUP.add(acc, base)
+                jac.append(acc)
+        affs = _batch_to_affine_g1(jac)  # ONE field inversion total
+        table = tuple(
+            (None, self.g1_powers[i])
+            + tuple(affs[i * (b_max - 1) : (i + 1) * (b_max - 1)])
+            for i in range(n_points)
+        )
+        self._window_tables[key] = table
+        return table
+
+
+def _batch_to_affine_g1(points) -> list:
+    """Jacobian G1 points -> affine int pairs (None = infinity), ONE
+    Fp inversion total via Montgomery's simultaneous-inversion trick
+    (the G2 twin lives in bls/tpu_backend.batch_to_affine_g2)."""
+    F = G1_GROUP.F
+    zs, keep = [], []
+    for i, pt in enumerate(points):
+        if not G1_GROUP.is_infinity(pt):
+            zs.append(pt[2])
+            keep.append(i)
+    out = [None] * len(points)
+    if not zs:
+        return out
+    prefix = [zs[0]]
+    for z in zs[1:]:
+        prefix.append(F.mul(prefix[-1], z))
+    acc = F.inv(prefix[-1])
+    invs = [None] * len(zs)
+    for j in range(len(zs) - 1, 0, -1):
+        invs[j] = F.mul(acc, prefix[j - 1])
+        acc = F.mul(acc, zs[j])
+    invs[0] = acc
+    for j, i in enumerate(keep):
+        x, y, _ = points[i]
+        zi2 = F.sqr(invs[j])
+        out[i] = (F.mul(x, zi2), F.mul(y, F.mul(zi2, invs[j])))
+    return out
+
+
+def g1_generator_multiples(n: int) -> list:
+    """[1]G .. [n]G as affine int pairs — one Jacobian add chain plus
+    one simultaneous inversion. The shared source of cheap distinct G1
+    points (no decompression, no setup build) for the committed MSM
+    vectors, scripts/bench_msm.py, and the MSM test fixtures: one
+    implementation, so the three cannot silently desynchronize."""
+    base = G1_GROUP.generator
+    acc = base
+    jac = []
+    for _ in range(n):
+        jac.append(acc)
+        acc = G1_GROUP.add(acc, base)
+    return _batch_to_affine_g1(jac)
 
 
 _CACHE: dict[int, TrustedSetup] = {}

@@ -1,0 +1,179 @@
+"""Batched device multi-scalar multiplication (MSM) for BLS12-381 G1.
+
+The KZG producer path (blob -> commitment, opening proofs) is one MSM
+per blob plus one per proof: C = sum_i [s_i]P_i over up to 4096 points.
+The naive form is N independent 255-bit double-add ladders — ~255N
+doublings + ~128N adds. This module carries the two classic
+restructurings into the projective-RCB lane discipline of
+`ops.kzg_verify` / `ops.curve.PG1` (complete formulas: identity lanes,
+duplicate points and folded collisions all flow through one branchless
+code path):
+
+* **fixed-base windowed** (`msm_fixed_base`): the trusted setup's G1
+  points are static, so the host precomputes per-point digit multiples
+  [d]P_i for |d| <= 2^(c-1) ONCE per setup (`TrustedSetup
+  .g1_window_table`, cached) and each MSM reduces to W window steps of
+  (gather digit multiple) + (log-depth tree fold over N lanes) + a
+  Horner combine (c doublings + 1 add per window). Group-op count:
+  W*N fold adds + ~255 doublings ~= 266k ops at N=4096/c=4, vs ~1.57M
+  for the naive ladders — and the fold is log2(N)-deep instead of
+  255-step-sequential per point.
+
+* **variable-base Pippenger** (`msm_pippenger`): arbitrary point sets.
+  Signed base-2^c digits put every window digit in [-B, B], B =
+  2^(c-1); per window the B bucket sums are masked tree folds over the
+  N lanes, the bucket-weighted sum T_w = sum_b b*S_b is the standard
+  double running sum (2B adds), and windows combine by the same Horner
+  scan. Op count: W*B*N masked fold adds — the win over the per-lane
+  ladder is DEPTH (log2(N) + 2B + c per window vs 255 sequential
+  add+double steps), which is what XLA scan latency and compile time
+  scale with.
+
+Scalar digit decomposition (`signed_digit_arrays`) happens on the host
+(numpy, exact bigint), mirroring how `ops.kzg_verify` receives
+host-built scalar bit matrices. Both graphs return ONE projective PG1
+point; callers convert via `curve.PG1.to_affine`.
+
+Host-side policy (which points, subgroup checks, setup caching) lives
+in `lighthouse_tpu.kzg`; the pure-bigint Pippenger oracle these graphs
+are verified against is `kzg.api._g1_lincomb`.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import R
+from lighthouse_tpu.ops import curve, fieldb as fb
+
+NB = fb.NB
+
+WINDOW_BITS = 4  # default window width c; B = 2^(c-1) = 8 bucket magnitudes
+SCALAR_BITS = R.bit_length()  # 255
+
+
+def num_windows(c: int = WINDOW_BITS) -> int:
+    """Window count for signed base-2^c digits of scalars < r.
+
+    The top window holds SCALAR_BITS - c*(W0-1) bits plus an incoming
+    carry; an extra window is needed only when that can exceed the
+    signed bound 2^(c-1) (e.g. c=5: 51 windows of 5 bits leave a 5-bit
+    top digit whose carry overflows; c=4 leaves 3 bits and never does).
+    """
+    w0 = -(-SCALAR_BITS // c)
+    top_bits = SCALAR_BITS - c * (w0 - 1)
+    if (1 << top_bits) - 1 + 1 > (1 << (c - 1)):
+        return w0 + 1
+    return w0
+
+
+def signed_digits(s: int, c: int = WINDOW_BITS) -> list:
+    """One scalar -> W signed base-2^c digits, LSB-first, each in
+    [-(2^(c-1) - 1), 2^(c-1)]: sum_w d_w 2^(cw) == s mod r."""
+    s %= R
+    half = 1 << (c - 1)
+    full = 1 << c
+    out = []
+    carry = 0
+    for _ in range(num_windows(c)):
+        t = (s & (full - 1)) + carry
+        s >>= c
+        if t > half:
+            out.append(t - full)
+            carry = 1
+        else:
+            out.append(t)
+            carry = 0
+    assert carry == 0 and s == 0
+    return out
+
+
+def signed_digit_arrays(scalars, c: int = WINDOW_BITS):
+    """Host: scalars -> (mags, negs): (W, N) int32 digit magnitudes in
+    [0, 2^(c-1)] and (W, N) bool negation flags, window-major (the scan
+    axis of both device graphs)."""
+    digits = np.array(
+        [signed_digits(s, c) for s in scalars], dtype=np.int32
+    ).T  # (W, N)
+    return np.abs(digits), digits < 0
+
+
+def _identity_point():
+    ident = jnp.asarray(curve.PG1._identity)  # (3, 1, NB)
+    return (ident[0], ident[1], ident[2])
+
+
+def _horner_step(acc, t, c: int):
+    """acc <- [2^c] acc + t (the per-window combine, MSB-first)."""
+    for _ in range(c):
+        acc = curve.PG1.double(acc)
+    return curve.PG1.add(acc, t)
+
+
+def msm_fixed_base(table_x, table_y, table_valid, mags, negs, *, c=WINDOW_BITS):
+    """Fixed-base windowed MSM over a precomputed digit-multiple table.
+
+    table_x/table_y: (N, B+1, 1, NB) affine Montgomery bundles of
+        [d]P_i for d = 0..B (d=0 rows are dummies, masked invalid).
+    table_valid: (N, B+1) bool — False rows enter the fold as identity
+        (d=0 and any infinity multiples).
+    mags/negs: (W, N) digit magnitudes / negation flags from
+        `signed_digit_arrays`.
+
+    Returns one projective PG1 point, coords (1, NB).
+    """
+    n = table_x.shape[0]
+    lane = jnp.arange(n)
+
+    def body(acc, wd):
+        mag, neg = wd
+        x = table_x[lane, mag]  # (N, 1, NB) gather of [|d_i|]P_i
+        y = table_y[lane, mag]
+        v = table_valid[lane, mag]
+        y = fb.select(neg, curve.F1.neg(y), y)
+        pts = curve.PG1.from_affine((x, y), v)
+        t = curve.PG1.sum_axis(pts, axis=0)
+        return _horner_step(acc, t, c), None
+
+    acc, _ = jax.lax.scan(
+        body, _identity_point(), (mags, negs), reverse=True
+    )
+    return acc
+
+
+def msm_pippenger(pts_x, pts_y, valid, mags, negs, *, c=WINDOW_BITS):
+    """Variable-base Pippenger MSM: signed-digit windows + bucket
+    aggregation by masked tree folds.
+
+    pts_x/pts_y: (N, 1, NB) affine Montgomery bundles; valid: (N,) bool
+    (False = infinity). mags/negs as in `signed_digit_arrays`.
+
+    Returns one projective PG1 point, coords (1, NB).
+    """
+    b_max = 1 << (c - 1)
+    pts = curve.PG1.from_affine((pts_x, pts_y), valid)
+    buckets = jnp.arange(1, b_max + 1)  # (B,)
+
+    def body(acc, wd):
+        mag, neg = wd  # (N,)
+        y_s = fb.select(neg, curve.F1.neg(pts[1]), pts[1])
+        p_w = (pts[0], y_s, pts[2])
+        lanes = tuple(
+            jnp.broadcast_to(comp, (b_max,) + comp.shape) for comp in p_w
+        )  # (B, N, 1, NB)
+        sel = mag[None, :] == buckets[:, None]  # (B, N)
+        s = curve.PG1.masked_sum_axis(lanes, sel, axis=1)  # (B,) points
+        # T_w = sum_b b * S_b via the double running sum:
+        #   run_k = sum_{b >= k} S_b accumulated top-down; T = sum_k run_k
+        run = _identity_point()
+        tot = _identity_point()
+        for b in reversed(range(b_max)):
+            run = curve.PG1.add(run, tuple(comp[b] for comp in s))
+            tot = curve.PG1.add(tot, run)
+        return _horner_step(acc, tot, c), None
+
+    acc, _ = jax.lax.scan(
+        body, _identity_point(), (mags, negs), reverse=True
+    )
+    return acc

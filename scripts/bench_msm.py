@@ -1,0 +1,86 @@
+"""Host MSM A/B: the retired naive per-point ladder vs the windowed
+Pippenger `_g1_lincomb` (kzg/api.py) — the producer-side hot loop of
+block production (one commitment MSM per blob plus one per proof).
+
+Work model (group ops, n points, window c, 255-bit scalars):
+
+    naive:     n * (255 doublings + ~128 adds)        ~= 383 n
+    Pippenger: ceil(255/c) * (n inserts + 2(2^c - 1)
+               aggregation adds) + 255 doublings
+
+At n = 4096 the heuristic picks c = 8: ~147k ops vs ~1.57M — a ~10.7x
+op-count cut; the measured wall-clock ratio is smaller because bucket
+inserts are generic Jacobian adds while the ladder's doublings are
+cheaper per op. The PR acceptance floor is >= 3x at 4096.
+
+Run: python scripts/bench_msm.py [sizes...]   (default 64 512 4096)
+Prints one JSON line per size; paste the 4096 row into PERF_NOTES.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu.crypto.constants import R  # noqa: E402
+from lighthouse_tpu.crypto.ref_curve import G1  # noqa: E402
+from lighthouse_tpu.kzg.api import (  # noqa: E402
+    _g1_lincomb,
+    _g1_lincomb_naive,
+    _pippenger_window_bits,
+)
+from lighthouse_tpu.kzg.trusted_setup import (  # noqa: E402
+    g1_generator_multiples,
+)
+
+
+def _scalars(n: int):
+    """Deterministic full-width scalars (the commitment MSM sees
+    arbitrary 255-bit field elements)."""
+    import hashlib
+
+    return [
+        int.from_bytes(
+            hashlib.sha256(b"bench_msm %d" % i).digest(), "big"
+        )
+        % R
+        for i in range(n)
+    ]
+
+
+def measure(n: int, naive_reps: int = 1, pip_reps: int = 3) -> dict:
+    pts = g1_generator_multiples(n)
+    ss = _scalars(n)
+    t_naive = []
+    for _ in range(naive_reps):
+        t0 = time.perf_counter()
+        ref = _g1_lincomb_naive(pts, ss)
+        t_naive.append(time.perf_counter() - t0)
+    t_pip = []
+    for _ in range(pip_reps):
+        t0 = time.perf_counter()
+        got = _g1_lincomb(pts, ss)
+        t_pip.append(time.perf_counter() - t0)
+    assert G1.eq(ref, got), f"MSM mismatch at n={n}"
+    naive_s = sorted(t_naive)[len(t_naive) // 2]
+    pip_s = sorted(t_pip)[len(t_pip) // 2]
+    return {
+        "metric": "host_msm_pippenger_speedup",
+        "n_points": n,
+        "window_bits": _pippenger_window_bits(n),
+        "naive_s": round(naive_s, 3),
+        "pippenger_s": round(pip_s, 3),
+        "speedup": round(naive_s / pip_s, 2),
+    }
+
+
+def main():
+    sizes = [int(a) for a in sys.argv[1:]] or [64, 512, 4096]
+    for n in sizes:
+        print(json.dumps(measure(n)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

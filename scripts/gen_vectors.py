@@ -451,6 +451,76 @@ def main():
                 "output": expect,
             },
         )
+    # ---- kzg/msm: committed G1 MSM vectors ------------------------------
+    # Oracle-pinned against the NAIVE per-point ladder (the pre-Pippenger
+    # reference), cross-checked against the Pippenger path at generation
+    # time: any drift in either host MSM implementation changes bytes.
+    # Points are stored as affine int pairs (null = infinity) so the
+    # tier-1 runner pays no decompression cost at the 4096 shape.
+    from lighthouse_tpu.bls.point_serde import g1_compress  # noqa: E402
+    from lighthouse_tpu.crypto.constants import R  # noqa: E402
+    from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP  # noqa: E402
+    from lighthouse_tpu.kzg.api import (  # noqa: E402
+        _g1_lincomb,
+        _g1_lincomb_naive,
+    )
+    from lighthouse_tpu.kzg.trusted_setup import (  # noqa: E402
+        g1_generator_multiples,
+    )
+
+    def msm_case(name: str, points, scalars):
+        naive = _g1_lincomb_naive(points, scalars)
+        pip = _g1_lincomb(points, scalars)
+        assert G1_GROUP.eq(naive, pip), f"msm oracle drift in {name}"
+        write_case(
+            "kzg",
+            "msm",
+            name,
+            {
+                "input": {
+                    "points": [
+                        None
+                        if p is None
+                        else {"x": hex(p[0]), "y": hex(p[1])}
+                        for p in points
+                    ],
+                    "scalars": [hex(s) for s in scalars],
+                },
+                "output": hx(g1_compress(naive)),
+            },
+        )
+
+    setup8 = kzg.dev_setup(kzg_n)
+    pows = list(setup8.g1_powers)
+    msm_case("zero_scalars", pows[:4], [0, 0, 0, 0])
+    msm_case(
+        "infinity_points",
+        [pows[0], None, pows[2], None],
+        [5, 7, R - 3, 11],
+    )
+    msm_case("scalar_r_minus_1", pows[:2], [R - 1, R - 1])
+    msm_case(
+        "duplicate_points",
+        [pows[1], pows[1], pows[1], pows[3]],
+        [3, R - 5, 2**64 + 9, 1],
+    )
+    msm_case("single_point", [pows[5]], [0xABCDEF0123456789])
+    # the mainnet commitment shape: 4096 distinct points ([i+1]G, built
+    # by one add chain + one simultaneous inversion — cheap for the
+    # tier-1 runner to load, unlike 4096 decompressions) with
+    # deterministic full-width scalars
+    pts_4096 = g1_generator_multiples(4096)
+    import hashlib as _hl
+
+    scalars_4096 = [
+        int.from_bytes(
+            _hl.sha256(b"lighthouse-tpu msm 4096 %d" % i).digest(), "big"
+        )
+        % R
+        for i in range(4096)
+    ]
+    msm_case("full_4096", pts_4096, scalars_4096)
+
     write_case(
         "kzg",
         "meta",

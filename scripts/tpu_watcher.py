@@ -80,6 +80,13 @@ SWEEP = [
     ("pallas", 132, "block"),
     ("pallas", 32, "replay32"),
     ("pallas", 32768, "oppool32k"),
+    # KZG plane (PR 4): producer commit-MSM throughput on the
+    # fixed-base windowed device graph at the minimal-preset and
+    # mainnet blob shapes, then the ops/kzg_verify fold factor the
+    # ROADMAP has pending (ref curve: 0.89x/2.69x/5.10x at N=1/4/8)
+    ("xla", 4, "kzg"),
+    ("xla", 4096, "kzg"),
+    ("xla", 8, "kzgfold"),
     ("predcbf", 4096),
     ("predcbf", 30720),
     ("predc", 4096),
