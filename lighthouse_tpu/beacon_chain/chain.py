@@ -156,6 +156,10 @@ class BeaconChain:
         # unknown parent) is handed here; the node wires in its
         # parent-lookup recovery so the block is not silently lost
         self.da_release_failure_handler = None
+        # (header root, signature) pairs whose proposer signature already
+        # verified — gossip redeliveries of a block's sidecars cost one
+        # pairing total, not one per sidecar (FIFO-bounded)
+        self._verified_sidecar_headers: dict[tuple, None] = {}
 
         self._justified_balances = [
             v.effective_balance for v in genesis_state.validators
@@ -364,14 +368,6 @@ class BeaconChain:
         if self.fork_choice.current_slot < block.slot:
             self.fork_choice.set_slot(block.slot)
 
-        outcome = self.observed_block_producers.observe(
-            block.slot, block.proposer_index, block_root
-        )
-        if outcome == "equivocation":
-            raise BlockError("proposer equivocation")
-        if outcome == "duplicate":
-            raise BlockError("block already observed")
-
         parent_state = self._snapshots.get(parent_root)
         if parent_state is None:
             stored = self.store.get_block(parent_root)
@@ -380,6 +376,18 @@ class BeaconChain:
             parent_state = self.store.state_at_slot(stored.message.slot)
             if parent_state is None:
                 raise BlockError("parent state unavailable")
+
+        # proposer observation AFTER parent resolution (the reference's
+        # gossip verification order): an unknown-parent block must stay
+        # retriable once the parent-lookup recovery fetches its parent —
+        # observing it here would make the retry a false "duplicate"
+        outcome = self.observed_block_producers.observe(
+            block.slot, block.proposer_index, block_root
+        )
+        if outcome == "equivocation":
+            raise BlockError("proposer equivocation")
+        if outcome == "duplicate":
+            raise BlockError("block already observed")
 
         # pre-slot state advance (state_advance_timer.rs:89,321): if the
         # timer already advanced the head state across this slot's (or
@@ -577,12 +585,91 @@ class BeaconChain:
             roots.append(root)
         return roots
 
-    def process_blob_sidecar(self, sidecar):
+    def verify_blob_sidecar_header(self, sidecar) -> bool:
+        """Proposer-signature check on the sidecar's signed block header
+        (gossip rule `blob_sidecar.signed_block_header`; reference
+        verify_blob_sidecar_for_gossip). Scope of the guarantee: the
+        signature covers the HEADER only, so this stops an attacker
+        from inventing sidecars for arbitrary (root, index) space —
+        spamming the candidate cache now requires replaying a REAL
+        proposer's signed header from an existing block. Targeted
+        flooding of one known block's candidate cap by pairing that
+        public header with garbage blobs remains possible (the
+        reference closes that residual with gossip-time KZG +
+        commitment-inclusion proofs; here the first-come-wins cap,
+        eviction digest-forgetting, and post-block redelivery bound the
+        damage to a delayed import). Verified (header root, signature)
+        pairs are cached so the N sidecars of one block — and mesh
+        redeliveries — cost one pairing total."""
+        from lighthouse_tpu import bls
+        from lighthouse_tpu.state_processing import signature_sets as ss
+
+        if self.backend == "fake":
+            # fake crypto = always-valid (the set can't even be BUILT
+            # from a structurally-invalid placeholder signature)
+            return True
+        header = sidecar.signed_block_header
+        msg = header.message
+        key = (
+            bytes(type(msg).hash_tree_root(msg)),
+            bytes(header.signature),
+        )
+        if key in self._verified_sidecar_headers:
+            return True
+        try:
+            self.pubkey_cache.get(int(msg.proposer_index))
+        except (KeyError, IndexError):
+            return False
+        try:
+            ok = bls.verify_signature_sets(
+                [
+                    ss.block_header_set(
+                        self.head_state,
+                        header,
+                        self.pubkey_cache.get,
+                        self.spec,
+                    )
+                ],
+                backend=self.backend,
+            )
+        except Exception:
+            return False
+        if ok:
+            self._verified_sidecar_headers[key] = None
+            while len(self._verified_sidecar_headers) > 512:
+                self._verified_sidecar_headers.pop(
+                    next(iter(self._verified_sidecar_headers))
+                )
+        return bool(ok)
+
+    def process_blob_sidecar(self, sidecar, verify_header: bool = True):
         """Gossip blob-sidecar entry point: verify + record through the
         DA checker, then import any block the sidecar completed.
         Returns the roots of blocks imported as a result (usually
         empty); raises DataAvailabilityError on invalid/duplicate
-        sidecars (the gossip layer maps that onto peer scoring)."""
+        sidecars (the gossip layer maps that onto peer scoring).
+
+        `verify_header=False` is for the req/resp sync path ONLY, where
+        the caller has already bound the sidecar structurally to a block
+        whose proposal signature is verified in the segment batch (the
+        sidecar header carries the identical signature over the
+        identical root, so re-pairing it proves nothing new)."""
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            DataAvailabilityError,
+        )
+
+        if verify_header:
+            # cheap structural rejections FIRST: index/horizon junk and
+            # exact redeliveries must never cost a pairing
+            self.da_checker.precheck_sidecar(sidecar)
+            if not self.verify_blob_sidecar_header(sidecar):
+                self.metrics["sidecar_header_sig_failures"] = (
+                    self.metrics.get("sidecar_header_sig_failures", 0)
+                    + 1
+                )
+                raise DataAvailabilityError(
+                    "blob sidecar proposer signature invalid"
+                )
         released = self.da_checker.put_sidecar(sidecar)
         self.metrics["blob_sidecars_processed"] = (
             self.metrics.get("blob_sidecars_processed", 0) + 1
@@ -611,10 +698,10 @@ class BeaconChain:
         parent_root = bytes(block.parent_root)
         # the availability invariant holds on the sync path too: a
         # segment block committing to blobs imports only if its
-        # sidecars already verified (arrived via gossip). Fetching
-        # missing ones needs the blobs_by_range/by_root RPC — a
-        # ROADMAP item; until then the serving peer's segment is
-        # rejected rather than imported unavailable.
+        # sidecars already verified (arrived via gossip, or fetched by
+        # SyncManager over blob_sidecars_by_range ahead of this
+        # import). A still-incomplete segment is rejected rather than
+        # imported unavailable — the sync manager requeues it.
         try:
             missing = self.da_checker.put_block(block_root, signed_block)
         except DataAvailabilityError as e:

@@ -15,9 +15,14 @@ Verification discipline (the soundness/DoS core):
     self-consistent forgery ahead of the honest sidecar cannot poison
     anything (both candidates sit side by side until the block picks
     the one matching its body). Candidates per (root, index) are
-    capped; the residual pre-block spam vector (flooding the cap) is
-    closed in the reference by verifying the sidecar's proposer
-    signature at gossip time — noted as future work here.
+    capped; the chain entry point (`chain.process_blob_sidecar`)
+    verifies the sidecar's signed block header BEFORE anything may
+    enter this cache (`chain.verify_blob_sidecar_header`), so spam
+    must replay a real proposer's signed header — inventing arbitrary
+    (root, index) space is closed, while targeted flooding of one
+    known block's cap with header-replay forgeries remains bounded
+    (not eliminated) by first-come-wins + digest-forgetting; the
+    reference's full answer is gossip-time KZG + inclusion proofs.
   * block arrival — candidates matching the body's commitments are
     verified in ONE RLC-folded multi-pairing
     (`kzg.verify_blob_kzg_proof_batch`), the fold the PERF_NOTES entry
@@ -344,6 +349,32 @@ class DataAvailabilityChecker:
 
     # ------------------------------------------------------------ sidecars
 
+    def precheck_sidecar(self, sidecar):
+        """Cheap structural rejections — index bound, clock horizon,
+        exact-duplicate — WITHOUT mutating any cache. The chain runs
+        this BEFORE the proposer-signature pairing so junk costs O(1),
+        never a pairing (cheap-checks-first DoS ordering); put_sidecar
+        re-runs the same checks as its own gate."""
+        spec = self.spec
+        header = sidecar.signed_block_header.message
+        block_root = type(header).hash_tree_root(header)
+        index = int(sidecar.index)
+        slot = int(header.slot)
+        if index >= spec.MAX_BLOBS_PER_BLOCK:
+            _SIDECARS.labels("bad_index").inc()
+            raise DataAvailabilityError(
+                f"sidecar index {index} out of range"
+            )
+        if not self._slot_in_horizon(slot):
+            _SIDECARS.labels("future_slot").inc()
+            raise DataAvailabilityError(
+                f"sidecar slot {slot} beyond the clock horizon"
+            )
+        digest = hashlib.sha256(sidecar.to_bytes()).digest()
+        if self.observed.is_known(slot, block_root, index, digest):
+            _SIDECARS.labels("duplicate").inc()
+            raise DataAvailabilityError("duplicate sidecar")
+
     def put_sidecar(self, sidecar) -> list:
         """Validate + record one gossip sidecar. Returns the list of
         released (now fully-available) held blocks — usually empty or
@@ -382,11 +413,12 @@ class DataAvailabilityChecker:
                 if len(cands) >= self.MAX_CANDIDATES_PER_INDEX:
                     # cap full: drop the NEW arrival (first-come-wins —
                     # an already-cached sidecar can never be displaced,
-                    # so back-running spam is harmless; an attacker
-                    # must FRONT-run the honest sidecar past the whole
-                    # cap, which gossip-time proposer-signature
-                    # verification closes — see module docstring). Not
-                    # observed: a post-block redelivery verifies fresh.
+                    # so back-running spam is harmless; FRONT-running
+                    # needs a replay of the block's real signed header
+                    # since the chain verifies it before put_sidecar,
+                    # and even then costs only a delayed import — see
+                    # module docstring). Not observed: a post-block
+                    # redelivery verifies fresh.
                     _SIDECARS.labels("candidate_overflow").inc()
                     return []
                 cands[digest] = sidecar

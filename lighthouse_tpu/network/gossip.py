@@ -18,6 +18,11 @@ GOSSIP_MAX_SIZE = 10 * 1024 * 1024
 SCORE_INVALID_MESSAGE = -20.0
 SCORE_DUPLICATE = -0.5
 SCORE_VALID = 0.5
+# req/resp misbehavior is scored through the same hub: an unresponsive
+# or erroring peer costs a little (it may just be overloaded), a peer
+# serving malformed/hash-chain-violating responses costs
+# SCORE_INVALID_MESSAGE (it is provably lying)
+SCORE_TIMEOUT = -1.0
 BAN_THRESHOLD = -50.0
 
 

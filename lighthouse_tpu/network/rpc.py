@@ -1,16 +1,25 @@
-"""Req/resp RPC: Status, Ping, Metadata, Goodbye, BlocksByRange/ByRoot.
+"""Req/resp RPC: Status, Ping, Metadata, Goodbye, BlocksByRange/ByRoot,
+BlobSidecarsByRange/ByRoot.
 
 Role of the reference's rpc stack (lighthouse_network/src/rpc/: methods,
 protocol negotiation, ssz_snappy codec, per-protocol rate limiting). SSZ
 payloads over an abstract peer channel (in-process here; the framing layer
 is transport-agnostic), with a token-bucket rate limiter per (peer,
 method) mirroring rpc/rate_limiter.rs.
+
+The req/resp surface is an adversarial boundary: every method is
+rate-limited BEFORE any store work runs, request sizes are clamped to the
+protocol maxima, and the `lighthouse_tpu_rpc_requests_total` family
+records every served/rate-limited/errored request so an abusive peer is
+visible on the scrape before it is visible in the logs.
 """
 
+import functools
 import time
 from dataclasses import dataclass
 
 from lighthouse_tpu import ssz
+from lighthouse_tpu.common.metrics import REGISTRY
 
 
 class StatusMessage(ssz.Container):
@@ -41,6 +50,28 @@ class BlocksByRangeRequest(ssz.Container):
 
 
 MAX_REQUEST_BLOCKS = 1024
+# deneb p2p: MAX_REQUEST_BLOCKS_DENEB (128) * MAX_BLOBS_PER_BLOCK (6)
+MAX_REQUEST_BLOB_SIDECARS = 768
+
+
+class BlobIdentifier(ssz.Container):
+    """(block_root, index) — the by-root request key for one sidecar
+    (deneb p2p BlobIdentifier). A wire-local twin of the spec-bound
+    container in types/containers.py: request framing must not depend
+    on a Spec instance."""
+
+    block_root: ssz.bytes32
+    index: ssz.uint64
+
+
+class BlobSidecarsByRootRequest(ssz.Container):
+    identifiers: ssz.List(BlobIdentifier, MAX_REQUEST_BLOB_SIDECARS)
+
+
+class BlobSidecarsByRangeRequest(ssz.Container):
+    start_slot: ssz.uint64
+    count: ssz.uint64
+
 
 # token-bucket quotas per method: (tokens, per_seconds)
 QUOTAS = {
@@ -50,7 +81,19 @@ QUOTAS = {
     "goodbye": (1, 10),
     "blocks_by_range": (1024, 10),
     "blocks_by_root": (128, 10),
+    "blob_sidecars_by_range": (MAX_REQUEST_BLOB_SIDECARS, 10),
+    "blob_sidecars_by_root": (MAX_REQUEST_BLOB_SIDECARS, 10),
 }
+
+_RPC_REQUESTS = REGISTRY.counter_vec(
+    "lighthouse_tpu_rpc_requests_total",
+    "req/resp requests, by method and outcome (ok|rate_limited|error)",
+    ("method", "outcome"),
+)
+_RPC_SIDECARS_SERVED = REGISTRY.counter(
+    "lighthouse_tpu_rpc_blob_sidecars_served_total",
+    "blob sidecars served over the by_range/by_root req/resp methods",
+)
 
 
 class RateLimitExceeded(Exception):
@@ -81,6 +124,29 @@ class RpcError(Exception):
     message: str
 
 
+def _counted(method_name: str):
+    """Record the request outcome AFTER the handler runs: ok only when
+    it actually served, error when it raised (rate_limited is recorded
+    at the bucket, before any work)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, peer_id, *args, **kwargs):
+            try:
+                out = fn(self, peer_id, *args, **kwargs)
+            except RateLimitExceeded:
+                raise
+            except Exception:
+                _RPC_REQUESTS.labels(method_name, "error").inc()
+                raise
+            _RPC_REQUESTS.labels(method_name, "ok").inc()
+            return out
+
+        return wrapper
+
+    return deco
+
+
 class RpcServer:
     """Per-node RPC endpoint serving the standard methods from a chain."""
 
@@ -90,15 +156,23 @@ class RpcServer:
         self.fork_digest = fork_digest
         self.seq_number = 0
         self._buckets: dict[tuple, _Bucket] = {}
+        # goodbye hook: the node wires this to SyncManager.remove_peer so
+        # a cleanly-disconnecting peer leaves without any score penalty
+        self.on_goodbye = None
 
     def _limit(self, peer_id: str, method: str, n=1.0):
         key = (peer_id, method)
         if key not in self._buckets:
             self._buckets[key] = _Bucket(*QUOTAS[method])
-        self._buckets[key].take(n)
+        try:
+            self._buckets[key].take(n)
+        except RateLimitExceeded:
+            _RPC_REQUESTS.labels(method, "rate_limited").inc()
+            raise
 
     # ------------------------------------------------------------ methods
 
+    @_counted("status")
     def status(self, peer_id: str) -> StatusMessage:
         self._limit(peer_id, "status")
         chain = self.chain
@@ -114,14 +188,26 @@ class RpcServer:
             head_slot=head.slot,
         )
 
+    @_counted("ping")
     def ping(self, peer_id: str, data: int) -> int:
         self._limit(peer_id, "ping")
         return self.seq_number
 
+    @_counted("metadata")
     def metadata(self, peer_id: str) -> MetaData:
         self._limit(peer_id, "metadata")
         return MetaData(seq_number=self.seq_number, attnets=[True] * 64)
 
+    @_counted("goodbye")
+    def goodbye(self, peer_id: str, reason: int = 0):
+        """Clean disconnect (rpc GoodbyeReason): the peer announced it is
+        leaving, so drop it from the serving side's sync view with NO
+        score penalty — saying goodbye is polite, not misbehavior."""
+        self._limit(peer_id, "goodbye")
+        if self.on_goodbye is not None:
+            self.on_goodbye(peer_id, int(reason))
+
+    @_counted("blocks_by_range")
     def blocks_by_range(self, peer_id: str, req: BlocksByRangeRequest):
         count = min(req.count, MAX_REQUEST_BLOCKS)
         self._limit(peer_id, "blocks_by_range", float(count))
@@ -137,6 +223,7 @@ class RpcServer:
                 out.append(block)
         return out
 
+    @_counted("blocks_by_root")
     def blocks_by_root(self, peer_id: str, roots):
         self._limit(peer_id, "blocks_by_root", float(len(roots)))
         out = []
@@ -144,4 +231,52 @@ class RpcServer:
             block = self.chain.store.get_block(bytes(root))
             if block is not None:
                 out.append(block)
+        return out
+
+    @_counted("blob_sidecars_by_root")
+    def blob_sidecars_by_root(self, peer_id: str, identifiers):
+        """Serve stored sidecars for explicit (block_root, index) keys —
+        the unknown-parent recovery path. Requests beyond
+        MAX_REQUEST_BLOB_SIDECARS identifiers are clamped, and the
+        bucket is charged per identifier BEFORE any store read."""
+        identifiers = list(identifiers)[:MAX_REQUEST_BLOB_SIDECARS]
+        self._limit(
+            peer_id, "blob_sidecars_by_root", float(len(identifiers) or 1)
+        )
+        out = []
+        wanted: dict[bytes, set] = {}
+        for ident in identifiers:
+            wanted.setdefault(bytes(ident.block_root), set()).add(
+                int(ident.index)
+            )
+        for root, indices in wanted.items():
+            for sc in self.chain.store.get_blob_sidecars(root):
+                if int(sc.index) in indices:
+                    out.append(sc)
+        _RPC_SIDECARS_SERVED.inc(len(out))
+        return out
+
+    @_counted("blob_sidecars_by_range")
+    def blob_sidecars_by_range(
+        self, peer_id: str, req: BlobSidecarsByRangeRequest
+    ):
+        """Serve canonical sidecars for a slot range (range-sync DA
+        companion to blocks_by_range), capped at
+        MAX_REQUEST_BLOB_SIDECARS sidecars total."""
+        # charge for what a slot can actually CARRY (MAX_BLOBS_PER_BLOCK
+        # sidecars), not one token per slot — a per-slot charge would be
+        # a 6x bandwidth amplifier against the bucket. The slot clamp
+        # keeps the worst-case charge exactly at the bucket's capacity
+        # (768 / 6 = 128 slots on mainnet params = the deneb
+        # MAX_REQUEST_BLOCKS_DENEB), so a maximal request is serveable
+        # on a fresh bucket and never truncates mid-range.
+        max_blobs = self.chain.store.spec.MAX_BLOBS_PER_BLOCK
+        count = min(req.count, MAX_REQUEST_BLOB_SIDECARS // max_blobs)
+        self._limit(
+            peer_id, "blob_sidecars_by_range", float(count * max_blobs)
+        )
+        out = self.chain.store.get_blob_sidecars_by_range(
+            int(req.start_slot), int(count), limit=MAX_REQUEST_BLOB_SIDECARS
+        )
+        _RPC_SIDECARS_SERVED.inc(len(out))
         return out

@@ -48,12 +48,24 @@ from lighthouse_tpu.network.gossip import (
     message_id,
 )
 from lighthouse_tpu.network.rpc import (
+    BlobSidecarsByRangeRequest,
+    BlobSidecarsByRootRequest,
     BlocksByRangeRequest,
+    Goodbye,
     MetaData,
     Ping,
+    RateLimitExceeded,
     RpcError,
     StatusMessage,
 )
+
+# wire status codes for RPC responses: 0 ok, 1 server error, 2 is used
+# client-side for timeouts, 3 rate-limited. 3 must survive the wire as
+# a TYPED RateLimitExceeded — the sync manager treats "you are over
+# budget" (rotate penalty-free) very differently from "server error"
+# (downscore), and flattening it would punish honest servers for the
+# client's own polling.
+RPC_STATUS_RATE_LIMITED = 3
 from lighthouse_tpu.network.snappy_codec import (
     frame_compress,
     frame_decompress,
@@ -152,6 +164,30 @@ class RpcClientProxy:
         payload = frame_compress(b"".join(bytes(r) for r in roots))
         chunks = self._call("blocks_by_root", payload)
         return [self.net._decode_block(c) for c in chunks]
+
+    def goodbye(self, caller: str, reason: int = 0):
+        self._call(
+            "goodbye", frame_compress(Goodbye(reason=reason).to_bytes())
+        )
+
+    def blob_sidecars_by_range(self, caller: str, req):
+        chunks = self._call(
+            "blob_sidecars_by_range", frame_compress(req.to_bytes())
+        )
+        return [
+            self.net.t.BlobSidecar.decode(frame_decompress(c))
+            for c in chunks
+        ]
+
+    def blob_sidecars_by_root(self, caller: str, identifiers):
+        req = BlobSidecarsByRootRequest(identifiers=list(identifiers))
+        chunks = self._call(
+            "blob_sidecars_by_root", frame_compress(req.to_bytes())
+        )
+        return [
+            self.net.t.BlobSidecar.decode(frame_decompress(c))
+            for c in chunks
+        ]
 
 
 class SocketNet:
@@ -589,6 +625,8 @@ class SocketNet:
             self._pending.pop(req_id, None)
             raise RpcError(2, f"rpc {method} timed out")
         status, chunks = out[0]
+        if status == RPC_STATUS_RATE_LIMITED:
+            raise RateLimitExceeded
         if status != 0:
             raise RpcError(status, chunks[0].decode() if chunks else "")
         return chunks
@@ -601,6 +639,8 @@ class SocketNet:
         try:
             chunks = self._dispatch_rpc(conn.node_id, method, payload)
             status = 0
+        except RateLimitExceeded:
+            status, chunks = RPC_STATUS_RATE_LIMITED, [b"rate limited"]
         except RpcError as e:
             status, chunks = e.args[0] or 1, [str(e.args[1]).encode()]
         except Exception as e:
@@ -637,6 +677,24 @@ class SocketNet:
             roots = [raw[i : i + 32] for i in range(0, len(raw), 32)]
             blocks = srv.blocks_by_root(peer_id, roots)
             return [self._encode_block(b) for b in blocks]
+        if method == "goodbye":
+            reason = Goodbye.decode(frame_decompress(payload)).reason
+            srv.goodbye(peer_id, int(reason))
+            return []
+        if method == "blob_sidecars_by_range":
+            req = BlobSidecarsByRangeRequest.decode(
+                frame_decompress(payload)
+            )
+            sidecars = srv.blob_sidecars_by_range(peer_id, req)
+            return [frame_compress(sc.to_bytes()) for sc in sidecars]
+        if method == "blob_sidecars_by_root":
+            req = BlobSidecarsByRootRequest.decode(
+                frame_decompress(payload)
+            )
+            sidecars = srv.blob_sidecars_by_root(
+                peer_id, req.identifiers
+            )
+            return [frame_compress(sc.to_bytes()) for sc in sidecars]
         raise RpcError(1, f"unknown method {method}")
 
     def _encode_block(self, signed_block) -> bytes:

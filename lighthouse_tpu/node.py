@@ -79,7 +79,18 @@ class BeaconNode:
 
         self.chain.migrator = BackgroundMigrator(self.chain, threaded=True)
         self.rpc = RpcServer(self.chain, node_id, self.fork_digest)
-        self.sync = SyncManager(self.chain, spec)
+        # the sync manager scores req/resp misbehavior through the same
+        # hub the gossip plane uses, so one bad actor accumulates one
+        # score across both planes; it calls out under THIS node's id
+        # (serving peers key their rate limiters on it)
+        self.sync = SyncManager(
+            self.chain, spec, hub=hub, local_peer_id=node_id
+        )
+        # goodbye is a clean disconnect: remove the peer from the sync
+        # view with no score penalty
+        self.rpc.on_goodbye = lambda pid, reason: self.sync.remove_peer(
+            pid
+        )
         # a DA-released block whose import fails on an unknown parent
         # re-enters through the same recovery as a gossip block
         self.chain.da_release_failure_handler = self._on_release_failure
@@ -154,6 +165,8 @@ class BeaconNode:
             ),
         )
         self.hub = net.join(self.node_id, self._deliver)
+        # req/resp peer scoring follows the transport swap
+        self.sync.hub = net
         for name in self._gossip_topics():
             net.subscribe(self.node_id, topic(self.fork_digest, name))
         self._init_subnet_service()
@@ -318,9 +331,9 @@ class BeaconNode:
         interesting case is an unknown parent: the original gossip
         delivery raised 'data unavailable' before the parent check ever
         ran, so the lookup in _on_block never fired — run it now and
-        requeue the block. Known gap (ROADMAP): a parent that ITSELF
-        commits to blobs cannot import from blocks_by_root alone — that
-        needs the blob_sidecars_by_root RPC."""
+        requeue the block. A parent that ITSELF commits to blobs
+        imports too: lookup_parent fetches its sidecars over
+        blob_sidecars_by_root before processing it."""
         if "unknown parent" in str(err):
             if self.sync.lookup_parent(bytes(block.message.parent_root)):
                 self.processor.submit(

@@ -119,6 +119,23 @@ def epoch_kernel_enabled() -> bool:
     return os.environ.get("LIGHTHOUSE_TPU_EPOCH_KERNEL", "1") != "0"
 
 
+def _x64_context(jax):
+    """`jax.enable_x64` moved between jax versions (top-level in newer
+    releases, jax.experimental before that). Returns the context-manager
+    factory, or None when this jax has neither — the caller then reports
+    'outside the envelope' and the exact Python path runs instead of the
+    whole epoch transition crashing."""
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is not None:
+        return ctx
+    try:
+        from jax.experimental import enable_x64
+
+        return enable_x64
+    except ImportError:
+        return None
+
+
 def run_inactivity_and_rewards(state, spec, ctx) -> bool:
     """Fused device pass replacing process_inactivity_updates +
     process_rewards_and_penalties_altair. Returns False when the inputs
@@ -183,8 +200,11 @@ def run_inactivity_and_rewards(state, spec, ctx) -> bool:
         * spec.inactivity_penalty_quotient_for(fork_of(state, spec))
     )
 
+    x64 = _x64_context(jax)
+    if x64 is None:
+        return False
     fn = _get_jitted()
-    with jax.enable_x64(True):
+    with x64(True):
         new_balances, new_scores = fn(
             eff,
             prev_part,

@@ -118,6 +118,27 @@ class HotColdDB:
                 out.append(self.t.BlobSidecar.decode(data))
         return out
 
+    def get_blob_sidecars_by_range(
+        self, start_slot: int, count: int, limit: int | None = None
+    ) -> list:
+        """Canonical-chain sidecars for slots [start_slot, start_slot +
+        count), ordered by (slot, index) — the serving side of the
+        `blob_sidecars_by_range` req/resp method. Walks the canonical
+        root index (direct keyed reads, no column scan). `limit` stops
+        at a BLOCK boundary: a response never carries a partial sidecar
+        set for a block, because a client staging it for its DA gate
+        could not tell truncation from data-withholding."""
+        out = []
+        for slot in range(start_slot, start_slot + count):
+            root = self.get_canonical_block_root(slot)
+            if root is None:
+                continue
+            sidecars = self.get_blob_sidecars(root)
+            if limit is not None and len(out) + len(sidecars) > limit:
+                break
+            out.extend(sidecars)
+        return out
+
     def prune_blob_sidecars(self, cutoff_slot: int) -> int:
         """Drop sidecars below `cutoff_slot`; returns the count removed.
         Driven by the finality migration with the

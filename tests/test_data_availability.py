@@ -34,10 +34,14 @@ def _blob(spec, seed: int) -> bytes:
     )
 
 
-def make_block_with_blobs(t, spec, slot, blobs, parent=b"\x11" * 32):
+def make_block_with_blobs(
+    t, spec, slot, blobs, parent=b"\x11" * 32, sign_with=None
+):
     """A structurally-complete bellatrix signed block + its sidecars,
     no chain required (the DA checker reads only body commitments and
-    the header binding)."""
+    the header binding). `sign_with` is an optional callable(root) ->
+    96-byte proposal signature for paths that verify the sidecar's
+    proposer signature (the chain gossip entry point)."""
     comms = [kzg.blob_to_kzg_commitment(b) for b in blobs]
     body = t.BeaconBlockBodyBellatrix(blob_kzg_commitments=comms)
     block = t.BeaconBlockBellatrix(
@@ -47,8 +51,13 @@ def make_block_with_blobs(t, spec, slot, blobs, parent=b"\x11" * 32):
         state_root=b"\x22" * 32,
         body=body,
     )
+    signature = (
+        sign_with(t.BeaconBlockBellatrix.hash_tree_root(block))
+        if sign_with is not None
+        else b"\x00" * 96
+    )
     signed = t.SignedBeaconBlockBellatrix(
-        message=block, signature=b"\x00" * 96
+        message=block, signature=signature
     )
     header = t.SignedBeaconBlockHeader(
         message=t.BeaconBlockHeader(
@@ -58,7 +67,7 @@ def make_block_with_blobs(t, spec, slot, blobs, parent=b"\x11" * 32):
             state_root=b"\x22" * 32,
             body_root=type(body).hash_tree_root(body),
         ),
-        signature=b"\x00" * 96,
+        signature=signature,
     )
     sidecars = [
         t.BlobSidecar(
@@ -326,14 +335,27 @@ def test_gossip_plane_scores_sidecar_misbehavior(t, spec):
     from lighthouse_tpu.network.gossip import GossipHub
     from lighthouse_tpu.node import BeaconNode
 
+    from lighthouse_tpu.state_processing.helpers import get_domain
+    from lighthouse_tpu.types.helpers import compute_signing_root
+
     h = Harness(spec, 8)
     hub = GossipHub()
     a = BeaconNode("a", h.state, spec, hub=hub, backend="ref")
     b = BeaconNode("b", h.state, spec, hub=hub, backend="ref")
     assert a is not None
 
+    # the chain entry point verifies the sidecar's proposer signature
+    # at gossip time, so the header must be REALLY signed by proposer 3
+    domain = get_domain(
+        h.state, spec.DOMAIN_BEACON_PROPOSER, spec.slot_to_epoch(3), spec
+    )
+    sign = lambda root: h.keypairs[3].sk.sign(  # noqa: E731
+        compute_signing_root(root, domain)
+    ).to_bytes()
     blobs = [_blob(spec, 30)]
-    signed, sidecars, root = make_block_with_blobs(t, spec, 3, blobs)
+    signed, sidecars, root = make_block_with_blobs(
+        t, spec, 3, blobs, sign_with=sign
+    )
 
     # the block arrives first and is HELD by b's DA gate (no penalty —
     # its sidecar is simply still in flight)
@@ -369,6 +391,43 @@ def test_gossip_plane_scores_sidecar_misbehavior(t, spec):
     before = hub.peers["a"].score
     a.publish_blob_sidecar(sidecars[0])
     assert hub.peers["a"].score == pytest.approx(before - 0.5)
+
+
+def test_gossip_time_proposer_signature_gates_candidate_cache(t, spec):
+    """Satellite: the chain gossip entry point verifies the sidecar's
+    proposer signature BEFORE anything may enter the DA checker's
+    candidate cache, so flooding a (root, index) candidate cap now
+    requires BLS forgeries (the front-running vector noted by the
+    reference)."""
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.state_processing.helpers import get_domain
+    from lighthouse_tpu.types.helpers import compute_signing_root
+
+    h = Harness(spec, 8)
+    chain = BeaconChain(h.state.copy(), spec, backend="ref")
+    domain = get_domain(
+        h.state, spec.DOMAIN_BEACON_PROPOSER, spec.slot_to_epoch(2), spec
+    )
+    sign = lambda root: h.keypairs[3].sk.sign(  # noqa: E731
+        compute_signing_root(root, domain)
+    ).to_bytes()
+    _, good_scs, _ = make_block_with_blobs(
+        t, spec, 2, [_blob(spec, 90)], sign_with=sign
+    )
+    # zero-signature forgery: rejected before the candidate cache
+    _, forged_scs, _ = make_block_with_blobs(
+        t, spec, 2, [_blob(spec, 91)], parent=b"\x33" * 32
+    )
+    with pytest.raises(DataAvailabilityError, match="proposer signature"):
+        chain.process_blob_sidecar(forged_scs[0])
+    assert chain.da_checker._pending == {}
+    assert chain.metrics["sidecar_header_sig_failures"] == 1
+    # the properly signed sidecar caches fine (block not yet known)...
+    assert chain.process_blob_sidecar(good_scs[0]) == []
+    assert len(chain.da_checker._pending) == 1
+    # ...and the verified-header cache makes its sibling free
+    assert chain.verify_blob_sidecar_header(good_scs[0])
 
 
 def test_released_block_import_failure_reaches_recovery_hook(t, spec):

@@ -1,0 +1,199 @@
+"""Req/resp RPC plane: token-bucket refill math, the blob-sidecar
+methods (by_root / by_range, clamps, quotas), goodbye, and the request
+container wire format."""
+
+import types
+
+import pytest
+
+from lighthouse_tpu.network.rpc import (
+    MAX_REQUEST_BLOB_SIDECARS,
+    BlobIdentifier,
+    BlobSidecarsByRangeRequest,
+    BlobSidecarsByRootRequest,
+    RateLimitExceeded,
+    RpcServer,
+    _Bucket,
+)
+from lighthouse_tpu.store import HotColdDB, MemoryStore
+from lighthouse_tpu.types.containers import types_for
+from lighthouse_tpu.types.spec import minimal_spec
+
+from tests.test_data_availability import _blob, make_block_with_blobs
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec(name="minimal-rpc-plane")
+
+
+@pytest.fixture(scope="module")
+def t(spec):
+    return types_for(spec)
+
+
+# ------------------------------------------------- token-bucket math
+
+
+def test_bucket_fractional_refill():
+    # 5 tokens / 15 s -> exactly 1/3 token per second
+    b = _Bucket(5, 15)
+    b.take(5)
+    with pytest.raises(RateLimitExceeded):
+        b.take(1)
+    # rewind the bookkeeping clock 3 s: precisely one token refilled
+    b.last -= 3.0
+    b.take(1)
+    with pytest.raises(RateLimitExceeded):
+        b.take(0.9)
+
+
+def test_bucket_capacity_clamp():
+    b = _Bucket(5, 15)
+    b.take(5)
+    # a long idle period must refill to CAPACITY, not beyond it
+    b.last -= 100_000.0
+    b.take(5)
+    with pytest.raises(RateLimitExceeded):
+        b.take(4)
+
+
+def test_bucket_isolation_per_peer_and_method():
+    srv = RpcServer(chain=None, node_id="x", fork_digest=b"\x00" * 4)
+    # ping quota is (2, 10): two takes pass, the third is limited
+    srv._limit("p1", "ping")
+    srv._limit("p1", "ping")
+    with pytest.raises(RateLimitExceeded):
+        srv._limit("p1", "ping")
+    # a different peer has its own bucket...
+    srv._limit("p2", "ping")
+    # ...and the same peer has a separate bucket per method
+    srv._limit("p1", "metadata")
+
+
+def test_bucket_fractional_cost_takes():
+    b = _Bucket(10, 10)  # 1 token/s
+    for _ in range(4):
+        b.take(2.5)
+    with pytest.raises(RateLimitExceeded):
+        b.take(0.5)
+
+
+# -------------------------------------------- blob sidecar methods
+
+
+def _server_with_blobs(t, spec):
+    """An RpcServer over a store holding one blob-committing canonical
+    block at slot 2 and one blob-less block at slot 3."""
+    db = HotColdDB(MemoryStore(), spec)
+    blobs = [_blob(spec, 1), _blob(spec, 2)]
+    signed, sidecars, root = make_block_with_blobs(t, spec, 2, blobs)
+    db.put_block(root, signed)
+    db.set_canonical_block_root(2, root)
+    for sc in sidecars:
+        db.put_blob_sidecar(root, sc)
+    plain, _, plain_root = make_block_with_blobs(t, spec, 3, [])
+    db.put_block(plain_root, plain)
+    db.set_canonical_block_root(3, plain_root)
+    chain = types.SimpleNamespace(store=db)
+    srv = RpcServer(chain, "server", b"\x00" * 4)
+    return srv, root, sidecars
+
+
+def test_blob_sidecars_by_root_serves_stored(t, spec):
+    srv, root, sidecars = _server_with_blobs(t, spec)
+    out = srv.blob_sidecars_by_root(
+        "peer",
+        [
+            BlobIdentifier(block_root=root, index=1),
+            BlobIdentifier(block_root=root, index=0),
+            BlobIdentifier(block_root=b"\x55" * 32, index=0),  # unknown
+        ],
+    )
+    assert sorted(int(sc.index) for sc in out) == [0, 1]
+    assert all(
+        bytes(sc.kzg_commitment)
+        == bytes(sidecars[int(sc.index)].kzg_commitment)
+        for sc in out
+    )
+
+
+def test_blob_sidecars_by_range_serves_and_clamps(t, spec):
+    srv, root, sidecars = _server_with_blobs(t, spec)
+    out = srv.blob_sidecars_by_range(
+        "peer", BlobSidecarsByRangeRequest(start_slot=0, count=10)
+    )
+    assert [int(sc.index) for sc in out] == [0, 1]
+    # the limit is BLOCK-aligned: a partial per-block sidecar set is
+    # never served (a client could not tell truncation from
+    # data-withholding), so limit=1 serves nothing and limit=2 serves
+    # the whole block
+    assert srv.chain.store.get_blob_sidecars_by_range(0, 10, limit=1) == []
+    both = srv.chain.store.get_blob_sidecars_by_range(0, 10, limit=2)
+    assert [int(sc.index) for sc in both] == [0, 1]
+
+
+def test_blob_sidecar_quota_exhaustion(t, spec):
+    srv, root, _ = _server_with_blobs(t, spec)
+    # the by_range bucket holds MAX_REQUEST_BLOB_SIDECARS tokens per
+    # 10 s and is charged per requested SLOT before any store read
+    srv.blob_sidecars_by_range(
+        "greedy",
+        BlobSidecarsByRangeRequest(
+            start_slot=0, count=MAX_REQUEST_BLOB_SIDECARS
+        ),
+    )
+    with pytest.raises(RateLimitExceeded):
+        srv.blob_sidecars_by_range(
+            "greedy", BlobSidecarsByRangeRequest(start_slot=0, count=8)
+        )
+    # identifiers beyond the protocol max are clamped, not an error
+    idents = [
+        BlobIdentifier(block_root=root, index=0)
+        for _ in range(MAX_REQUEST_BLOB_SIDECARS + 50)
+    ]
+    out = srv.blob_sidecars_by_root("other", idents)
+    assert len(out) == 1  # dedup'd by (root, index)
+
+
+def test_request_container_roundtrip(t, spec):
+    req = BlobSidecarsByRangeRequest(start_slot=7, count=33)
+    assert BlobSidecarsByRangeRequest.decode(req.to_bytes()).count == 33
+    by_root = BlobSidecarsByRootRequest(
+        identifiers=[
+            BlobIdentifier(block_root=b"\x0a" * 32, index=4),
+            BlobIdentifier(block_root=b"\x0b" * 32, index=0),
+        ]
+    )
+    back = BlobSidecarsByRootRequest.decode(by_root.to_bytes())
+    assert [int(i.index) for i in back.identifiers] == [4, 0]
+    assert bytes(back.identifiers[0].block_root) == b"\x0a" * 32
+
+
+def test_goodbye_removes_peer_without_penalty(t, spec):
+    srv, _, _ = _server_with_blobs(t, spec)
+    seen = []
+    srv.on_goodbye = lambda pid, reason: seen.append((pid, reason))
+    srv.goodbye("leaver", 1)
+    assert seen == [("leaver", 1)]
+    # quota is (1, 10): an immediate second goodbye is limited
+    with pytest.raises(RateLimitExceeded):
+        srv.goodbye("leaver", 1)
+
+
+def test_client_disconnect_sends_goodbye(spec):
+    """Client-side goodbye round trip: SyncManager.disconnect tells the
+    serving node we are leaving (it forgets us, penalty-free) and drops
+    the peer from our own view."""
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.node import BeaconNode
+
+    h = Harness(spec, 8, backend="fake")
+    a = BeaconNode("srv", h.state.copy(), spec, backend="fake")
+    b = BeaconNode("cli", h.state.copy(), spec, backend="fake")
+    a.sync.add_peer("cli", object())  # the server tracks its client
+    b.sync.add_peer("srv", a.rpc)
+    b.sync.disconnect("srv")
+    assert "srv" not in b.sync.peers
+    # the goodbye crossed: the server's on_goodbye removed us
+    assert "cli" not in a.sync.peers

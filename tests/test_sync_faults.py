@@ -1,0 +1,296 @@
+"""Fault-tolerant sync: chaos tests over the retriable req/resp plane.
+
+A late node range-syncs a multi-epoch chain WITH blob-committing blocks
+from an honest peer while a seeded `FaultyRpc` peer drops, stalls,
+truncates, corrupts, duplicates, or rate-limit-exhausts responses. The
+node must converge to the honest head with every sidecar imported
+through the DA gate, the faulty peer's score must sink below the honest
+peer's, and no retry loop may run unbounded (counters in the metrics
+registry prove both the retries and their bound).
+
+Tier-1 keeps one fast seeded smoke run; the full per-fault matrix is in
+the slow tier.
+"""
+
+import pytest
+
+from lighthouse_tpu import kzg
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.harness import Harness
+from lighthouse_tpu.network.fault_injection import FAULT_KINDS, FaultyRpc
+from lighthouse_tpu.network.gossip import GossipHub
+from lighthouse_tpu.network import sync as sync_mod
+from lighthouse_tpu.node import BeaconNode
+from lighthouse_tpu.state_processing.per_block import (
+    BlockSignatureStrategy,
+)
+from lighthouse_tpu.types.spec import minimal_spec
+
+from tests.test_data_availability import _blob
+
+N_VALIDATORS = 32
+N_SLOTS = 20
+BLOB_SLOTS = {9, 12, 17}  # bellatrix starts at slot 8 (epoch 1)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec(
+        name="minimal-sync-faults",
+        ALTAIR_FORK_EPOCH=0,
+        BELLATRIX_FORK_EPOCH=1,
+    )
+
+
+@pytest.fixture(scope="module")
+def net(spec):
+    """One honest grown node (module-scoped: growing the chain is the
+    expensive part). Returns (harness, genesis_state, honest_node,
+    {blob_block_root: n_sidecars})."""
+    h = Harness(spec, N_VALIDATORS, backend="ref")
+    genesis = h.state.copy()
+    a = BeaconNode("honest", genesis, spec, hub=GossipHub(), backend="ref")
+    blob_roots = {}
+    for slot in range(1, N_SLOTS + 1):
+        a.on_slot(slot)
+        if slot in BLOB_SLOTS:
+            blobs = [_blob(spec, slot * 8 + j) for j in range(2)]
+            comms = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+            block = h.produce_block(slot, [], blob_kzg_commitments=comms)
+            h.import_block(
+                block, strategy=BlockSignatureStrategy.NO_VERIFICATION
+            )
+            root = type(block.message).hash_tree_root(block.message)
+            for sc in h.make_blob_sidecars(block, blobs):
+                a.chain.process_blob_sidecar(sc)
+            a.chain.process_block(block)
+            blob_roots[root] = len(blobs)
+        else:
+            block = h.produce_block(slot, [])
+            h.import_block(
+                block, strategy=BlockSignatureStrategy.NO_VERIFICATION
+            )
+            a.chain.process_block(block)
+    assert a.chain.head_state.slot == N_SLOTS
+    return h, genesis, a, blob_roots
+
+
+_counter = {"n": 0}
+
+
+def _late_node(spec, genesis):
+    """A fresh late joiner on its own hub, with a no-op backoff sleep
+    (the delays are still COUNTED in the backoff metric)."""
+    _counter["n"] += 1
+    hub = GossipHub()
+    b = BeaconNode(
+        f"late{_counter['n']}", genesis, spec, hub=hub, backend="ref"
+    )
+    b.sync._sleep = lambda s: None
+    # scoreable peer entries for the req/resp handles we register
+    hub.join("honest", lambda *a: None)
+    hub.join("evil", lambda *a: None)
+    return hub, b
+
+
+def _registry_value(name, labels=None):
+    return REGISTRY.get_value(name, labels=labels)
+
+
+def test_chaos_smoke_converges_past_faulty_peer(spec, net):
+    """Tier-1 acceptance run: seeded mixed-fault peer tried FIRST on
+    every request, honest peer behind it — the node converges to the
+    honest head, every sidecar imports through the DA gate, the faulty
+    peer scores below the honest one, and retries stay bounded."""
+    h, genesis, a, blob_roots = net
+    head_slot = int(a.chain.head_state.slot)
+    hub, b = _late_node(spec, genesis)
+    evil = FaultyRpc(a.rpc, seed=1234, fault_rate=0.7)
+    # insertion order puts evil first among equal advertised heads
+    b.sync.add_peer("evil", evil)
+    b.sync.add_peer("honest", a.rpc)
+    b.on_slot(head_slot)
+
+    retries_before = _registry_value(
+        "lighthouse_tpu_sync_batch_retries_total"
+    )
+    backoff_before = _registry_value(
+        "lighthouse_tpu_sync_backoff_seconds_total"
+    )
+    imported = b.sync.run_range_sync(max_batches=32, batch_slots=8)
+
+    assert b.chain.head_root == a.chain.head_root
+    assert imported == head_slot
+    # every blob-committing block's sidecars crossed the DA gate and
+    # were persisted at import
+    for root, n in blob_roots.items():
+        got = b.chain.store.get_blob_sidecars(root)
+        assert len(got) == n, f"missing sidecars for {root.hex()}"
+    # the chaos actually fired...
+    assert sum(evil.injected.values()) > 0, evil.injected
+    # ...the faulty peer paid for it...
+    assert hub.peers["evil"].score < hub.peers["honest"].score
+    assert hub.peers["honest"].score >= 0
+    # ...and the retry/backoff loop is bounded and visible in the
+    # registry
+    retries = (
+        _registry_value("lighthouse_tpu_sync_batch_retries_total")
+        - retries_before
+    )
+    assert retries > 0
+    assert retries <= 32 * 2 * sync_mod.MAX_ATTEMPTS_PER_REQUEST
+    assert (
+        _registry_value("lighthouse_tpu_sync_backoff_seconds_total")
+        > backoff_before
+    )
+
+
+def test_status_cache_survives_many_batches(spec, net):
+    """Satellite: _best_peer must not burn the 5-token/15 s status
+    bucket on every batch — a long sync with tiny batches must issue a
+    handful of status calls, not one per batch."""
+    h, genesis, a, blob_roots = net
+    head_slot = int(a.chain.head_state.slot)
+    hub, b = _late_node(spec, genesis)
+
+    calls = {"status": 0}
+
+    class CountingRpc:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def __getattr__(self, name):
+            attr = getattr(self.inner, name)
+            if name == "status":
+                def counted(*a, **kw):
+                    calls["status"] += 1
+                    return attr(*a, **kw)
+
+                return counted
+            return attr
+
+    b.sync.add_peer("honest", CountingRpc(a.rpc))
+    b.on_slot(head_slot)
+    # 2-slot batches -> >= 10 batch iterations over the 20-slot chain;
+    # the pre-TTL-cache code would stall on its own status polling
+    imported = b.sync.run_range_sync(max_batches=64, batch_slots=2)
+    assert imported == head_slot
+    assert b.chain.head_root == a.chain.head_root
+    assert calls["status"] <= 3, calls
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", FAULT_KINDS)
+def test_chaos_matrix_single_fault_kinds(spec, net, kind):
+    """Slow tier: each fault kind at rate 1.0 on the first-tried peer —
+    every mix must still converge through the honest peer."""
+    h, genesis, a, blob_roots = net
+    head_slot = int(a.chain.head_state.slot)
+    hub, b = _late_node(spec, genesis)
+    evil = FaultyRpc(
+        a.rpc,
+        seed=100 + FAULT_KINDS.index(kind),
+        fault_rate=1.0,
+        kinds=(kind,),
+    )
+    b.sync.add_peer("evil", evil)
+    b.sync.add_peer("honest", a.rpc)
+    b.on_slot(head_slot)
+    b.sync.run_range_sync(max_batches=64, batch_slots=8)
+    assert b.chain.head_root == a.chain.head_root, kind
+    for root, n in blob_roots.items():
+        assert len(b.chain.store.get_blob_sidecars(root)) == n, kind
+    assert evil.injected[kind] > 0
+
+
+@pytest.mark.slow
+def test_chaos_two_faulty_one_honest(spec, net):
+    """Slow tier: two differently-seeded mixed-fault peers plus one
+    honest peer; quarantine + rotation must still converge."""
+    h, genesis, a, blob_roots = net
+    head_slot = int(a.chain.head_state.slot)
+    hub, b = _late_node(spec, genesis)
+    hub.join("evil2", lambda *a: None)
+    b.sync.add_peer("evil", FaultyRpc(a.rpc, seed=7, fault_rate=0.9))
+    b.sync.add_peer("evil2", FaultyRpc(a.rpc, seed=8, fault_rate=0.9))
+    b.sync.add_peer("honest", a.rpc)
+    b.on_slot(head_slot)
+    b.sync.run_range_sync(max_batches=64, batch_slots=8)
+    assert b.chain.head_root == a.chain.head_root
+    for root, n in blob_roots.items():
+        assert len(b.chain.store.get_blob_sidecars(root)) == n
+
+
+def test_sync_advances_past_skip_slot_window(spec):
+    """An all-skip-slot window must not pin the sync: a unanimous empty
+    answer from the usable peers advances the fetch cursor past the
+    window (blocks beyond it still chain to our head), with no
+    quarantine and no score damage for the honest peer."""
+    h = Harness(spec, N_VALIDATORS, backend="fake")
+    genesis = h.state.copy()
+    a = BeaconNode(
+        "honest-skip", genesis, spec, hub=GossipHub(), backend="fake"
+    )
+    for slot in (1, 2, 6, 7, 8):  # slots 3-5 are skipped
+        a.on_slot(slot)
+        block = h.produce_block(slot, [])
+        h.import_block(
+            block, strategy=BlockSignatureStrategy.NO_VERIFICATION
+        )
+        a.chain.process_block(block)
+    hub = GossipHub()
+    b = BeaconNode("late-skip", genesis, spec, hub=hub, backend="fake")
+    b.sync._sleep = lambda s: None
+    hub.join("honest-skip", lambda *x: None)
+    b.sync.add_peer("honest-skip", a.rpc)
+    b.on_slot(8)
+    # 2-slot batches force a window ([3,4]) that is entirely empty
+    imported = b.sync.run_range_sync(max_batches=16, batch_slots=2)
+    assert imported == 5
+    assert b.chain.head_root == a.chain.head_root
+    assert "honest-skip" not in b.sync.quarantined
+    assert hub.peers["honest-skip"].score >= 0
+
+
+def test_lookup_parent_fetches_blob_sidecars(spec, net):
+    """DA-gap closure for unknown-parent recovery: a gossip block whose
+    parent commits to blobs imports after lookup_parent fetches the
+    parent AND its sidecars over req/resp. A peer serving a wrong
+    by-root block is downscored. (Extends the module chain; runs after
+    the range-sync tests by file order.)"""
+    h, genesis, a, blob_roots = net
+    head_slot = int(a.chain.head_state.slot)
+    hub, b = _late_node(spec, genesis)
+    b.sync.add_peer("honest", a.rpc)
+    b.on_slot(head_slot)
+    assert b.sync.run_range_sync(max_batches=32) == head_slot
+
+    # grow A by a blob-committing parent P and a plain child C that B
+    # only ever sees via gossip
+    p_slot = head_slot + 1
+    blobs = [_blob(spec, 999), _blob(spec, 998)]
+    comms = [kzg.blob_to_kzg_commitment(bl) for bl in blobs]
+    parent = h.produce_block(p_slot, [], blob_kzg_commitments=comms)
+    h.import_block(
+        parent, strategy=BlockSignatureStrategy.NO_VERIFICATION
+    )
+    p_root = type(parent.message).hash_tree_root(parent.message)
+    a.on_slot(p_slot)
+    for sc in h.make_blob_sidecars(parent, blobs):
+        a.chain.process_blob_sidecar(sc)
+    a.chain.process_block(parent)
+    child = h.produce_block(p_slot + 1, [])
+    h.import_block(
+        child, strategy=BlockSignatureStrategy.NO_VERIFICATION
+    )
+    a.on_slot(p_slot + 1)
+    a.chain.process_block(child)
+
+    b.on_slot(p_slot + 1)
+    # gossip delivery of the child hits 'unknown parent' and the node's
+    # recovery pulls P + its sidecars over req/resp
+    b.processor.submit("gossip_block", (child, "honest"))
+    b.processor.process_pending()
+    assert b.chain.store.get_block(p_root) is not None
+    assert len(b.chain.store.get_blob_sidecars(p_root)) == len(blobs)
+    assert b.chain.head_root == a.chain.head_root
