@@ -29,6 +29,7 @@ from lighthouse_tpu.beacon_chain.observed import (
     ObservedSyncContributors,
 )
 from lighthouse_tpu.beacon_chain.operation_pool import OperationPool
+from lighthouse_tpu.common.events_journal import Journal
 from lighthouse_tpu.common.metrics import RegistryBackedMetrics
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.fork_choice import ForkChoice
@@ -104,6 +105,11 @@ class BeaconChain:
         self.execution_layer = execution_layer
         self.t = types_for(spec)
         self.backend = backend
+        # per-node lifecycle event journal: every subsystem this chain
+        # assembles (DA checker, sync manager, beacon processor, HTTP
+        # API) emits into THIS instance, so multi-node simulations keep
+        # separate forensic records (common/events_journal.py)
+        self.journal = Journal()
         self.store = HotColdDB(kv or MemoryStore(), spec)
         self.pubkey_cache = PubkeyCache()
         self.pubkey_cache.import_new(genesis_state)
@@ -151,6 +157,7 @@ class BeaconChain:
             spec,
             backend=backend,
             current_slot_fn=self.current_slot,
+            journal=self.journal,
         )
         # a released block that fails import for NON-DA reasons (e.g.
         # unknown parent) is handed here; the node wires in its
@@ -207,7 +214,7 @@ class BeaconChain:
         )
 
         self.events = EventBus()
-        self.validator_monitor = ValidatorMonitor()
+        self.validator_monitor = ValidatorMonitor(journal=self.journal)
 
         # finality-driven store lifecycle (migrate.rs:29-35): head
         # recompute notifies the migrator on every finalization advance.
@@ -283,6 +290,12 @@ class BeaconChain:
 
     def set_slot(self, slot: int):
         self.fork_choice.set_slot(slot)
+        # close out completed validator-monitor epochs (summaries into
+        # the journal; expected proposals from the proposer cache)
+        self.validator_monitor.advance(
+            self.spec.slot_to_epoch(slot),
+            proposers_fn=self.proposers_for_epoch,
+        )
         self.attester_cache.prune(self.finalized_checkpoint.epoch)
         self.naive_pool.prune(slot)
         self.observed_aggregates.prune(slot)
@@ -329,12 +342,61 @@ class BeaconChain:
 
     # ----------------------------------------------------- block pipeline
 
+    @staticmethod
+    def _import_outcome(msg: str) -> str:
+        """BlockError message -> journal outcome vocabulary."""
+        if "already" in msg:
+            return "duplicate"
+        if "data unavailable" in msg:
+            return "held"
+        return "rejected"
+
+    def _journaled_import(self, signed_block, block_root, inner, **extra):
+        """Run one import attempt, landing its terminal — imported,
+        held, rejected, duplicate — as ONE `block_import` journal event
+        keyed by the block root (shared by the gossip and sync paths so
+        the forensic record cannot diverge between them)."""
+        slot = int(signed_block.message.slot)
+        t0 = time.perf_counter()
+        try:
+            result = inner()
+        except BlockError as e:
+            msg = str(e)
+            self.journal.emit(
+                "block_import",
+                root=block_root,
+                slot=slot,
+                outcome=self._import_outcome(msg),
+                duration_s=time.perf_counter() - t0,
+                reason=msg,
+                **extra,
+            )
+            raise
+        self.journal.emit(
+            "block_import",
+            root=block_root,
+            slot=slot,
+            outcome="imported",
+            duration_s=time.perf_counter() - t0,
+            **extra,
+        )
+        return result
+
     def process_block(self, signed_block):
         """Full import pipeline: structural gossip checks -> bulk signature
         verification + state transition -> fork choice -> store -> head."""
+        block_root = type(signed_block.message).hash_tree_root(
+            signed_block.message
+        )
+        return self._journaled_import(
+            signed_block,
+            block_root,
+            lambda: self._process_block_inner(signed_block, block_root),
+        )
+
+    def _process_block_inner(self, signed_block, block_root):
         spec = self.spec
         block = signed_block.message
-        block_root = type(block).hash_tree_root(block)
         parent_root = bytes(block.parent_root)
 
         if block_root in self._snapshots:
@@ -570,9 +632,20 @@ class BeaconChain:
                 )
             except BlockProcessingError as e:
                 raise BlockError(f"segment block invalid: {e}") from e
-        if not collector.sets or not bls.verify_signature_sets(
+        batch_ok = bool(collector.sets) and bls.verify_signature_sets(
             collector.sets, backend=self.backend
-        ):
+        )
+        # signature-batch membership: one event records how many sets
+        # from how many blocks shared this bulk verification, so a
+        # segment failure is attributable to the batch that carried it
+        self.journal.emit(
+            "signature_batch",
+            slot=int(signed_blocks[-1].message.slot),
+            outcome="ok" if batch_ok else "failed",
+            n_sets=len(collector.sets),
+            n_blocks=len(signed_blocks),
+        )
+        if not batch_ok:
             raise BlockError("segment signature batch failed")
         # apply for real through the normal pipeline (signatures already
         # batch-checked; per-block re-verification is skipped)
@@ -658,19 +731,31 @@ class BeaconChain:
             DataAvailabilityError,
         )
 
+        precomputed = None
         if verify_header:
             # cheap structural rejections FIRST: index/horizon junk and
-            # exact redeliveries must never cost a pairing
-            self.da_checker.precheck_sidecar(sidecar)
+            # exact redeliveries must never cost a pairing. The returned
+            # (root, digest) pair rides into put_sidecar so the gossip
+            # hot path hashes the sidecar ONCE, not twice.
+            precomputed = self.da_checker.precheck_sidecar(sidecar)
             if not self.verify_blob_sidecar_header(sidecar):
                 self.metrics["sidecar_header_sig_failures"] = (
                     self.metrics.get("sidecar_header_sig_failures", 0)
                     + 1
                 )
+                self.journal.emit(
+                    "sidecar",
+                    root=precomputed[0],
+                    slot=int(sidecar.signed_block_header.message.slot),
+                    outcome="header_sig_invalid",
+                    index=int(sidecar.index),
+                )
                 raise DataAvailabilityError(
                     "blob sidecar proposer signature invalid"
                 )
-        released = self.da_checker.put_sidecar(sidecar)
+        released = self.da_checker.put_sidecar(
+            sidecar, precomputed=precomputed
+        )
         self.metrics["blob_sidecars_processed"] = (
             self.metrics.get("blob_sidecars_processed", 0) + 1
         )
@@ -688,13 +773,23 @@ class BeaconChain:
         return imported
 
     def _import_verified(self, signed_block):
+        block_root = type(signed_block.message).hash_tree_root(
+            signed_block.message
+        )
+        self._journaled_import(
+            signed_block,
+            block_root,
+            lambda: self._import_verified_inner(signed_block, block_root),
+            path="sync",
+        )
+
+    def _import_verified_inner(self, signed_block, block_root):
         from lighthouse_tpu.beacon_chain.data_availability_checker import (
             DataAvailabilityError,
         )
 
         spec = self.spec
         block = signed_block.message
-        block_root = type(block).hash_tree_root(block)
         parent_root = bytes(block.parent_root)
         # the availability invariant holds on the sync path too: a
         # segment block committing to blobs imports only if its
@@ -840,6 +935,7 @@ class BeaconChain:
         naive aggregation pool."""
         state = self.head_state
         results = attn.batch_verify_unaggregated(self, state, attestations)
+        accepted = 0
         for res in results:
             if isinstance(res, attn.VerifiedAttestation):
                 self.fork_choice.on_attestation(
@@ -849,6 +945,16 @@ class BeaconChain:
                 )
                 self.naive_pool.insert(res.attestation)
                 self.metrics["attestations_processed"] += 1
+                accepted += 1
+        if results:
+            self.journal.emit(
+                "attestation_batch",
+                slot=int(attestations[0].data.slot),
+                outcome="ok" if accepted == len(results) else "partial",
+                n=len(results),
+                accepted=accepted,
+                aggregated=False,
+            )
         return results
 
     def process_aggregated_attestations(self, signed_aggregates):
@@ -856,6 +962,7 @@ class BeaconChain:
         results = attn.batch_verify_aggregates(
             self, state, signed_aggregates
         )
+        accepted = 0
         for res in results:
             if isinstance(res, attn.VerifiedAttestation):
                 self.fork_choice.on_attestation(
@@ -865,6 +972,18 @@ class BeaconChain:
                 )
                 self.op_pool.insert_attestation(res.attestation)
                 self.metrics["attestations_processed"] += 1
+                accepted += 1
+        if results:
+            self.journal.emit(
+                "attestation_batch",
+                slot=int(
+                    signed_aggregates[0].message.aggregate.data.slot
+                ),
+                outcome="ok" if accepted == len(results) else "partial",
+                n=len(results),
+                accepted=accepted,
+                aggregated=True,
+            )
         return results
 
     # ----------------------------------------------------- sync committee

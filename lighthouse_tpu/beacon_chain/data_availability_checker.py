@@ -46,6 +46,7 @@ pass consensus validation.
 import hashlib
 import time
 
+from lighthouse_tpu.common.events_journal import JOURNAL
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.common.tracing import span
 
@@ -144,14 +145,62 @@ class DataAvailabilityChecker:
     MAX_PENDING_ENTRIES = 512
     MAX_CANDIDATES_PER_INDEX = 4
 
-    def __init__(self, spec, backend: str = "ref", current_slot_fn=None):
+    def __init__(
+        self,
+        spec,
+        backend: str = "ref",
+        current_slot_fn=None,
+        journal=None,
+    ):
         self.spec = spec
         # "fake" BLS backend means structural testing with no real
         # pairing plane — map it onto the fake KZG backend too
         self.backend = backend if backend in ("ref", "tpu", "fake") else "ref"
         self.current_slot_fn = current_slot_fn
+        # per-node lifecycle journal (the chain passes its own); every
+        # sidecar outcome counted in the da_sidecars_total family also
+        # lands as a root/index-correlated journal event
+        self.journal = journal if journal is not None else JOURNAL
         self.observed = ObservedBlobSidecars()
         self._pending: dict[bytes, _PendingComponents] = {}
+
+    def _note_sidecar(
+        self, outcome: str, root=None, index=None, slot=None, n: int = 1
+    ):
+        """One sidecar outcome -> Prometheus counter + journal event."""
+        _SIDECARS.labels(outcome).inc(n)
+        self.journal.emit(
+            "sidecar",
+            root=root,
+            slot=slot,
+            outcome=outcome,
+            index=index,
+            **({"n": n} if n != 1 else {}),
+        )
+
+    def stats(self) -> dict:
+        """Occupancy snapshot for the health plane. Reads race import
+        threads (the checker carries no lock), so every container is
+        snapshotted with an ATOMIC C-level copy (list(dict.values()))
+        before iteration — a concurrent put/evict shifts the numbers by
+        one but can never raise mid-scrape."""
+        entries = list(self._pending.values())
+        candidates = 0
+        verified = 0
+        held = 0
+        for e in entries:
+            candidates += sum(
+                len(c) for c in list(e.candidates.values())
+            )
+            verified += len(e.sidecars)
+            if e.block is not None:
+                held += 1
+        return {
+            "pending_entries": len(entries),
+            "held_blocks": held,
+            "cached_candidates": candidates,
+            "verified_sidecars": verified,
+        }
 
     def _drop_entry(self, block_root: bytes):
         """Evict one root and forget every digest it recorded —
@@ -303,7 +352,9 @@ class DataAvailabilityChecker:
                     discarded.append((i, digest, sc))
         entry.candidates.clear()
         if discarded:
-            _SIDECARS.labels("mismatched_commitment").inc(len(discarded))
+            self._note_sidecar(
+                "mismatched_commitment", root=block_root, n=len(discarded)
+            )
         if matching:
             from lighthouse_tpu.kzg import KzgError
 
@@ -327,8 +378,10 @@ class DataAvailabilityChecker:
                     # one malformed candidate must not sink the rest
                     accepted = _verify_singly()
             if len(accepted) < len(matching):
-                _SIDECARS.labels("invalid_proof").inc(
-                    len(matching) - len(accepted)
+                self._note_sidecar(
+                    "invalid_proof",
+                    root=block_root,
+                    n=len(matching) - len(accepted),
                 )
             accepted_set = {id(item[2]) for item in accepted}
             discarded.extend(
@@ -337,8 +390,22 @@ class DataAvailabilityChecker:
             for i, digest, sc in accepted:
                 if i in entry.sidecars:
                     continue  # two valid candidates for an index: keep one
-                _SIDECARS.labels("verified").inc()
+                self._note_sidecar(
+                    "verified",
+                    root=block_root,
+                    index=i,
+                    slot=int(sc.signed_block_header.message.slot),
+                )
                 entry.sidecars[i] = sc
+            self.journal.emit(
+                "da_settle",
+                root=block_root,
+                outcome="ok" if len(accepted) == len(matching) else (
+                    "partial"
+                ),
+                n_matched=len(matching),
+                n_accepted=len(accepted),
+            )
         for i, digest, sc in discarded:
             self.observed.forget(
                 int(sc.signed_block_header.message.slot),
@@ -349,59 +416,68 @@ class DataAvailabilityChecker:
 
     # ------------------------------------------------------------ sidecars
 
-    def precheck_sidecar(self, sidecar):
-        """Cheap structural rejections — index bound, clock horizon,
-        exact-duplicate — WITHOUT mutating any cache. The chain runs
-        this BEFORE the proposer-signature pairing so junk costs O(1),
-        never a pairing (cheap-checks-first DoS ordering); put_sidecar
-        re-runs the same checks as its own gate."""
+    def _structural_gate(self, sidecar, precomputed=None):
+        """Shared cheap checks — index bound, clock horizon, exact
+        duplicate. Returns (block_root, digest); `precomputed` skips the
+        two hashes when a previous precheck already paid them (the
+        gossip path's root/digest plumbing — PR 5 deferred note)."""
         spec = self.spec
         header = sidecar.signed_block_header.message
-        block_root = type(header).hash_tree_root(header)
         index = int(sidecar.index)
         slot = int(header.slot)
+        if precomputed is not None:
+            block_root, digest = precomputed
+        else:
+            block_root = type(header).hash_tree_root(header)
+            digest = None  # computed only if the cheap bounds pass
         if index >= spec.MAX_BLOBS_PER_BLOCK:
-            _SIDECARS.labels("bad_index").inc()
+            self._note_sidecar(
+                "bad_index", root=block_root, index=index, slot=slot
+            )
             raise DataAvailabilityError(
                 f"sidecar index {index} out of range"
             )
         if not self._slot_in_horizon(slot):
-            _SIDECARS.labels("future_slot").inc()
+            self._note_sidecar(
+                "future_slot", root=block_root, index=index, slot=slot
+            )
             raise DataAvailabilityError(
                 f"sidecar slot {slot} beyond the clock horizon"
             )
-        digest = hashlib.sha256(sidecar.to_bytes()).digest()
+        if digest is None:
+            digest = hashlib.sha256(sidecar.to_bytes()).digest()
         if self.observed.is_known(slot, block_root, index, digest):
-            _SIDECARS.labels("duplicate").inc()
+            self._note_sidecar(
+                "duplicate", root=block_root, index=index, slot=slot
+            )
             raise DataAvailabilityError("duplicate sidecar")
+        return block_root, digest
 
-    def put_sidecar(self, sidecar) -> list:
+    def precheck_sidecar(self, sidecar):
+        """Cheap structural rejections — index bound, clock horizon,
+        exact-duplicate — WITHOUT mutating any cache. The chain runs
+        this BEFORE the proposer-signature pairing so junk costs O(1),
+        never a pairing (cheap-checks-first DoS ordering). Returns the
+        (block_root, content digest) pair so the caller can hand it
+        back to put_sidecar and skip the second hashing pass."""
+        return self._structural_gate(sidecar)
+
+    def put_sidecar(self, sidecar, precomputed=None) -> list:
         """Validate + record one gossip sidecar. Returns the list of
         released (now fully-available) held blocks — usually empty or
         one. Raises DataAvailabilityError on invalid/duplicate input.
         Sidecars for still-unknown blocks are cached WITHOUT any
         pairing work (verification happens when the block names their
-        commitment — see the module docstring)."""
-        spec = self.spec
+        commitment — see the module docstring). `precomputed` is the
+        (block_root, digest) pair a precheck_sidecar call already
+        derived (halves gossip-path sidecar hashing); the structural
+        checks themselves are re-run as this method's own gate."""
         header = sidecar.signed_block_header.message
-        block_root = type(header).hash_tree_root(header)
         index = int(sidecar.index)
         slot = int(header.slot)
-
-        if index >= spec.MAX_BLOBS_PER_BLOCK:
-            _SIDECARS.labels("bad_index").inc()
-            raise DataAvailabilityError(
-                f"sidecar index {index} out of range"
-            )
-        if not self._slot_in_horizon(slot):
-            _SIDECARS.labels("future_slot").inc()
-            raise DataAvailabilityError(
-                f"sidecar slot {slot} beyond the clock horizon"
-            )
-        digest = hashlib.sha256(sidecar.to_bytes()).digest()
-        if self.observed.is_known(slot, block_root, index, digest):
-            _SIDECARS.labels("duplicate").inc()
-            raise DataAvailabilityError("duplicate sidecar")
+        block_root, digest = self._structural_gate(
+            sidecar, precomputed=precomputed
+        )
 
         entry = self._pending.get(block_root)
         if entry is None or entry.commitments is None:
@@ -419,18 +495,33 @@ class DataAvailabilityChecker:
                     # and even then costs only a delayed import — see
                     # module docstring). Not observed: a post-block
                     # redelivery verifies fresh.
-                    _SIDECARS.labels("candidate_overflow").inc()
+                    self._note_sidecar(
+                        "candidate_overflow",
+                        root=block_root,
+                        index=index,
+                        slot=slot,
+                    )
                     return []
                 cands[digest] = sidecar
             self.observed.observe(slot, block_root, index, digest)
-            _SIDECARS.labels("cached_pending_block").inc()
+            self._note_sidecar(
+                "cached_pending_block",
+                root=block_root,
+                index=index,
+                slot=slot,
+            )
             return []
 
         # block known: cross-check against the body, then verify NOW
         if index >= len(entry.commitments) or bytes(
             sidecar.kzg_commitment
         ) != entry.commitments[index]:
-            _SIDECARS.labels("mismatched_commitment").inc()
+            self._note_sidecar(
+                "mismatched_commitment",
+                root=block_root,
+                index=index,
+                slot=slot,
+            )
             raise DataAvailabilityError(
                 "sidecar commitment does not match the block body"
             )
@@ -440,13 +531,19 @@ class DataAvailabilityChecker:
             try:
                 ok = self._verify_batch([sidecar])
             except KzgError as e:
-                _SIDECARS.labels("invalid_proof").inc()
+                self._note_sidecar(
+                    "invalid_proof", root=block_root, index=index, slot=slot
+                )
                 raise DataAvailabilityError(f"malformed sidecar: {e}") from e
         if not ok:
-            _SIDECARS.labels("invalid_proof").inc()
+            self._note_sidecar(
+                "invalid_proof", root=block_root, index=index, slot=slot
+            )
             raise DataAvailabilityError("KZG proof verification failed")
 
-        _SIDECARS.labels("verified").inc()
+        self._note_sidecar(
+            "verified", root=block_root, index=index, slot=slot
+        )
         self.observed.observe(slot, block_root, index, digest)
         if index not in entry.sidecars:
             entry.sidecars[index] = sidecar
@@ -466,8 +563,18 @@ class DataAvailabilityChecker:
         popping here would re-hold the block forever."""
         if entry.block is not None:
             _BLOCKS_RELEASED.inc()
+            held_s = None
             if entry.t_held is not None:
-                _HOLD_SECONDS.observe(time.monotonic() - entry.t_held)
+                held_s = time.monotonic() - entry.t_held
+                _HOLD_SECONDS.observe(held_s)
+            self.journal.emit(
+                "block_release",
+                root=block_root,
+                slot=int(entry.block.message.slot),
+                outcome="complete",
+                duration_s=held_s,
+                n_sidecars=len(entry.sidecars),
+            )
             entry.block = None
             entry.t_held = None
         _PENDING_BLOCKS.set(len(self.pending_block_roots()))

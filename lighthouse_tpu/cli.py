@@ -53,14 +53,31 @@ def _apply_trace_flags(args) -> None:
     tracing.configure(enabled=capacity > 0, capacity=max(capacity, 1))
 
 
-def _export_trace(args) -> None:
-    """Dump the buffered span trees as JSONL on shutdown when asked."""
+def _apply_journal_flags(chain, args) -> None:
+    """Size (or disable, with 0) the node's lifecycle event journal."""
+    from lighthouse_tpu.common import events_journal
+
+    capacity = getattr(
+        args, "journal_buffer", events_journal.DEFAULT_CAPACITY
+    )
+    chain.journal.configure(
+        enabled=capacity > 0, capacity=max(capacity, 1)
+    )
+
+
+def _export_trace(args, chain=None) -> None:
+    """Dump the buffered span trees (and journal events) as JSONL on
+    shutdown when asked."""
     path = getattr(args, "trace_jsonl", None)
     if path:
         from lighthouse_tpu.common.tracing import TRACER
 
         n = TRACER.export_jsonl(path)
         print(f"wrote {n} span trees to {path}")
+    jpath = getattr(args, "journal_jsonl", None)
+    if jpath and chain is not None:
+        n = chain.journal.export_jsonl(jpath)
+        print(f"wrote {n} journal events to {jpath}")
 
 
 def _serve_api(chain, args, banner: str) -> int:
@@ -69,6 +86,7 @@ def _serve_api(chain, args, banner: str) -> int:
     from lighthouse_tpu.http_api import BeaconApiServer
 
     _apply_store_flags(chain, args)
+    _apply_journal_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
     ).start()
@@ -78,7 +96,7 @@ def _serve_api(chain, args, banner: str) -> int:
             time.sleep(args.serve_seconds)
     finally:
         srv.stop()
-        _export_trace(args)
+        _export_trace(args, chain)
     return 0
 
 
@@ -201,6 +219,7 @@ def cmd_bn(args):
         h.state.copy(), spec, kv=kv, backend=args.bls_backend
     )
     _apply_store_flags(chain, args)
+    _apply_journal_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
     ).start()
@@ -224,7 +243,7 @@ def cmd_bn(args):
                 time.sleep(spec.SECONDS_PER_SLOT)
     finally:
         srv.stop()
-        _export_trace(args)
+        _export_trace(args, chain)
     return 0
 
 
@@ -564,6 +583,20 @@ def build_parser():
         default=None,
         help="write the buffered span trees to this JSONL file on "
         "shutdown (bench attribution input)",
+    )
+    bn.add_argument(
+        "--journal-buffer",
+        type=int,
+        default=4096,
+        help="lifecycle event-journal ring capacity, served at GET "
+        "/lighthouse/events (0 disables the journal entirely; the "
+        "underlying subsystem counters keep counting)",
+    )
+    bn.add_argument(
+        "--journal-jsonl",
+        default=None,
+        help="write the buffered journal events to this JSONL file on "
+        "shutdown (chaos-run forensics input)",
     )
     bn.set_defaults(fn=cmd_bn)
 

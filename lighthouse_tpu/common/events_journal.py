@@ -1,0 +1,265 @@
+"""Object-lifecycle event journal: correlation-ID'd forensic record.
+
+Role of the reference's structured `tracing` event stream (every
+subsystem logs slot/root/peer-attributed events through the
+tracing-subscriber layer), shaped as a queryable ring: every block, blob
+sidecar, and attestation batch entering via gossip or RPC is tracked by
+a STABLE correlation id — the block root for blocks, (block root, index)
+for sidecars — and every decision point along its lifecycle emits one
+typed `Event`:
+
+  * beacon-processor enqueue/drop/batch (queue plane),
+  * signature-batch membership (one event per bulk batch),
+  * DA precheck/candidate-cache/settle/release outcomes,
+  * sync request attempts, batch outcomes, retry/rotation,
+  * peer downscores and quarantines with their reasons,
+  * block import/reject with the reason string,
+  * per-epoch validator-monitor summaries.
+
+Events land in a bounded ring buffer (oldest evicted; evictions
+counted), are mirrored into the process registry as
+``lighthouse_tpu_journal_events_total{kind,outcome}`` so the /metrics
+scrape and the journal can be cross-checked against each other, and are
+served over ``GET /lighthouse/events?root=…&slot=…&kind=…`` for
+per-object forensics ("what happened to THIS block on THIS node and
+why"). `bn --journal-jsonl` exports the ring on shutdown, mirroring the
+PR 2 `--trace-jsonl` flag.
+
+The journal is PER NODE: each `BeaconChain` owns a `Journal` instance
+threaded through its DA checker, sync manager, beacon processor, and
+HTTP API, so multi-node simulations (one process, many nodes) keep
+their forensic records separate. The module-level `JOURNAL` is the
+default for code running outside a chain.
+
+Event kinds are a CLOSED vocabulary (`KINDS`): `emit` raises on an
+unregistered kind, and `scripts/check_metric_names.py` statically
+enforces that every call site uses a literal, registered kind — the
+same contract metric names live under.
+
+Overhead discipline: `emit` on a disabled journal is one attribute
+check and a return (measured ~0 — the import hot path pays nothing);
+enabled it is one small allocation, a deque append under the ring lock,
+and one counter increment (the mirror family goes dark when the
+journal is disabled; the underlying subsystem counters keep counting).
+"""
+
+import json
+import threading
+import time
+from collections import deque
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+# the closed event-kind vocabulary — extend HERE (and only here); the
+# metric-name lint rejects emit() calls with kinds outside this set
+KINDS = frozenset(
+    {
+        # queue plane (beacon_processor)
+        "processor_enqueue",
+        "processor_drop",
+        "processor_batch",
+        # block lifecycle (chain)
+        "block_import",
+        "block_release",
+        "signature_batch",
+        "attestation_batch",
+        # data-availability lifecycle (da_checker)
+        "sidecar",
+        "da_settle",
+        # req/resp sync lifecycle (sync manager)
+        "sync_request",
+        "sync_batch",
+        # peer scoring
+        "peer_downscore",
+        "peer_quarantine",
+        # validator monitor
+        "validator_summary",
+    }
+)
+
+DEFAULT_CAPACITY = 4096
+
+_EVENTS_TOTAL = REGISTRY.counter_vec(
+    "lighthouse_tpu_journal_events_total",
+    "object-lifecycle journal events, by kind and outcome",
+    ("kind", "outcome"),
+)
+_DROPPED_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_journal_dropped_total",
+    "journal events evicted from the ring buffer (oldest-first)",
+)
+
+
+class Event:
+    __slots__ = (
+        "seq", "t", "kind", "slot", "root", "peer", "outcome",
+        "duration_s", "attrs",
+    )
+
+    def __init__(
+        self, seq, kind, slot, root, peer, outcome, duration_s, attrs
+    ):
+        self.seq = seq
+        self.t = time.time()
+        self.kind = kind
+        self.slot = slot
+        self.root = root
+        self.peer = peer
+        self.outcome = outcome
+        self.duration_s = duration_s
+        self.attrs = attrs
+
+    def to_dict(self) -> dict:
+        out = {"seq": self.seq, "t": self.t, "kind": self.kind}
+        if self.slot is not None:
+            out["slot"] = int(self.slot)
+        if self.root is not None:
+            out["root"] = "0x" + self.root.hex()
+        if self.peer is not None:
+            out["peer"] = self.peer
+        if self.outcome is not None:
+            out["outcome"] = self.outcome
+        if self.duration_s is not None:
+            out["duration_s"] = self.duration_s
+        if self.attrs:
+            out["attrs"] = self.attrs
+        return out
+
+
+class Journal:
+    def __init__(
+        self, capacity: int = DEFAULT_CAPACITY, enabled: bool = True
+    ):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, capacity))
+        self._seq = 0
+        self.emitted = 0
+        self.dropped = 0
+
+    # ------------------------------------------------------- configuration
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
+
+    def configure(self, enabled=None, capacity=None):
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if capacity is not None:
+                self._ring = deque(
+                    self._ring, maxlen=max(1, int(capacity))
+                )
+
+    def reset(self):
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self.emitted = 0
+            self.dropped = 0
+
+    # -------------------------------------------------------------- emit
+
+    def emit(
+        self,
+        kind: str,
+        root: bytes | None = None,
+        slot: int | None = None,
+        peer: str | None = None,
+        outcome: str | None = None,
+        duration_s: float | None = None,
+        **attrs,
+    ):
+        """Record one lifecycle event. `root` is the object's correlation
+        id (block root; sidecars add an `index` attr). Raises ValueError
+        on a kind outside the registered vocabulary."""
+        if not self.enabled:
+            return None
+        if kind not in KINDS:
+            raise ValueError(f"unregistered journal event kind {kind!r}")
+        if root is not None:
+            root = bytes(root)
+        with self._lock:
+            self._seq += 1
+            ev = Event(
+                self._seq, kind, slot, root, peer, outcome, duration_s,
+                attrs,
+            )
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+                _DROPPED_TOTAL.inc()
+            self._ring.append(ev)
+            self.emitted += 1
+        _EVENTS_TOTAL.labels(kind, outcome or "none").inc()
+        return ev
+
+    # ------------------------------------------------------------- query
+
+    def query(
+        self,
+        root: bytes | str | None = None,
+        slot: int | None = None,
+        kind: str | None = None,
+        peer: str | None = None,
+        outcome: str | None = None,
+        limit: int | None = None,
+    ) -> list[dict]:
+        """Forensic filter over the ring, oldest first; `limit` keeps the
+        most recent N matches. `root` accepts bytes or 0x-hex."""
+        if isinstance(root, str):
+            root = bytes.fromhex(root[2:] if root.startswith("0x") else root)
+        with self._lock:
+            events = list(self._ring)
+        out = []
+        for ev in events:
+            if root is not None and ev.root != root:
+                continue
+            if slot is not None and ev.slot != slot:
+                continue
+            if kind is not None and ev.kind != kind:
+                continue
+            if peer is not None and ev.peer != peer:
+                continue
+            if outcome is not None and ev.outcome != outcome:
+                continue
+            out.append(ev.to_dict())
+        if limit is not None and limit >= 0:
+            out = out[-limit:] if limit else []
+        return out
+
+    def count(self, **filters) -> int:
+        return len(self.query(**filters))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "capacity": self._ring.maxlen,
+                "size": len(self._ring),
+                "emitted": self.emitted,
+                "dropped": self.dropped,
+            }
+
+    # ------------------------------------------------------------ export
+
+    def to_jsonl(self, limit: int | None = None) -> str:
+        docs = self.query(limit=limit)
+        if not docs:
+            return ""
+        return "\n".join(json.dumps(d) for d in docs) + "\n"
+
+    def export_jsonl(self, path, limit: int | None = None) -> int:
+        """Write the buffered events to `path`; returns the count."""
+        docs = self.query(limit=limit)
+        with open(path, "w") as f:
+            for d in docs:
+                f.write(json.dumps(d) + "\n")
+        return len(docs)
+
+
+JOURNAL = Journal()
+
+
+def configure(enabled=None, capacity=None):
+    JOURNAL.configure(enabled=enabled, capacity=capacity)

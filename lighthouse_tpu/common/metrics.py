@@ -323,6 +323,34 @@ class Registry:
         with self._lock:
             return list(self._metrics)
 
+    def snapshot(self) -> dict:
+        """Flat point-in-time view of every scalar series: series key
+        (``name{label="value",...}``) -> float. Histograms contribute
+        their ``_count`` and ``_sum`` series (bucket detail stays in the
+        text exposition). This is the data multi-node tests diff to
+        assert convergence and bounded scores WITHOUT reaching into node
+        internals — see `snapshot_diff`."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: dict[str, float] = {}
+        for m in metrics:
+            self._snapshot_metric(m, out)
+        return out
+
+    @staticmethod
+    def _snapshot_metric(m, out: dict):
+        if isinstance(m, _MetricVec):
+            for child in m.children().values():
+                Registry._snapshot_metric(child, out)
+            return
+        if isinstance(m, Histogram):
+            with m._lock:
+                out[f"{m.name}_count{_label_str(m._labels)}"] = float(m.n)
+                out[f"{m.name}_sum{_label_str(m._labels)}"] = float(m.total)
+            return
+        with m._lock:
+            out[f"{m.name}{_label_str(m._labels)}"] = float(m.value)
+
     def render(self) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
@@ -336,6 +364,23 @@ class Registry:
 
 
 REGISTRY = Registry()
+
+
+def snapshot_diff(before: dict, after: dict) -> dict:
+    """Series-keyed delta between two `Registry.snapshot` views: every
+    key whose value changed (or appeared) maps to ``after - before``.
+    Keys absent from `after` are reported at their negated `before`
+    value (a series cannot disappear from a live registry; this keeps
+    the function total)."""
+    out: dict[str, float] = {}
+    for key, v in after.items():
+        delta = v - before.get(key, 0.0)
+        if delta:
+            out[key] = delta
+    for key, v in before.items():
+        if key not in after and v:
+            out[key] = -v
+    return out
 
 
 # ------------------------------------------------ dict-compatible views
@@ -389,6 +434,13 @@ class RegistryBackedMetrics(MutableMapping):
 
     def __len__(self):
         return len(self._values)
+
+    def snapshot(self) -> dict:
+        """Atomic point-in-time copy (C-level plain-dict copy) — the
+        read for scrape/health threads while the owner mutates;
+        `dict(view)` goes through the MutableMapping iterator and can
+        raise mid-resize."""
+        return dict(self._values)
 
     def __repr__(self):
         return f"RegistryBackedMetrics({self._values!r})"

@@ -29,7 +29,12 @@ Endpoints (the operative subset):
   GET  /eth/v1/beacon/blocks/{id}/root | attestations
   GET  /eth/v1/config/spec | fork_schedule | deposit_contract
   GET  /eth/v1/node/identity | peers | peer_count
-  GET  /lighthouse/health  (chain internals namespace)
+  GET  /lighthouse/health  (per-node health document: head/finality,
+       queues, peer scores, DA occupancy, journal, validator monitor)
+  GET  /lighthouse/events?root=...&slot=...&kind=...&peer=...&outcome=...
+       (object-lifecycle journal forensics)
+  GET  /lighthouse/metrics/snapshot  (flat registry snapshot for diffs)
+  GET  /lighthouse/tpu/stats  (chain internals namespace)
   GET  /eth/v1/validator/attestation_data?slot=...&committee_index=...
   GET  /eth/v1/validator/aggregate_attestation?slot=...&attestation_data_root=...
   POST /eth/v1/validator/aggregate_and_proofs
@@ -68,6 +73,7 @@ _CACHE_STATS = REGISTRY.gauge_vec(
 _ROUTE_SEGMENTS = frozenset(
     """
     eth lighthouse v1 v2 metrics spans health tpu stats node beacon
+    snapshot
     config validator debug events genesis states headers blocks blinded
     blob_sidecars pool duties liveness register_validator blinded_blocks
     aggregate_and_proofs contribution_and_proofs aggregate_attestation
@@ -623,10 +629,7 @@ class BeaconApiServer:
                     "completed_roots": TRACER.completed_roots,
                 },
             }
-        if parts[:3] == ["lighthouse", "tpu", "stats"] or parts[:2] == [
-            "lighthouse",
-            "health",
-        ]:
+        if parts[:3] == ["lighthouse", "tpu", "stats"]:
             # lighthouse namespace analog: process + chain internals
             return {
                 "data": {
@@ -642,6 +645,40 @@ class BeaconApiServer:
                     "snapshots": len(chain._snapshots),
                 }
             }
+        if parts[:2] == ["lighthouse", "health"]:
+            return {"data": self._health_doc()}
+        if parts[:2] == ["lighthouse", "events"]:
+            # per-object forensic queries over the node's lifecycle
+            # journal: ?root=0x…&slot=…&kind=…&peer=…&outcome=…&limit=…
+            q = self._query(path)
+            kind = q.get("kind")
+            from lighthouse_tpu.common.events_journal import KINDS
+
+            if kind is not None and kind not in KINDS:
+                raise ApiError(400, f"unknown event kind {kind!r}")
+            root = q.get("root")
+            if root is not None:
+                try:
+                    bytes.fromhex(root[2:] if root.startswith("0x") else root)
+                except ValueError:
+                    raise ApiError(400, "invalid root") from None
+            events = chain.journal.query(
+                root=root,
+                slot=self._int_q(q, "slot"),
+                kind=kind,
+                peer=q.get("peer"),
+                outcome=q.get("outcome"),
+                limit=self._int_q(q, "limit"),
+            )
+            return {
+                "data": events,
+                "meta": chain.journal.stats(),
+            }
+        if parts[:3] == ["lighthouse", "metrics", "snapshot"]:
+            # flat registry snapshot (series key -> value): the remote
+            # half of the snapshot/diff API multi-node tests assert
+            # convergence and bounded scores from
+            return {"data": REGISTRY.snapshot()}
         if parts[:3] == ["eth", "v2", "beacon"]:
             if parts[3] == "blocks" and len(parts) >= 5:
                 block = self._resolve_block(parts[4])
@@ -953,6 +990,74 @@ class BeaconApiServer:
                     }
                 )
         return {"data": duties}
+
+    def _health_doc(self) -> dict:
+        """GET /lighthouse/health: one per-node health document — head
+        and finality distance, queue depths, peer-score summary, DA
+        cache occupancy, journal stats, validator-monitor report — so
+        multi-node tests and operators assert node state from data, not
+        internals."""
+        chain = self.chain
+        spec = chain.spec
+        fin = chain.finalized_checkpoint
+        current_epoch = spec.slot_to_epoch(chain.current_slot())
+        doc = {
+            "head": {
+                "slot": int(chain.head_state.slot),
+                "root": "0x" + chain.head_root.hex(),
+                "justified_epoch": int(
+                    chain.head_state.current_justified_checkpoint.epoch
+                ),
+                "finalized_epoch": int(fin.epoch),
+                "finality_distance_epochs": max(
+                    0, int(current_epoch) - int(fin.epoch)
+                ),
+                "sync_distance": self._sync_distance(),
+                "execution_optimistic": chain.fork_choice.is_optimistic(
+                    chain.head_root
+                ),
+            },
+            "da": chain.da_checker.stats(),
+            "journal": chain.journal.stats(),
+            "validator_monitor": (
+                chain.validator_monitor.health_summary()
+            ),
+            "metrics": chain.metrics.snapshot(),
+        }
+        node = getattr(self, "node", None)
+        processor = getattr(node, "processor", None)
+        if processor is not None:
+            doc["queues"] = processor.queue_depths()
+        # peer summary: scores from the gossip hub (shared scoring
+        # plane), quarantine view from the sync manager. dict() takes
+        # an atomic snapshot — network threads mutate peers.
+        self_id = getattr(node, "node_id", None)
+        scores = {}
+        hub = getattr(node, "hub", None)
+        for pid, peer in dict(getattr(hub, "peers", {})).items():
+            score = getattr(peer, "score", None)
+            if score is not None and pid != self_id:
+                scores[pid] = score
+        sync = getattr(self, "sync", None)
+        doc["peers"] = {
+            "count": len(scores) if scores else (
+                len(getattr(sync, "peers", {})) if sync else 0
+            ),
+            "quarantined": (
+                sorted(sync.quarantined.copy())
+                if sync is not None
+                else []
+            ),
+            "scores": {
+                "min": min(scores.values()),
+                "max": max(scores.values()),
+                "mean": sum(scores.values()) / len(scores),
+                "by_peer": scores,
+            }
+            if scores
+            else None,
+        }
+        return doc
 
     def _sync_distance(self) -> int:
         """Slots between the wall clock and the head — the standard

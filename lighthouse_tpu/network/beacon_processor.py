@@ -16,6 +16,7 @@ preserved because they shape the TPU data plane:
 import heapq
 import threading
 
+from lighthouse_tpu.common.events_journal import JOURNAL
 from lighthouse_tpu.common.locks import TimedLock
 from lighthouse_tpu.common.metrics import REGISTRY
 import time
@@ -32,7 +33,7 @@ _QUEUE_DEPTH = REGISTRY.gauge_vec(
 _QUEUE_EVENTS = REGISTRY.counter_vec(
     "lighthouse_tpu_beacon_processor_events_total",
     "beacon processor queue events (submitted/dropped/reprocess_"
-    "scheduled/processed) per kind",
+    "scheduled/processed/handler_error) per kind",
     ("kind", "event"),
 )
 _QUEUE_WAIT_SECONDS = REGISTRY.histogram_vec(
@@ -88,12 +89,32 @@ DEFAULT_BOUNDS = {
 ATTESTATION_BATCH_MAX = 64
 AGGREGATE_BATCH_MAX = 64
 
+# journal policy: per-item enqueue events only for the object kinds
+# whose lifecycle the journal correlates by root downstream (blocks,
+# sidecars, segments) — the 16k-deep attestation queue would otherwise
+# flush every block's forensic trail out of the ring. Drops are
+# journaled for EVERY kind (a dropped item is exactly the event a
+# forensic query needs), and each drained batch lands one
+# processor_batch event.
+_JOURNALED_ENQUEUE_KINDS = frozenset(
+    {"gossip_block", "gossip_blob_sidecar", "chain_segment"}
+)
+
 
 class BeaconProcessor:
-    def __init__(self, handlers, max_workers: int = 2, bounds=None):
+    # one journaled drop event per this many drops of a non-forensic
+    # kind (the exact count rides in the event's dropped_total attr)
+    DROP_SAMPLE = 256
+
+    def __init__(
+        self, handlers, max_workers: int = 2, bounds=None, journal=None
+    ):
         """handlers: kind -> callable(payload_or_batch). Attestation and
-        aggregate kinds receive LISTS (coalesced batches)."""
+        aggregate kinds receive LISTS (coalesced batches). `journal` is
+        the owning node's event journal (defaults to the process-global
+        one)."""
         self.handlers = handlers
+        self.journal = journal if journal is not None else JOURNAL
         self.bounds = dict(DEFAULT_BOUNDS)
         if bounds:
             self.bounds.update(bounds)
@@ -108,6 +129,11 @@ class BeaconProcessor:
         self._reprocess: list = []  # (ready_time, kind, payload)
         self.metrics = {"processed": 0, "reprocessed": 0, "dropped": 0}
 
+    def queue_depths(self) -> dict:
+        """Current depth per work kind (the health-plane read)."""
+        with self._lock:
+            return {k: len(q) for k, q in self._queues.items()}
+
     # -------------------------------------------------------------- submit
 
     def submit(self, kind: str, payload) -> bool:
@@ -118,6 +144,22 @@ class BeaconProcessor:
                 self._dropped[kind] += 1
                 self.metrics["dropped"] += 1
                 _QUEUE_EVENTS.labels(kind, "dropped").inc()
+                # drop journaling is per-item only for the forensic
+                # object kinds; a high-volume drop storm (attestation
+                # flood) is sampled every DROP_SAMPLE so it cannot
+                # flush the ring it is being recorded in (the counter
+                # above stays exact)
+                if (
+                    kind in _JOURNALED_ENQUEUE_KINDS
+                    or self._dropped[kind] % self.DROP_SAMPLE == 1
+                ):
+                    self.journal.emit(
+                        "processor_drop",
+                        outcome="queue_full",
+                        work=kind,
+                        depth=len(q),
+                        dropped_total=self._dropped[kind],
+                    )
                 return False
             self._seq += 1
             q.append(
@@ -128,6 +170,13 @@ class BeaconProcessor:
             )
             _QUEUE_EVENTS.labels(kind, "submitted").inc()
             _QUEUE_DEPTH.labels(kind).set(len(q))
+            if kind in _JOURNALED_ENQUEUE_KINDS:
+                self.journal.emit(
+                    "processor_enqueue",
+                    outcome="submitted",
+                    work=kind,
+                    depth=len(q),
+                )
             self._work_available.notify()
         return True
 
@@ -183,12 +232,42 @@ class BeaconProcessor:
             if nxt is None:
                 return n
             kind, payload = nxt
-            with _WORK_SECONDS.labels(kind).time():
-                self.handlers[kind](payload)
-            self.metrics["processed"] += 1
-            _QUEUE_EVENTS.labels(kind, "processed").inc()
+            self._run_batch(kind, payload)
             n += 1
         return n
+
+    def _run_batch(self, kind: str, payload):
+        """Run one drained batch through its handler, timing it into the
+        work histogram and journaling the batch. A raising handler is
+        counted as handler_error — in BOTH the event counter and the
+        journal, so the two stay cross-checkable — never as processed."""
+        t0 = time.perf_counter()
+        n = len(payload) if isinstance(payload, list) else 1
+        try:
+            self.handlers[kind](payload)
+        except Exception:
+            dt = time.perf_counter() - t0
+            _WORK_SECONDS.labels(kind).observe(dt)
+            _QUEUE_EVENTS.labels(kind, "handler_error").inc()
+            self.journal.emit(
+                "processor_batch",
+                outcome="handler_error",
+                duration_s=dt,
+                work=kind,
+                n=n,
+            )
+            raise
+        dt = time.perf_counter() - t0
+        _WORK_SECONDS.labels(kind).observe(dt)
+        self.metrics["processed"] += 1
+        _QUEUE_EVENTS.labels(kind, "processed").inc()
+        self.journal.emit(
+            "processor_batch",
+            outcome="processed",
+            duration_s=dt,
+            work=kind,
+            n=n,
+        )
 
     # ------------------------------------------------------ threaded mode
 
@@ -218,9 +297,6 @@ class BeaconProcessor:
                     continue
             kind, payload = nxt
             try:
-                with _WORK_SECONDS.labels(kind).time():
-                    self.handlers[kind](payload)
+                self._run_batch(kind, payload)
             except Exception:  # worker errors must not kill the pool
                 pass
-            self.metrics["processed"] += 1
-            _QUEUE_EVENTS.labels(kind, "processed").inc()

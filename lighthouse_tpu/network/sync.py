@@ -33,7 +33,8 @@ The req/resp plane is treated as adversarial:
 import random
 import time
 
-from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.events_journal import JOURNAL
+from lighthouse_tpu.common.metrics import REGISTRY, RegistryBackedMetrics
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.network.gossip import (
     SCORE_INVALID_MESSAGE,
@@ -135,13 +136,24 @@ class SyncManager:
         self.local_peer_id = local_peer_id
         self.peers: dict[str, object] = {}  # peer_id -> RpcServer handle
         self.quarantined: set[str] = set()
-        self.metrics = {
-            "batches": 0,
-            "blocks_synced": 0,
-            "retries": 0,
-            "requeues": 0,
-            "sidecars_fetched": 0,
-        }
+        # the node's lifecycle journal (chain-owned, per node): every
+        # request attempt, batch outcome, downscore, and quarantine
+        # lands there with peer attribution
+        self.journal = getattr(chain, "journal", None) or JOURNAL
+        # dict-compatible view mirrored onto lighthouse_tpu_sync_client_*
+        # registry gauges (PR 5 deferred note): sync internals, /metrics
+        # scrapes, and registry snapshots read the same numbers — the
+        # sync_* counter families above stay the cross-peer totals
+        self.metrics = RegistryBackedMetrics(
+            "lighthouse_tpu_sync_client_",
+            initial={
+                "batches": 0,
+                "blocks_synced": 0,
+                "retries": 0,
+                "requeues": 0,
+                "sidecars_fetched": 0,
+            },
+        )
         self.request_timeout = REQUEST_TIMEOUT_SECONDS
         self._status_cache: dict[str, tuple] = {}  # pid -> (status, t)
         self._rl_strikes: dict[str, int] = {}
@@ -180,6 +192,9 @@ class SyncManager:
 
     def _downscore(self, peer_id: str, delta: float, reason: str):
         _DOWNSCORES.labels(reason).inc()
+        self.journal.emit(
+            "peer_downscore", peer=peer_id, outcome=reason, delta=delta
+        )
         if self.hub is not None:
             try:
                 self.hub.report(peer_id, delta)
@@ -189,6 +204,9 @@ class SyncManager:
     def _quarantine(self, peer_id: str, reason: str):
         self._downscore(peer_id, SCORE_INVALID_MESSAGE, reason)
         self.quarantined.add(peer_id)
+        self.journal.emit(
+            "peer_quarantine", peer=peer_id, outcome=reason
+        )
         _QUARANTINED.set(len(self.quarantined))
 
     def _peer_status(self, peer_id: str, rpc):
@@ -290,6 +308,18 @@ class SyncManager:
                 self.metrics["retries"] += 1
                 self._backoff(key, attempt)
             t0 = time.monotonic()
+
+            def _req_event(outcome, **attrs):
+                self.journal.emit(
+                    "sync_request",
+                    peer=pid,
+                    outcome=outcome,
+                    duration_s=time.monotonic() - t0,
+                    method=method,
+                    attempt=attempt,
+                    **attrs,
+                )
+
             try:
                 with span(f"sync/{method}", peer=pid, attempt=attempt):
                     result = call(pid, rpc)
@@ -301,20 +331,28 @@ class SyncManager:
                 # is this client's doing, so the peer must not bleed
                 # toward the gossip ban threshold for it
                 _REQUEST_ERRORS.labels(method, "rate_limited").inc()
+                _req_event("rate_limited")
                 strikes = self._rl_strikes.get(pid, 0) + 1
                 self._rl_strikes[pid] = strikes
                 if strikes >= MAX_RATE_LIMIT_STRIKES:
                     _DOWNSCORES.labels("rate_limit_starvation").inc()
+                    self.journal.emit(
+                        "peer_quarantine",
+                        peer=pid,
+                        outcome="rate_limit_starvation",
+                    )
                     self.quarantined.add(pid)
                     _QUARANTINED.set(len(self.quarantined))
                 continue
             except RpcError as e:
                 kind = "timeout" if e.code == 2 else "error"
                 _REQUEST_ERRORS.labels(method, kind).inc()
+                _req_event(kind)
                 self._downscore(pid, SCORE_TIMEOUT, kind)
                 continue
             except Exception:
                 _REQUEST_ERRORS.labels(method, "error").inc()
+                _req_event("error")
                 self._downscore(pid, SCORE_TIMEOUT, "error")
                 continue
             self._rl_strikes.pop(pid, None)
@@ -326,6 +364,7 @@ class SyncManager:
                 reason = validate(result, peer_head)
                 if reason is not None:
                     _REQUEST_ERRORS.labels(method, "malformed").inc()
+                    _req_event("malformed", reason=reason)
                     if reason in SOFT_VALIDATION_REASONS:
                         # not provably malicious (an all-skip-slot range
                         # or pruned history also yields an empty answer
@@ -338,6 +377,7 @@ class SyncManager:
                     else:
                         self._quarantine(pid, reason)
                     continue
+            _req_event("ok")
             return pid, result
         return None, None
 
@@ -383,6 +423,7 @@ class SyncManager:
                 self._rl_strikes.clear()
                 _QUARANTINED.set(0)
                 _QUARANTINE_RESETS.inc()
+                self.journal.emit("peer_quarantine", outcome="forgiven")
                 forgiven = True
                 peers = self._usable_peers()
             if not peers:
@@ -406,6 +447,14 @@ class SyncManager:
             )
             outcome, n = self._sync_one_batch(start, count, probe=probe)
             _BATCHES.labels(outcome).inc()
+            self.journal.emit(
+                "sync_batch",
+                slot=start,
+                outcome=outcome,
+                n_blocks=n,
+                count=count,
+                probe=probe,
+            )
             imported += n
             if n > 0:
                 # progress — imported fully, or a retriable failure

@@ -105,7 +105,8 @@ class BeaconNode:
                 "rpc_request": lambda p: None,
                 "gossip_exit": self._on_exit,
                 "gossip_slashing": self._on_slashing,
-            }
+            },
+            journal=self.chain.journal,
         )
         self.hub = hub
         self.subnets = None

@@ -26,6 +26,19 @@ class Notifier:
         if not self.latch.elapsed():
             return
         chain = self.chain
+        extra = {}
+        # lifecycle-journal + validator-monitor headline numbers, when
+        # the chain carries them (the notifier also serves bare test
+        # chains that predate both)
+        journal = getattr(chain, "journal", None)
+        if journal is not None:
+            extra["events"] = journal.emitted
+        monitor = getattr(chain, "validator_monitor", None)
+        summary = getattr(monitor, "last_summary", None)
+        if summary is not None:
+            extra["vm_hits"] = summary["hits"]
+            extra["vm_misses"] = summary["misses"]
+            extra["vm_missed_proposals"] = summary["missed_proposals"]
         kv(
             self.log,
             logging.INFO,
@@ -40,6 +53,7 @@ class Notifier:
             # imported anything yet — a missing key is 0, not a crash
             blocks=chain.metrics.get("blocks_imported", 0),
             verify_sps=self.verify_throughput(),
+            **extra,
         )
 
     def verify_throughput(self) -> float:

@@ -157,11 +157,7 @@ def initialize_beacon_state_from_eth1(
         state.eth1_data.deposit_root = tree.root()
         process_deposit(state, deposit, spec, fork_name, cache)
 
-    # activate genesis validators that reached full effective balance
-    for v in state.validators:
-        if v.effective_balance >= spec.MAX_EFFECTIVE_BALANCE:
-            v.activation_eligibility_epoch = GENESIS_EPOCH
-            v.activation_epoch = GENESIS_EPOCH
+    process_activations(state, spec)
 
     from lighthouse_tpu import ssz
 
@@ -181,6 +177,25 @@ def initialize_beacon_state_from_eth1(
         state.current_sync_committee = get_next_sync_committee(state, spec)
         state.next_sync_committee = get_next_sync_committee(state, spec)
     return state
+
+
+def process_activations(state, spec: Spec) -> None:
+    """Genesis activation pass (phase0 spec `initialize_beacon_state_
+    from_eth1` tail): recompute every validator's effective balance
+    from its ACTUAL balance BEFORE the activation check. Deposit
+    processing only sets effective_balance at validator creation, so a
+    key funded by SPLIT deposits (e.g. two 16-ETH deposits) would
+    otherwise sit at the first deposit's effective balance forever and
+    never activate — a consensus-divergent genesis."""
+    for i, v in enumerate(state.validators):
+        balance = state.balances[i]
+        v.effective_balance = min(
+            balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT,
+            spec.MAX_EFFECTIVE_BALANCE,
+        )
+        if v.effective_balance == spec.MAX_EFFECTIVE_BALANCE:
+            v.activation_eligibility_epoch = GENESIS_EPOCH
+            v.activation_epoch = GENESIS_EPOCH
 
 
 def is_valid_genesis_state(state, spec: Spec) -> bool:

@@ -12,6 +12,8 @@ Objects are stored as SSZ bytes keyed by root (blocks) or slot (states);
 fork-aware decoding consults the Spec for the slot's fork.
 """
 
+import threading
+
 from lighthouse_tpu.state_processing.per_block import (
     BlockSignatureStrategy,
     per_block_processing,
@@ -52,6 +54,13 @@ class HotColdDB:
         self.slots_per_restore_point = (
             slots_per_restore_point or spec.SLOTS_PER_EPOCH * 4
         )
+        # serializes kv WRITES between the import path and the threaded
+        # background migrator (migrate.py runs migrate_to_cold off the
+        # import thread; the kv backends are individually atomic but a
+        # hot->cold move is a multi-op sequence that must not interleave
+        # with an import-path write of the same column). RLock:
+        # migrate_to_cold calls prune_blob_sidecars under its own hold.
+        self.lock = threading.RLock()
         self._replay_pubkeys = PubkeyCache()
         # schema versioning: stamp fresh stores, migrate old ones on open
         # (store/src/metadata.rs + schema_change.rs). Every production
@@ -75,7 +84,8 @@ class HotColdDB:
 
     def put_block(self, root: bytes, signed_block) -> None:
         data = _u64(signed_block.message.slot) + signed_block.to_bytes()
-        self.kv.put(COL_BLOCK, root, data)
+        with self.lock:
+            self.kv.put(COL_BLOCK, root, data)
 
     def get_block(self, root: bytes):
         data = self.kv.get(COL_BLOCK, root)
@@ -85,13 +95,15 @@ class HotColdDB:
         return self._block_cls_at_slot(slot).decode(data[8:])
 
     def set_canonical_block_root(self, slot: int, root: bytes) -> None:
-        self.kv.put(COL_BLOCK_ROOTS, _u64(slot), root)
+        with self.lock:
+            self.kv.put(COL_BLOCK_ROOTS, _u64(slot), root)
 
     def get_canonical_block_root(self, slot: int):
         return self.kv.get(COL_BLOCK_ROOTS, _u64(slot))
 
     def clear_canonical_block_root(self, slot: int) -> None:
-        self.kv.delete(COL_BLOCK_ROOTS, _u64(slot))
+        with self.lock:
+            self.kv.delete(COL_BLOCK_ROOTS, _u64(slot))
 
     # ------------------------------------------------------ blob sidecars
 
@@ -101,11 +113,12 @@ class HotColdDB:
         only — it never reads a blob."""
         key = bytes(block_root) + _u64(int(sidecar.index))
         slot = int(sidecar.signed_block_header.message.slot)
-        self.kv.put(COL_BLOB_SIDECAR, key, sidecar.to_bytes())
-        self.kv.put(COL_BLOB_INDEX, _u64(slot) + key, b"")
-        cur = self.kv.get(COL_META, BLOB_MIN_SLOT_KEY)
-        if cur is None or slot < int.from_bytes(cur, "big"):
-            self.kv.put(COL_META, BLOB_MIN_SLOT_KEY, _u64(slot))
+        with self.lock:
+            self.kv.put(COL_BLOB_SIDECAR, key, sidecar.to_bytes())
+            self.kv.put(COL_BLOB_INDEX, _u64(slot) + key, b"")
+            cur = self.kv.get(COL_META, BLOB_MIN_SLOT_KEY)
+            if cur is None or slot < int.from_bytes(cur, "big"):
+                self.kv.put(COL_META, BLOB_MIN_SLOT_KEY, _u64(slot))
 
     def get_blob_sidecars(self, block_root: bytes) -> list:
         """Stored sidecars for a block root, ordered by index — at most
@@ -148,32 +161,40 @@ class HotColdDB:
         be below the cutoff (a range-scan KV extension would make the
         remaining per-epoch scan key-bounded; the interface is get/put/
         delete/keys today)."""
-        cur = self.kv.get(COL_META, BLOB_MIN_SLOT_KEY)
-        if cur is not None and int.from_bytes(cur, "big") >= cutoff_slot:
-            return 0
-        removed = 0
-        remaining_min = None
-        for key in list(self.kv.keys(COL_BLOB_INDEX)):
-            slot = int.from_bytes(key[:8], "big")
-            if slot < cutoff_slot:
-                self.kv.delete(COL_BLOB_SIDECAR, key[8:])
-                self.kv.delete(COL_BLOB_INDEX, key)
-                removed += 1
-            elif remaining_min is None or slot < remaining_min:
-                remaining_min = slot
-        self.kv.put(
-            COL_META,
-            BLOB_MIN_SLOT_KEY,
-            _u64(remaining_min if remaining_min is not None else cutoff_slot),
-        )
-        return removed
+        with self.lock:
+            cur = self.kv.get(COL_META, BLOB_MIN_SLOT_KEY)
+            if cur is not None and int.from_bytes(
+                cur, "big"
+            ) >= cutoff_slot:
+                return 0
+            removed = 0
+            remaining_min = None
+            for key in list(self.kv.keys(COL_BLOB_INDEX)):
+                slot = int.from_bytes(key[:8], "big")
+                if slot < cutoff_slot:
+                    self.kv.delete(COL_BLOB_SIDECAR, key[8:])
+                    self.kv.delete(COL_BLOB_INDEX, key)
+                    removed += 1
+                elif remaining_min is None or slot < remaining_min:
+                    remaining_min = slot
+            self.kv.put(
+                COL_META,
+                BLOB_MIN_SLOT_KEY,
+                _u64(
+                    remaining_min
+                    if remaining_min is not None
+                    else cutoff_slot
+                ),
+            )
+            return removed
 
     # ------------------------------------------------------------- states
 
     def put_hot_state(self, state) -> None:
-        self.kv.put(
-            COL_HOT_STATE, _u64(state.slot), state.to_bytes()
-        )
+        with self.lock:
+            self.kv.put(
+                COL_HOT_STATE, _u64(state.slot), state.to_bytes()
+            )
 
     def get_hot_state(self, slot: int):
         data = self.kv.get(COL_HOT_STATE, _u64(slot))
@@ -184,7 +205,10 @@ class HotColdDB:
     def put_cold_state(self, state) -> None:
         if state.slot % self.slots_per_restore_point:
             raise StoreError("cold states must land on restore points")
-        self.kv.put(COL_COLD_STATE, _u64(state.slot), state.to_bytes())
+        with self.lock:
+            self.kv.put(
+                COL_COLD_STATE, _u64(state.slot), state.to_bytes()
+            )
 
     # ------------------------------------------------------ hot/cold split
 
@@ -197,23 +221,28 @@ class HotColdDB:
         """Move hot states below the finalized slot into the freezer:
         keep restore points, drop the rest (reference
         beacon_chain/src/migrate.rs background migration)."""
-        for key in sorted(self.kv.keys(COL_HOT_STATE)):
-            slot = int.from_bytes(key, "big")
-            if slot >= finalized_slot:
-                continue
-            if slot % self.slots_per_restore_point == 0:
-                data = self.kv.get(COL_HOT_STATE, key)
-                self.kv.put(COL_COLD_STATE, key, data)
-            self.kv.delete(COL_HOT_STATE, key)
-        self.kv.put(COL_META, SPLIT_KEY, _u64(finalized_slot))
-        # blob retention window: sidecars are a serving obligation, not
-        # history — prune everything older than the window behind
-        # finality (deneb MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS)
-        retention_slots = (
-            self.spec.MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS
-            * self.spec.SLOTS_PER_EPOCH
-        )
-        self.prune_blob_sidecars(max(0, finalized_slot - retention_slots))
+        with self.lock:
+            for key in sorted(self.kv.keys(COL_HOT_STATE)):
+                slot = int.from_bytes(key, "big")
+                if slot >= finalized_slot:
+                    continue
+                if slot % self.slots_per_restore_point == 0:
+                    data = self.kv.get(COL_HOT_STATE, key)
+                    self.kv.put(COL_COLD_STATE, key, data)
+                self.kv.delete(COL_HOT_STATE, key)
+            self.kv.put(COL_META, SPLIT_KEY, _u64(finalized_slot))
+            # blob retention window: sidecars are a serving obligation,
+            # not history — prune everything older than the window
+            # behind finality (MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS).
+            # Still under the lock (RLock re-entry): the whole
+            # migration is ONE sequence an import write cannot split.
+            retention_slots = (
+                self.spec.MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS
+                * self.spec.SLOTS_PER_EPOCH
+            )
+            self.prune_blob_sidecars(
+                max(0, finalized_slot - retention_slots)
+            )
 
     # -------------------------------------------------- state reconstruction
 

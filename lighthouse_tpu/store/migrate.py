@@ -15,13 +15,17 @@ unprocessed older one — migrating to slot 64 subsumes migrating to 32).
 for tests and the in-process simulator.
 """
 
+import logging
 import threading
+
+from lighthouse_tpu.common.logging import get_logger, kv
 
 
 class BackgroundMigrator:
     def __init__(self, chain, threaded: bool = True):
         self.chain = chain
         self.threaded = threaded
+        self.log = get_logger("migrator")
         self.runs = 0  # completed migrations (read by tests/metrics)
         self.failures = 0
         self.last_error: str | None = None
@@ -103,9 +107,18 @@ class BackgroundMigrator:
             except Exception as e:
                 # a failed migration must not kill the node, but it must
                 # be VISIBLE: a persistently failing store would
-                # otherwise grow the hot column silently
+                # otherwise grow the hot column silently — counted AND
+                # logged (ADVICE r5: counting alone buried the error)
                 self.failures += 1
                 self.last_error = repr(e)
+                kv(
+                    self.log,
+                    logging.ERROR,
+                    "store migration failed",
+                    finalized_slot=slot,
+                    failures=self.failures,
+                    error=repr(e),
+                )
             with self._wake:
                 self._busy = False
                 self._wake.notify_all()
@@ -118,7 +131,10 @@ class BackgroundMigrator:
     def _migrate_store(self, finalized_slot: int):
         """The store I/O half: hot states below finality → freezer,
         plus periodic log compaction on backends that support it (the
-        native append-log store)."""
+        native append-log store). Serialization against import-path
+        writes happens inside the store: HotColdDB.migrate_to_cold and
+        every kv WRITE share `store.lock`, so this worker's multi-op
+        hot→cold move never interleaves with an import."""
         self.chain.store.migrate_to_cold(finalized_slot)
         kv = self.chain.store.kv
         if (

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Metric-name lint for the process registry.
+"""Metric-name + journal-event-kind lint for the process registry.
 
 Statically enforces the observability contract over the whole
 `lighthouse_tpu` package:
@@ -9,7 +9,12 @@ Statically enforces the observability contract over the whole
   * every name matches ``lighthouse_tpu_[a-z0-9_]+``;
   * every name is registered at exactly ONE call site (one family, one
     owner — lookups go through Registry.get/get_value, which have no
-    registration side effect).
+    registration side effect);
+  * every lifecycle-journal `emit` call (``self.journal.emit(...)``,
+    ``JOURNAL.emit(...)``) uses a LITERAL event kind that is registered
+    in `common/events_journal.py`'s closed `KINDS` vocabulary and
+    matches ``[a-z0-9_]+`` — the journal's typed-event contract,
+    enforced the same way metric names are.
 
 The registry-infrastructure module (common/metrics.py) is exempt from
 the literal-name rule: the RegistryBackedMetrics view derives gauge
@@ -34,8 +39,10 @@ REGISTRATION_METHODS = {
     "histogram_vec",
 }
 NAME_RE = re.compile(r"^lighthouse_tpu_[a-z0-9_]+$")
+KIND_RE = re.compile(r"^[a-z0-9_]+$")
 # registry plumbing: name synthesis from mapping keys is the point
 EXEMPT_FILES = {"common/metrics.py"}
+EVENTS_MODULE = "common/events_journal.py"
 
 
 def _registry_call_name(node: ast.Call):
@@ -50,11 +57,60 @@ def _registry_call_name(node: ast.Call):
     return None
 
 
+def _journal_emit_kind(node: ast.Call):
+    """A journal `emit` call -> its kind arg node, else None. Matches
+    `<anything>.journal.emit(...)`, `JOURNAL.emit(...)`, and
+    `journal.emit(...)` — the journal's only spelling conventions."""
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "emit"):
+        return None
+    recv = fn.value
+    if isinstance(recv, ast.Attribute) and recv.attr == "journal":
+        pass
+    elif isinstance(recv, ast.Name) and recv.id in ("JOURNAL", "journal"):
+        pass
+    else:
+        return None
+    return node.args[0] if node.args else ast.Constant(value=None)
+
+
+def registered_event_kinds(package_root) -> set:
+    """Parse the closed KINDS vocabulary out of events_journal.py
+    (statically — the lint must not import the package)."""
+    path = Path(package_root) / EVENTS_MODULE
+    if not path.exists():  # linting a tree without the journal module
+        return set()
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "KINDS"
+            for t in node.targets
+        ):
+            continue
+        kinds = set()
+        for lit in ast.walk(node.value):
+            if isinstance(lit, ast.Constant) and isinstance(
+                lit.value, str
+            ):
+                kinds.add(lit.value)
+        return kinds
+    return set()
+
+
 def collect(package_root) -> tuple[dict, list]:
     """Scan the package; returns (name -> [(file, line), ...], violations)."""
     package_root = Path(package_root)
     sites: dict[str, list] = {}
     violations: list[str] = []
+    kinds = registered_event_kinds(package_root)
+    for kind in sorted(kinds):
+        if not KIND_RE.match(kind):
+            violations.append(
+                f"{EVENTS_MODULE}: registered kind {kind!r} does not "
+                "match [a-z0-9_]+"
+            )
     for path in sorted(package_root.rglob("*.py")):
         rel = path.relative_to(package_root).as_posix()
         try:
@@ -64,6 +120,23 @@ def collect(package_root) -> tuple[dict, list]:
             continue
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):
+                continue
+            kind_arg = _journal_emit_kind(node)
+            if kind_arg is not None and rel != EVENTS_MODULE:
+                if not (
+                    isinstance(kind_arg, ast.Constant)
+                    and isinstance(kind_arg.value, str)
+                ):
+                    violations.append(
+                        f"{rel}:{node.lineno}: journal event kind must "
+                        "be a string literal"
+                    )
+                elif kind_arg.value not in kinds:
+                    violations.append(
+                        f"{rel}:{node.lineno}: journal event kind "
+                        f"{kind_arg.value!r} is not registered in "
+                        f"{EVENTS_MODULE} KINDS"
+                    )
                 continue
             if _registry_call_name(node) is None:
                 continue

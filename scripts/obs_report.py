@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Render p50/p99 stage reports from the Prometheus histogram families.
+
+The bench/chaos assertion tool: takes a `/metrics` text exposition —
+from a live node (``--url http://127.0.0.1:5052/metrics``), a dump file
+(``--file metrics.txt``), or stdin — parses every histogram family, and
+reports count / mean / p50 / p99 per labeled series, Prometheus
+`histogram_quantile`-style (linear interpolation inside the owning
+cumulative bucket). This is how a load test or chaos run turns the
+registry's `*_stage_seconds` / `*_request_seconds` histograms into the
+"p50/p99 from the existing histograms" number the ROADMAP's serving
+plane asks for, with no Prometheus server in the loop.
+
+Importable pieces (used by tests and bench tooling):
+  parse_histograms(text)   -> {(name, labels): {"buckets", "sum", "count"}}
+  bucket_quantile(buckets, count, q) -> float | None
+  render_report(text, family_filter=None) -> str
+"""
+
+import argparse
+import math
+import re
+import sys
+
+_SERIES_RE = re.compile(
+    r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>[^\s]+)$"
+)
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+_ESCAPE_RE = re.compile(r"\\(.)")
+
+
+def _unescape(v: str) -> str:
+    # single pass, so '\\n' (escaped backslash + n) stays backslash+n
+    # instead of being mangled by sequential replaces
+    return _ESCAPE_RE.sub(
+        lambda m: "\n" if m.group(1) == "n" else m.group(1), v
+    )
+
+
+def _parse_labels(raw: str) -> dict:
+    if not raw:
+        return {}
+    return {k: _unescape(v) for k, v in _LABEL_RE.findall(raw)}
+
+
+def parse_histograms(text: str) -> dict:
+    """Prometheus text exposition -> histogram series.
+
+    Returns {(family, labels_tuple): {"buckets": [(le, cum_count)...],
+    "sum": float, "count": int}} where labels_tuple excludes `le` and is
+    a sorted (key, value) tuple."""
+    out: dict = {}
+
+    def entry(family, labels: dict):
+        key = (family, tuple(sorted(labels.items())))
+        return out.setdefault(
+            key, {"buckets": [], "sum": 0.0, "count": 0}
+        )
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if m is None:
+            continue
+        name = m.group("name")
+        labels = _parse_labels(m.group("labels") or "")
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        if name.endswith("_bucket") and "le" in labels:
+            le_raw = labels.pop("le")
+            le = math.inf if le_raw == "+Inf" else float(le_raw)
+            entry(name[: -len("_bucket")], labels)["buckets"].append(
+                (le, value)
+            )
+        elif name.endswith("_sum"):
+            entry(name[: -len("_sum")], labels)["sum"] = value
+        elif name.endswith("_count"):
+            entry(name[: -len("_count")], labels)["count"] = int(value)
+    # only keep series that actually look like histograms
+    return {
+        k: v for k, v in out.items() if v["buckets"] and v["count"]
+    }
+
+
+def bucket_quantile(buckets, count: int, q: float):
+    """Quantile from cumulative le-buckets, histogram_quantile-style:
+    find the owning bucket and interpolate linearly inside it. Returns
+    None for an empty series; a quantile landing in the +Inf bucket
+    reports the highest finite bound (the histogram cannot resolve
+    beyond its buckets)."""
+    if count <= 0 or not buckets:
+        return None
+    buckets = sorted(buckets)
+    target = q * count
+    prev_le, prev_cum = 0.0, 0.0
+    highest_finite = 0.0
+    for le, cum in buckets:
+        if not math.isinf(le):
+            highest_finite = le
+        if cum >= target:
+            if math.isinf(le):
+                return highest_finite
+            span = cum - prev_cum
+            if span <= 0:
+                return le
+            frac = (target - prev_cum) / span
+            return prev_le + (le - prev_le) * frac
+        if not math.isinf(le):
+            prev_le, prev_cum = le, cum
+    return highest_finite
+
+
+def report_rows(text: str, family_filter: str | None = None) -> list:
+    """[(series_label, count, mean, p50, p99)] sorted by family then
+    descending count."""
+    rows = []
+    for (family, labels), h in parse_histograms(text).items():
+        if family_filter and family_filter not in family:
+            continue
+        label_str = ",".join(f"{k}={v}" for k, v in labels)
+        series = family + (f"{{{label_str}}}" if label_str else "")
+        count = h["count"]
+        rows.append(
+            (
+                series,
+                count,
+                h["sum"] / count if count else 0.0,
+                bucket_quantile(h["buckets"], count, 0.50),
+                bucket_quantile(h["buckets"], count, 0.99),
+            )
+        )
+    rows.sort(key=lambda r: (r[0].split("{")[0], -r[1]))
+    return rows
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if v >= 1:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.1f}us"
+
+
+def render_report(text: str, family_filter: str | None = None) -> str:
+    rows = report_rows(text, family_filter)
+    if not rows:
+        return "no histogram series matched\n"
+    width = max(len(r[0]) for r in rows)
+    lines = [
+        f"{'series':<{width}}  {'count':>8}  {'mean':>9}  "
+        f"{'p50':>9}  {'p99':>9}"
+    ]
+    for series, count, mean, p50, p99 in rows:
+        lines.append(
+            f"{series:<{width}}  {count:>8}  {_fmt(mean):>9}  "
+            f"{_fmt(p50):>9}  {_fmt(p99):>9}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="p50/p99 stage report from a /metrics exposition"
+    )
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument(
+        "--url", help="scrape a live node (e.g. http://127.0.0.1:5052/metrics)"
+    )
+    src.add_argument("--file", help="read a saved exposition dump")
+    ap.add_argument(
+        "--family",
+        default=None,
+        help="substring filter on the family name "
+        "(e.g. stage_seconds, http_request)",
+    )
+    args = ap.parse_args(argv)
+    if args.url:
+        from urllib.request import urlopen
+
+        with urlopen(args.url, timeout=10) as r:
+            text = r.read().decode()
+    elif args.file:
+        with open(args.file) as f:
+            text = f.read()
+    else:
+        text = sys.stdin.read()
+    sys.stdout.write(render_report(text, args.family))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,758 @@
+"""Object-lifecycle event journal, per-node health plane, registry
+snapshots: unit coverage for the journal ring + typed kinds, emission
+across the gossip/DA/sync/import paths, the /lighthouse/events and
+/lighthouse/health endpoints, registry snapshot/diff, the validator
+monitor's journal reporting, obs_report quantiles, and a seeded
+FaultyRpc chaos run whose convergence / per-object outcomes / bounded
+scores are asserted PURELY from the observability plane (endpoints +
+registry snapshot diffs — no node internals)."""
+
+import importlib.util
+import json
+import os
+import re
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu import kzg
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.beacon_chain.data_availability_checker import (
+    DataAvailabilityChecker,
+    DataAvailabilityError,
+)
+from lighthouse_tpu.beacon_chain.validator_monitor import ValidatorMonitor
+from lighthouse_tpu.common.events_journal import (
+    JOURNAL,
+    KINDS,
+    Journal,
+)
+from lighthouse_tpu.common.metrics import (
+    REGISTRY,
+    Registry,
+    snapshot_diff,
+)
+from lighthouse_tpu.harness import Harness
+from lighthouse_tpu.network.beacon_processor import BeaconProcessor
+from lighthouse_tpu.network.fault_injection import FaultyRpc
+from lighthouse_tpu.network.gossip import GossipHub
+from lighthouse_tpu.node import BeaconNode
+from lighthouse_tpu.state_processing.per_block import (
+    BlockSignatureStrategy,
+)
+from lighthouse_tpu.types.spec import minimal_spec
+
+from tests.test_data_availability import _blob, make_block_with_blobs
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obs_report():
+    path = os.path.join(_ROOT, "scripts", "obs_report.py")
+    spec = importlib.util.spec_from_file_location("obs_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------------- journal unit
+
+
+def test_journal_ring_filters_and_stats():
+    j = Journal(capacity=4)
+    r1, r2 = b"\x01" * 32, b"\x02" * 32
+    j.emit("block_import", root=r1, slot=5, outcome="imported")
+    j.emit("block_import", root=r2, slot=6, outcome="rejected",
+           reason="unknown parent")
+    j.emit("sidecar", root=r1, slot=5, outcome="verified", index=0)
+    j.emit("sync_request", peer="p1", outcome="timeout", method="status")
+
+    assert [e["kind"] for e in j.query(root=r1)] == [
+        "block_import", "sidecar",
+    ]
+    assert j.query(root="0x" + r1.hex()) == j.query(root=r1)
+    assert j.query(kind="block_import", outcome="rejected")[0][
+        "attrs"
+    ]["reason"] == "unknown parent"
+    assert j.query(peer="p1")[0]["outcome"] == "timeout"
+    assert j.query(slot=6)[0]["root"] == "0x" + r2.hex()
+    assert len(j.query(limit=2)) == 2
+    assert j.query(limit=0) == []
+    # seq is monotonic, events are oldest-first
+    seqs = [e["seq"] for e in j.query()]
+    assert seqs == sorted(seqs)
+    # ring eviction counts drops
+    j.emit("sync_batch", slot=1, outcome="imported")
+    st = j.stats()
+    assert st["size"] == 4 and st["emitted"] == 5 and st["dropped"] == 1
+    assert st["capacity"] == 4 and st["enabled"] is True
+
+
+def test_journal_kinds_are_typed():
+    j = Journal()
+    with pytest.raises(ValueError):
+        j.emit("made_up_kind")
+    # the registered vocabulary is what the lint enforces
+    assert "block_import" in KINDS and "peer_quarantine" in KINDS
+
+
+def test_journal_disabled_emits_nothing():
+    j = Journal(capacity=8, enabled=False)
+    assert j.emit("block_import", outcome="imported") is None
+    assert j.query() == [] and j.stats()["emitted"] == 0
+    j.configure(enabled=True)
+    j.emit("block_import", outcome="imported")
+    assert j.stats()["emitted"] == 1
+    j.configure(capacity=16)
+    assert j.capacity == 16 and j.stats()["size"] == 1
+
+
+def test_journal_jsonl_export(tmp_path):
+    j = Journal()
+    j.emit("da_settle", root=b"\x07" * 32, outcome="ok", n_matched=2,
+           n_accepted=2)
+    out = tmp_path / "events.jsonl"
+    assert j.export_jsonl(out) == 1
+    doc = json.loads(out.read_text().splitlines()[0])
+    assert doc["kind"] == "da_settle"
+    assert doc["attrs"] == {"n_matched": 2, "n_accepted": 2}
+
+
+def test_journal_mirrors_into_registry():
+    before = REGISTRY.get_value(
+        "lighthouse_tpu_journal_events_total",
+        labels=("sync_batch", "imported"),
+    )
+    Journal().emit("sync_batch", outcome="imported")
+    assert (
+        REGISTRY.get_value(
+            "lighthouse_tpu_journal_events_total",
+            labels=("sync_batch", "imported"),
+        )
+        == before + 1
+    )
+
+
+# --------------------------------------------------- registry snapshot/diff
+
+
+def test_registry_snapshot_and_diff():
+    reg = Registry()
+    c = reg.counter("lighthouse_tpu_snap_total")
+    g = reg.gauge_vec("lighthouse_tpu_snap_depth", "", ("kind",))
+    h = reg.histogram(
+        "lighthouse_tpu_snap_seconds", buckets=(0.1, 1.0)
+    )
+    c.inc(3)
+    g.labels("att").set(7)
+    h.observe(0.05)
+    before = reg.snapshot()
+    assert before["lighthouse_tpu_snap_total"] == 3.0
+    assert before['lighthouse_tpu_snap_depth{kind="att"}'] == 7.0
+    assert before["lighthouse_tpu_snap_seconds_count"] == 1.0
+    assert before["lighthouse_tpu_snap_seconds_sum"] == 0.05
+
+    c.inc(2)
+    g.labels("att").set(4)
+    g.labels("blk").set(1)
+    after = reg.snapshot()
+    diff = snapshot_diff(before, after)
+    assert diff["lighthouse_tpu_snap_total"] == 2.0
+    assert diff['lighthouse_tpu_snap_depth{kind="att"}'] == -3.0
+    assert diff['lighthouse_tpu_snap_depth{kind="blk"}'] == 1.0
+    # unchanged series stay out of the diff
+    assert "lighthouse_tpu_snap_seconds_count" not in diff
+    assert snapshot_diff(after, after) == {}
+
+
+# ------------------------------------------------------- processor events
+
+
+def test_beacon_processor_journal_events():
+    j = Journal()
+    seen = []
+    proc = BeaconProcessor(
+        handlers={
+            "gossip_block": seen.append,
+            "gossip_attestation": seen.append,
+        },
+        bounds={"gossip_block": 2, "gossip_attestation": 1},
+        journal=j,
+    )
+    assert proc.submit("gossip_block", "b1")
+    assert proc.submit("gossip_block", "b2")
+    assert not proc.submit("gossip_block", "b3")  # bounded: dropped
+    proc.submit("gossip_attestation", "a1")
+    # attestation drop-storm: journaled SAMPLED (first of each
+    # DROP_SAMPLE window), so a flood cannot flush the forensic ring
+    for _ in range(3):
+        assert not proc.submit("gossip_attestation", "aX")
+    proc.process_pending()
+
+    enq = j.query(kind="processor_enqueue")
+    assert [e["attrs"]["work"] for e in enq] == [
+        "gossip_block", "gossip_block",
+    ]
+    drop = j.query(kind="processor_drop")
+    assert [e["attrs"]["work"] for e in drop] == [
+        "gossip_block", "gossip_attestation",
+    ]
+    assert drop[1]["attrs"]["dropped_total"] == 1
+    batches = j.query(kind="processor_batch")
+    works = [e["attrs"]["work"] for e in batches]
+    assert works == ["gossip_block", "gossip_block", "gossip_attestation"]
+    # attestation kinds coalesce into list batches with n recorded
+    assert batches[-1]["attrs"]["n"] == 1
+    assert all(e["duration_s"] >= 0 for e in batches)
+    assert proc.queue_depths()["gossip_block"] == 0
+
+
+# ------------------------------------------------------------- DA events
+
+
+@pytest.fixture(scope="module")
+def da_spec():
+    return minimal_spec(
+        name="minimal-journal-da",
+        ALTAIR_FORK_EPOCH=0,
+        BELLATRIX_FORK_EPOCH=1,
+    )
+
+
+def test_da_checker_journal_lifecycle(da_spec):
+    from lighthouse_tpu.types.containers import types_for
+
+    t = types_for(da_spec)
+    j = Journal()
+    da = DataAvailabilityChecker(da_spec, backend="fake", journal=j)
+    blobs = [_blob(da_spec, 50), _blob(da_spec, 51)]
+    block, sidecars, root = make_block_with_blobs(
+        t, da_spec, 9, blobs
+    )
+    # sidecar before block: cached, no verification
+    da.put_sidecar(sidecars[0])
+    assert j.query(root=root, kind="sidecar", outcome=(
+        "cached_pending_block"
+    ))[0]["attrs"]["index"] == 0
+    # block arrives: candidate settles in one fold, block held for #1
+    missing = da.put_block(root, block)
+    assert missing == {1}
+    settle = j.query(root=root, kind="da_settle")
+    assert settle[0]["outcome"] == "ok"
+    assert settle[0]["attrs"] == {"n_matched": 1, "n_accepted": 1}
+    assert j.count(root=root, kind="sidecar", outcome="verified") == 1
+    # last sidecar releases the held block
+    released = da.put_sidecar(sidecars[1])
+    assert len(released) == 1
+    rel = j.query(root=root, kind="block_release")
+    assert rel[0]["outcome"] == "complete"
+    assert rel[0]["attrs"]["n_sidecars"] == 2
+    # exact redelivery is journaled as a duplicate
+    with pytest.raises(DataAvailabilityError):
+        da.put_sidecar(sidecars[0])
+    assert j.count(root=root, kind="sidecar", outcome="duplicate") == 1
+    # occupancy stats for the health plane
+    st = da.stats()
+    assert st["pending_entries"] == 1 and st["held_blocks"] == 0
+    assert st["verified_sidecars"] == 2
+
+
+def test_da_precheck_returns_root_digest_pair(da_spec):
+    """The (root, digest) plumbing: precheck hands back the pair so
+    put_sidecar skips the second hashing pass, and a precheck rejection
+    emits the journal event."""
+    import hashlib
+
+    from lighthouse_tpu.types.containers import types_for
+
+    t = types_for(da_spec)
+    j = Journal()
+    da = DataAvailabilityChecker(da_spec, backend="fake", journal=j)
+    blobs = [_blob(da_spec, 60)]
+    _, sidecars, root = make_block_with_blobs(t, da_spec, 9, blobs)
+    pair = da.precheck_sidecar(sidecars[0])
+    assert pair == (
+        root, hashlib.sha256(sidecars[0].to_bytes()).digest()
+    )
+    da.put_sidecar(sidecars[0], precomputed=pair)
+    assert j.count(root=root, outcome="cached_pending_block") == 1
+    # structural junk is journaled at precheck time
+    bad = t.BlobSidecar.decode(sidecars[0].to_bytes())
+    bad.index = da_spec.MAX_BLOBS_PER_BLOCK
+    with pytest.raises(DataAvailabilityError):
+        da.precheck_sidecar(bad)
+    assert j.count(kind="sidecar", outcome="bad_index") == 1
+
+
+# --------------------------------------- chain imports + endpoints + monitor
+
+
+@pytest.fixture(scope="module")
+def chain_env():
+    """A small fake-backend chain with a few imported blocks, one
+    unknown-parent reject, and one duplicate — the forensic fixture the
+    endpoint tests query."""
+    spec = minimal_spec(
+        name="minimal-journal-chain", ALTAIR_FORK_EPOCH=2**64 - 1
+    )
+    h = Harness(spec, 16, backend="fake")
+    chain = BeaconChain(h.state.copy(), spec, backend="fake")
+    imported = []
+    for slot in (1, 2):
+        block = h.produce_block(slot, [])
+        h.import_block(
+            block, strategy=BlockSignatureStrategy.NO_VERIFICATION
+        )
+        chain.process_block(block)
+        imported.append(
+            type(block.message).hash_tree_root(block.message)
+        )
+    # orphan: block 4 whose parent (block 3) the chain never saw
+    b3 = h.produce_block(3, [])
+    h.import_block(b3, strategy=BlockSignatureStrategy.NO_VERIFICATION)
+    b4 = h.produce_block(4, [])
+    h.import_block(b4, strategy=BlockSignatureStrategy.NO_VERIFICATION)
+    orphan_root = type(b4.message).hash_tree_root(b4.message)
+    try:
+        chain.process_block(b4)
+    except Exception:
+        pass
+    # duplicate delivery of block 1
+    b1 = chain.store.get_block(imported[0])
+    try:
+        chain.process_block(b1)
+    except Exception:
+        pass
+    from lighthouse_tpu.http_api.server import BeaconApiServer
+
+    srv = BeaconApiServer(chain).start()
+    yield spec, chain, srv, imported, orphan_root
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}{path}", timeout=10
+    ) as r:
+        return json.loads(r.read().decode())
+
+
+def test_chain_emits_block_import_events(chain_env):
+    spec, chain, srv, imported, orphan_root = chain_env
+    for root in imported:
+        evs = chain.journal.query(root=root, kind="block_import")
+        assert evs[0]["outcome"] == "imported"
+        assert evs[0]["duration_s"] > 0
+    rej = chain.journal.query(root=orphan_root, kind="block_import")
+    assert rej[-1]["outcome"] == "rejected"
+    assert "unknown parent" in rej[-1]["attrs"]["reason"]
+    dup = chain.journal.query(root=imported[0], kind="block_import")
+    assert dup[-1]["outcome"] == "duplicate"
+
+
+def test_events_endpoint_forensics(chain_env):
+    spec, chain, srv, imported, orphan_root = chain_env
+    root_hex = "0x" + imported[1].hex()
+    doc = _get(srv, f"/lighthouse/events?root={root_hex}")
+    assert [e["kind"] for e in doc["data"]] == ["block_import"]
+    assert doc["data"][0]["outcome"] == "imported"
+    assert doc["meta"]["enabled"] is True
+    # outcome + kind filters and limit
+    doc = _get(
+        srv, "/lighthouse/events?kind=block_import&outcome=imported"
+    )
+    assert {e["root"] for e in doc["data"]} == {
+        "0x" + r.hex() for r in imported
+    }
+    assert len(_get(srv, "/lighthouse/events?limit=1")["data"]) == 1
+    # unknown kinds and bad roots are 400s, not silent empties
+    for bad in (
+        "/lighthouse/events?kind=nope",
+        "/lighthouse/events?root=0xzz",
+        "/lighthouse/events?limit=no",
+    ):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv, bad)
+        assert ei.value.code == 400
+
+
+def test_health_endpoint_document(chain_env):
+    spec, chain, srv, imported, orphan_root = chain_env
+    doc = _get(srv, "/lighthouse/health")["data"]
+    head = doc["head"]
+    assert head["slot"] == 2
+    assert head["root"] == "0x" + chain.head_root.hex()
+    assert head["finalized_epoch"] == 0
+    assert head["finality_distance_epochs"] >= 0
+    assert doc["da"]["pending_entries"] == 0
+    assert doc["journal"]["emitted"] == chain.journal.emitted
+    assert doc["peers"]["count"] == 0
+    assert doc["validator_monitor"]["registered"] == 0
+    assert doc["metrics"]["blocks_imported"] == 2
+
+
+def test_metrics_snapshot_endpoint(chain_env):
+    spec, chain, srv, imported, orphan_root = chain_env
+    snap = _get(srv, "/lighthouse/metrics/snapshot")["data"]
+    assert snap["lighthouse_tpu_chain_blocks_imported"] >= 2.0
+    key = (
+        'lighthouse_tpu_journal_events_total'
+        '{kind="block_import",outcome="imported"}'
+    )
+    assert snap[key] >= 2.0
+
+
+def test_validator_monitor_chain_wiring(chain_env):
+    """chain.set_slot drives ValidatorMonitor.advance with the proposer
+    cache: completed epochs land validator_summary events with expected
+    proposals from the real shuffle."""
+    spec, chain, srv, imported, orphan_root = chain_env
+    chain.validator_monitor.register(*range(16))
+    # one observation marks epoch 0 as monitored (epochs with no data
+    # before the first observation report as 'unmonitored', not as
+    # false all-miss alarms)
+    b1 = chain.store.get_block(imported[0])
+    chain.validator_monitor.register_block(b1.message, [], spec)
+    chain.set_slot(spec.SLOTS_PER_EPOCH * 3)
+    summaries = chain.journal.query(kind="validator_summary")
+    assert {e["attrs"]["epoch"] for e in summaries} == {0, 1}
+    ep0 = summaries[0]["attrs"]
+    # the fixture imported 2 blocks in epoch 0 but only b1 was fed to
+    # the monitor: 1 of SLOTS_PER_EPOCH expected proposals made, and
+    # with no attestations every registered key reads as a miss
+    assert ep0["expected_proposals"] == spec.SLOTS_PER_EPOCH
+    assert ep0["proposals"] == 1
+    assert ep0["missed_proposals"] == spec.SLOTS_PER_EPOCH - 1
+    assert summaries[0]["outcome"] == "degraded"
+    hs = chain.validator_monitor.health_summary()
+    assert hs["registered"] == 16
+    assert hs["reported_through_epoch"] == 1
+    assert hs["last_summary"]["epoch"] == 1
+    assert REGISTRY.get_value(
+        "lighthouse_tpu_validator_monitor_stat", labels=("registered",)
+    ) == 16
+
+
+def test_validator_monitor_inclusion_and_misses():
+    class FakeSpec:
+        SLOTS_PER_EPOCH = 8
+
+        @staticmethod
+        def slot_to_epoch(slot):
+            return slot // 8
+
+    class Blk:
+        slot = 9
+        proposer_index = 1
+
+    class Data:
+        slot = 8
+
+        class target:
+            epoch = 1
+
+    class Indexed:
+        data = Data
+        attesting_indices = [1, 2]
+
+    j = Journal()
+    mon = ValidatorMonitor({1, 2, 3}, journal=j)
+    mon.register_block(Blk, [Indexed], FakeSpec)
+    mon.advance(3, proposers_fn=lambda e: [1, 7] if e == 1 else [])
+    summaries = j.query(kind="validator_summary")
+    # epoch 0 predates the first observation: unmonitored, not a false
+    # all-miss alarm
+    ep0 = [e for e in summaries if e["attrs"]["epoch"] == 0][0]
+    assert ep0["outcome"] == "unmonitored"
+    ep1 = [e for e in summaries if e["attrs"]["epoch"] == 1][0]
+    assert ep1["attrs"]["hits"] == 2 and ep1["attrs"]["misses"] == 1
+    # proposer 7 is unregistered -> only validator 1's slot expected
+    assert ep1["attrs"]["expected_proposals"] == 1
+    assert ep1["attrs"]["proposals"] == 1
+    assert ep1["attrs"]["missed_proposals"] == 0
+    s = mon.epoch_summary(1)
+    assert s["mean_inclusion_delay"] == 1.0
+    # a registered proposer that never proposed is a missed proposal
+    # (epoch 2 is monitored: validator 5 attested in epoch 1)
+    class Indexed5:
+        data = Data
+        attesting_indices = [5]
+
+    mon2 = ValidatorMonitor({5}, journal=j)
+    mon2.register_block(Blk, [Indexed5], FakeSpec)
+    mon2.advance(4, proposers_fn=lambda e: [5] if e == 2 else [])
+    ep2 = [
+        e for e in j.query(kind="validator_summary")
+        if e["attrs"]["epoch"] == 2 and e["attrs"].get(
+            "expected_proposals"
+        )
+    ][0]
+    assert ep2["attrs"]["missed_proposals"] == 1
+    assert ep2["outcome"] == "degraded"
+
+
+# ------------------------------------------------------------- obs_report
+
+
+def test_obs_report_quantiles_and_render():
+    obs = _load_obs_report()
+    reg = Registry()
+    h = reg.histogram_vec(
+        "lighthouse_tpu_rep_stage_seconds", "stage time", ("stage",),
+        buckets=(0.01, 0.1, 1.0),
+    )
+    for v in (0.005, 0.005, 0.05, 0.05, 0.05, 0.05, 0.05, 0.05, 0.5, 5.0):
+        h.labels("miller").observe(v)
+    text = reg.render()
+    hists = obs.parse_histograms(text)
+    key = (
+        "lighthouse_tpu_rep_stage_seconds", (("stage", "miller"),)
+    )
+    assert hists[key]["count"] == 10
+    # p50 lands in the (0.01, 0.1] bucket (2 below, 8 cumulative)
+    p50 = obs.bucket_quantile(hists[key]["buckets"], 10, 0.50)
+    assert 0.01 < p50 <= 0.1
+    # p99 lands beyond the last finite bound -> reports that bound
+    p99 = obs.bucket_quantile(hists[key]["buckets"], 10, 0.99)
+    assert p99 == 1.0
+    report = obs.render_report(text, family_filter="rep_stage")
+    assert "lighthouse_tpu_rep_stage_seconds{stage=miller}" in report
+    assert "p50" in report and "p99" in report
+    assert obs.render_report(text, family_filter="nomatch") == (
+        "no histogram series matched\n"
+    )
+    # empty series yields None, not a crash
+    assert obs.bucket_quantile([], 0, 0.5) is None
+
+
+def test_obs_report_reads_live_registry(chain_env):
+    """The tool consumes the real process exposition (the bench/chaos
+    assertion path: import stages came from the fixture's imports)."""
+    obs = _load_obs_report()
+    rows = obs.report_rows(REGISTRY.render(), "import_stage")
+    assert any("stage=slots" in r[0] for r in rows)
+    for _series, count, mean, p50, p99 in rows:
+        assert count > 0 and mean >= 0
+        if p50 is not None and p99 is not None:
+            assert p99 >= 0 and p50 >= 0
+
+
+# ------------------------------------------------------- overhead budget
+
+
+def test_journal_overhead_bounds(chain_env):
+    """Acceptance: journal overhead on block import is small when
+    enabled (the two emits cost well under 5% of one measured import)
+    and ~0 when disabled."""
+    spec, chain, srv, imported, orphan_root = chain_env
+    j = Journal(capacity=8192)
+    n = 2000
+    t0 = time.perf_counter()
+    for i in range(n):
+        j.emit("block_import", root=b"\x01" * 32, slot=i,
+               outcome="imported", duration_s=0.001)
+    per_emit = (time.perf_counter() - t0) / n
+
+    jd = Journal(capacity=8192, enabled=False)
+    t0 = time.perf_counter()
+    for i in range(n):
+        jd.emit("block_import", root=b"\x01" * 32, slot=i,
+                outcome="imported", duration_s=0.001)
+    per_emit_disabled = (time.perf_counter() - t0) / n
+
+    # disabled = one attribute check + return
+    assert per_emit_disabled < 5e-6
+    assert per_emit < 200e-6
+    # measured against the fixture's real imports: the import path emits
+    # ONE block_import event per terminal — its cost must stay under 5%
+    # of the cheapest measured import
+    durations = [
+        e["duration_s"]
+        for e in chain.journal.query(kind="block_import")
+        if e["outcome"] == "imported"
+    ]
+    assert durations
+    assert per_emit <= 0.05 * min(durations)
+
+
+# ------------------------------------------------- chaos forensics (seeded)
+
+
+N_CHAOS_SLOTS = 12
+CHAOS_BLOB_SLOTS = {9, 11}
+
+
+@pytest.fixture(scope="module")
+def chaos_net():
+    """Honest fake-backend node with a grown blob-carrying chain, for
+    the observability-plane chaos assertions."""
+    spec = minimal_spec(
+        name="minimal-journal-chaos",
+        ALTAIR_FORK_EPOCH=0,
+        BELLATRIX_FORK_EPOCH=1,
+    )
+    h = Harness(spec, 32, backend="fake")
+    genesis = h.state.copy()
+    a = BeaconNode(
+        "honest-j", genesis, spec, hub=GossipHub(), backend="fake"
+    )
+    blob_roots = {}
+    for slot in range(1, N_CHAOS_SLOTS + 1):
+        a.on_slot(slot)
+        if slot in CHAOS_BLOB_SLOTS:
+            blobs = [_blob(spec, slot * 16 + i) for i in range(2)]
+            comms = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+            block = h.produce_block(
+                slot, [], blob_kzg_commitments=comms
+            )
+            h.import_block(
+                block, strategy=BlockSignatureStrategy.NO_VERIFICATION
+            )
+            for sc in h.make_blob_sidecars(block, blobs):
+                a.chain.process_blob_sidecar(sc)
+            a.chain.process_block(block)
+            blob_roots[
+                type(block.message).hash_tree_root(block.message)
+            ] = len(blobs)
+        else:
+            block = h.produce_block(slot, [])
+            h.import_block(
+                block, strategy=BlockSignatureStrategy.NO_VERIFICATION
+            )
+            a.chain.process_block(block)
+    assert int(a.chain.head_state.slot) == N_CHAOS_SLOTS
+    return spec, genesis, a, blob_roots
+
+
+def _downscore_reason_deltas(diff):
+    """sync_peer_downscores_total series deltas keyed by reason."""
+    out = {}
+    for key, delta in diff.items():
+        m = re.match(
+            r'lighthouse_tpu_sync_peer_downscores_total'
+            r'\{reason="([^"]+)"\}',
+            key,
+        )
+        if m:
+            out[m.group(1)] = delta
+    return out
+
+
+def test_chaos_forensics_via_observability_plane(chaos_net):
+    """The PR's acceptance run: a late node syncs past a seeded
+    FaultyRpc peer, and honest-head convergence, per-object import
+    outcomes, and bounded peer scores are asserted purely via
+    /lighthouse/events, /lighthouse/health, and registry snapshot
+    diffs."""
+    spec, genesis, a, blob_roots = chaos_net
+    hub = GossipHub()
+    b = BeaconNode("late-j", genesis, spec, hub=hub, backend="fake")
+    b.sync._sleep = lambda s: None
+    hub.join("honest-j", lambda *x: None)
+    hub.join("evil-j", lambda *x: None)
+    evil = FaultyRpc(
+        a.rpc,
+        seed=4242,
+        fault_rate=0.6,
+        # the crypto-free fault mix: every kind here is detectable by
+        # the fake-backend node's structural validation
+        kinds=("drop", "stall", "truncate", "duplicate", "rate_limit"),
+    )
+    b.sync.add_peer("evil-j", evil)
+    b.sync.add_peer("honest-j", a.rpc)
+    b.on_slot(N_CHAOS_SLOTS)
+
+    before = REGISTRY.snapshot()
+    imported = b.sync.run_range_sync(max_batches=32, batch_slots=4)
+    diff = snapshot_diff(before, REGISTRY.snapshot())
+    assert sum(evil.injected.values()) > 0, evil.injected
+
+    srv_a = a.start_http_api()
+    srv_b = b.start_http_api()
+    try:
+        health_a = _get(srv_a, "/lighthouse/health")["data"]
+        health_b = _get(srv_b, "/lighthouse/health")["data"]
+        # 1. honest-head convergence, from the two health documents
+        assert health_b["head"]["slot"] == N_CHAOS_SLOTS
+        assert health_b["head"]["root"] == health_a["head"]["root"]
+        # 2. per-object import outcomes from /lighthouse/events: every
+        # blob block imported, with each sidecar individually verified
+        for root, n in blob_roots.items():
+            root_hex = "0x" + root.hex()
+            evs = _get(
+                srv_b,
+                f"/lighthouse/events?root={root_hex}&kind=block_import",
+            )["data"]
+            assert evs and evs[-1]["outcome"] == "imported", root_hex
+            got = _get(
+                srv_b,
+                f"/lighthouse/events?root={root_hex}"
+                "&kind=sidecar&outcome=verified",
+            )["data"]
+            assert len(got) == n, root_hex
+        # 3. bounded scores from the health peer summary: the evil peer
+        # paid, the honest peer did not, nobody fell off a cliff
+        scores = health_b["peers"]["scores"]["by_peer"]
+        assert scores["evil-j"] < scores["honest-j"]
+        assert scores["honest-j"] >= 0
+        assert scores["evil-j"] > -500
+        # 4. registry snapshot diff vs journal: blocks synced, retry
+        # visibility, and EXACT downscore-counter/journal agreement.
+        # The sync counter matches run_range_sync's return; blocks that
+        # imported via the DA-release path instead (a held block
+        # completed by a later sidecar fetch) are visible as non-sync
+        # block_import events, so the JOURNAL accounts for every slot
+        # exactly once even when the counter legitimately doesn't.
+        assert (
+            diff.get("lighthouse_tpu_sync_blocks_synced_total", 0)
+            == imported
+        )
+        all_imports = _get(
+            srv_b,
+            "/lighthouse/events?kind=block_import&outcome=imported",
+        )["data"]
+        assert len(all_imports) == N_CHAOS_SLOTS
+        assert {e["slot"] for e in all_imports} == set(
+            range(1, N_CHAOS_SLOTS + 1)
+        )
+        assert diff.get("lighthouse_tpu_sync_batch_retries_total", 0) > 0
+        retried = _get(
+            srv_b, "/lighthouse/events?kind=sync_request"
+        )["data"]
+        assert any(e["attrs"]["attempt"] > 0 for e in retried)
+        for reason, delta in _downscore_reason_deltas(diff).items():
+            events = _get(
+                srv_b,
+                "/lighthouse/events?kind=peer_downscore"
+                f"&outcome={reason}",
+            )["data"]
+            n_events = len(events)
+            if reason == "rate_limit_starvation":
+                n_events += len(
+                    _get(
+                        srv_b,
+                        "/lighthouse/events?kind=peer_quarantine"
+                        f"&outcome={reason}",
+                    )["data"]
+                )
+            assert n_events == delta, reason
+        # every quarantine the gauge saw is journaled with its reason
+        quarantines = _get(
+            srv_b, "/lighthouse/events?kind=peer_quarantine"
+        )["data"]
+        if health_b["peers"]["quarantined"]:
+            assert quarantines
+        # 5. batch outcomes are journaled
+        batches = _get(
+            srv_b, "/lighthouse/events?kind=sync_batch"
+        )["data"]
+        assert sum(
+            e["attrs"]["n_blocks"]
+            for e in batches
+            if e["outcome"] in ("imported", "requeued")
+        ) == imported
+    finally:
+        srv_a.stop()
+        srv_b.stop()

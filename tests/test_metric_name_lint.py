@@ -1,6 +1,8 @@
 """Tier-1 wiring for scripts/check_metric_names.py: every registry
 metric name in the package matches lighthouse_tpu_[a-z0-9_]+, is a
-string literal, and is registered at exactly one call site."""
+string literal, and is registered at exactly one call site — and every
+lifecycle-journal emit() uses a literal kind registered in
+common/events_journal.py's closed KINDS vocabulary."""
 
 import importlib.util
 import os
@@ -48,6 +50,42 @@ def test_linter_flags_bad_registrations(tmp_path):
     assert "does not match" in text
     assert "string literal" in text
     assert "registered at 2 sites" in text
+
+
+def test_linter_covers_journal_event_kinds():
+    linter = _load_linter()
+    kinds = linter.registered_event_kinds(
+        os.path.join(_ROOT, "lighthouse_tpu")
+    )
+    # the closed vocabulary parsed statically matches the live module
+    from lighthouse_tpu.common.events_journal import KINDS
+
+    assert kinds == set(KINDS)
+    assert "block_import" in kinds
+
+
+def test_linter_flags_bad_journal_kinds(tmp_path):
+    linter = _load_linter()
+    pkg = tmp_path / "pkg"
+    (pkg / "common").mkdir(parents=True)
+    (pkg / "common" / "events_journal.py").write_text(
+        'KINDS = frozenset({"good_kind"})\n'
+    )
+    (pkg / "a.py").write_text(
+        "from pkg.common.events_journal import JOURNAL\n"
+        'JOURNAL.emit("good_kind", outcome="x")\n'
+        'JOURNAL.emit("unregistered_kind")\n'
+        "JOURNAL.emit(dynamic)\n"
+        'self.journal.emit("also_unregistered")\n'
+        'unrelated.emit("not_a_journal")\n'
+    )
+    _sites, violations = linter.collect(pkg)
+    text = "\n".join(violations)
+    assert "'unregistered_kind' is not registered" in text
+    assert "'also_unregistered' is not registered" in text
+    assert "kind must be a string literal" in text
+    # non-journal .emit() receivers are out of scope
+    assert "not_a_journal" not in text
 
 
 def test_linter_cli_exit_codes(tmp_path):

@@ -260,6 +260,56 @@ def test_genesis_from_deposit_contract(spec):
     assert advanced.slot == 1
 
 
+def test_genesis_split_deposits_activate(spec):
+    """ADVICE r5: a validator funded by SPLIT deposits (two half-sized
+    deposits for one key) must activate at genesis. Deposit processing
+    only sets effective_balance at validator creation, so without the
+    pre-activation effective-balance recompute the second deposit's
+    balance never counted — a consensus-divergent genesis."""
+    from lighthouse_tpu.state_processing.genesis import (
+        genesis_deposits,
+        initialize_beacon_state_from_eth1,
+    )
+    from lighthouse_tpu.types.containers import types_for
+
+    t = types_for(spec)
+    n = spec.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    half = spec.MAX_EFFECTIVE_BALANCE // 2
+    datas = [
+        make_deposit(t, spec, bls.SecretKey(2000 + i),
+                     spec.MAX_EFFECTIVE_BALANCE)
+        for i in range(n - 1)
+    ]
+    # the split validator: two half deposits (second is a top-up)
+    split_sk = bls.SecretKey(3131)
+    datas.append(make_deposit(t, spec, split_sk, half))
+    datas.append(make_deposit(t, spec, split_sk, half))
+    state = initialize_beacon_state_from_eth1(
+        b"\x22" * 32,
+        spec.MIN_GENESIS_TIME,
+        genesis_deposits(datas, spec),
+        spec,
+    )
+    assert len(state.validators) == n
+    split = state.validators[n - 1]
+    assert state.balances[n - 1] == spec.MAX_EFFECTIVE_BALANCE
+    assert split.effective_balance == spec.MAX_EFFECTIVE_BALANCE
+    assert split.activation_epoch == 0
+    # an UNDER-funded split (quarter + quarter) stays inactive
+    under_sk = bls.SecretKey(3132)
+    datas.append(make_deposit(t, spec, under_sk, half // 2))
+    datas.append(make_deposit(t, spec, under_sk, half // 2))
+    state2 = initialize_beacon_state_from_eth1(
+        b"\x22" * 32,
+        spec.MIN_GENESIS_TIME,
+        genesis_deposits(datas, spec),
+        spec,
+    )
+    under = state2.validators[n]
+    assert under.effective_balance == half
+    assert under.activation_epoch == FAR_FUTURE_EPOCH
+
+
 def test_genesis_via_mock_eth1_service(spec):
     """Genesis driven by the eth1 service's deposit/block cache: deposits
     accumulate across mined blocks; the first block carrying enough
