@@ -1,0 +1,33 @@
+"""Repo-wide invariant linter: one AST plane, many passes.
+
+The framework behind ``scripts/lint.py`` and the tier-1
+``tests/test_lint.py`` gate. Every invariant class that review rounds
+kept rediscovering by hand — host syncs inside jit-traced device code,
+kv writes outside the store lock, HTTP-thread iteration over
+import-thread-mutated state, silent ``except Exception`` swallows —
+is a `LintPass` here, enforced on every future PR for free.
+
+Public surface:
+
+  * `core.Finding`         — one (rule, path, line, msg) record
+  * `core.iter_modules`    — shared parsed-file walker
+  * `core.run_passes`      — run passes, apply suppressions
+  * `core.Baseline`        — grandfathered-finding bookkeeping
+  * `passes.all_passes()`  — the registered pass set
+
+Suppression syntax (one plane, one spelling)::
+
+    risky_call()  # lint: allow(<rule>): why this site is intentional
+
+on the flagged line or the line directly above it. The reason is
+mandatory — a bare allow is itself a finding.
+"""
+
+from lighthouse_tpu.analysis.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    LintPass,
+    Module,
+    iter_modules,
+    run_passes,
+)

@@ -1,0 +1,294 @@
+"""Shared machinery for the invariant linter: walker, findings,
+suppressions, baseline.
+
+Design mirrors what `scripts/check_metric_names.py` proved in tier-1:
+pure-AST analysis (the lint never imports the package it checks), one
+parse per file shared by every pass, and exact string contracts so the
+output is grep-able and machine-readable.
+
+A pass is a `LintPass` subclass with a `run(modules)` method taking the
+WHOLE parsed corpus — cross-file rules (duplicate metric registration,
+jit-reachability) need the corpus, and per-file rules just loop.
+
+Suppression: ``# lint: allow(<rule>): <reason>`` on the flagged line or
+the line directly above. The reason is mandatory; an allow without one
+is itself reported (rule ``lint-allow``), so grandfathering always
+carries its justification in the diff.
+
+Baseline: a committed JSONL of finding keys (rule + path + message —
+line numbers excluded so unrelated edits don't churn it). The driver
+fails on NEW findings and on STALE entries alike, which makes the
+baseline monotonically shrinking by construction.
+"""
+
+import ast
+import io
+import json
+import re
+import tokenize
+from pathlib import Path
+
+ALLOW_RE = re.compile(
+    r"#\s*lint:\s*allow\(([a-z0-9-]+)\)\s*(?::\s*(.*\S))?\s*$"
+)
+
+
+class Finding:
+    """One lint finding. `key` (rule:path:msg) is the baseline identity
+    — deliberately line-free, so a finding survives unrelated edits to
+    the same file without churning the committed baseline."""
+
+    __slots__ = ("rule", "path", "line", "msg")
+
+    def __init__(self, rule: str, path: str, line: int, msg: str):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.msg = msg
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.msg}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "msg": self.msg,
+        }
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+    def __repr__(self):
+        return f"Finding({self.format()!r})"
+
+
+class LintPass:
+    """Base pass: subclasses set `name`/`description` and implement
+    `run(modules) -> iterable[Finding]` over the shared corpus."""
+
+    name = "base"
+    description = ""
+
+    def run(self, modules):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def finding(self, module, node_or_line, msg) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(self.name, module.rel, line, msg)
+
+
+class Module:
+    """One parsed source file plus the indexes every pass wants:
+    source lines, allow-comment map, and an id()-keyed parent map for
+    upward AST walks."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        self._parents = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+        # line -> [(rule, reason-or-None)] from allow COMMENTS — real
+        # tokenizer comments only, so a string literal that happens to
+        # contain the allow spelling can never suppress a finding
+        self.allows: dict[int, list] = {}
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(source).readline
+            )
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = ALLOW_RE.search(tok.string)
+                if m:
+                    self.allows.setdefault(tok.start[0], []).append(
+                        (m.group(1), m.group(2))
+                    )
+        except tokenize.TokenError:  # ast.parse above already vetted it
+            pass
+
+    def parent(self, node):
+        return self._parents.get(id(node))
+
+    def ancestors(self, node):
+        while node is not None:
+            node = self.parent(node)
+            if node is not None:
+                yield node
+
+    def allow_reason(self, line: int, rule: str):
+        """The reason string when `rule` is allowed at `line` (same line
+        or the line directly above), else None. Empty reasons count as
+        present here — core reports them separately via `lint-allow`."""
+        for ln in (line, line - 1):
+            for r, reason in self.allows.get(ln, ()):
+                if r == rule:
+                    return reason if reason is not None else ""
+        return None
+
+
+def attr_chain(node):
+    """Dotted-name parts of a Name/Attribute expression
+    (``self.kv.put`` -> ["self", "kv", "put"]), or None when the
+    expression is not a plain dotted chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def iter_modules(root):
+    """Parse every ``*.py`` under `root`; returns (modules, findings)
+    where findings carries one ``parse`` entry per unreadable file (an
+    unparseable file must fail the gate, not silently skip it)."""
+    root = Path(root)
+    modules, findings = [], []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if "__pycache__" in rel:
+            continue
+        try:
+            modules.append(Module(path, rel, path.read_text()))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            findings.append(Finding("parse", rel, 1, f"unparseable: {e}"))
+    return modules, findings
+
+
+def run_passes(root, passes):
+    """Run `passes` over the corpus at `root`; returns
+    (findings, stats). Suppressed findings are dropped; a reason-less
+    allow suppresses nothing — the original finding stays live AND a
+    ``lint-allow`` finding is added, so an allow can never silently
+    widen (not even via --write-baseline)."""
+    modules, findings = iter_modules(root)
+    by_rel = {m.rel: m for m in modules}
+    for p in passes:
+        findings.extend(p.run(modules))
+
+    kept, suppressed = [], 0
+    for f in findings:
+        mod = by_rel.get(f.path)
+        reason = mod.allow_reason(f.line, f.rule) if mod else None
+        if reason is None:
+            kept.append(f)
+        elif reason == "":
+            # a reason-less allow suppresses NOTHING: the original
+            # finding stays live (so it can't be laundered into the
+            # baseline as a lint-allow marker) plus the marker
+            kept.append(f)
+            kept.append(
+                Finding(
+                    "lint-allow",
+                    f.path,
+                    f.line,
+                    f"allow({f.rule}) has no reason — "
+                    "write '# lint: allow(<rule>): <why>'",
+                )
+            )
+        else:
+            suppressed += 1
+    # malformed allow spellings (rule typo'd outside [a-z-], missing
+    # parens) match nothing and would silently not suppress; surface
+    # any allow-comment that never matched a rule name we know
+    known_rules = {"parse", "lint-allow"}
+    for p in passes:
+        known_rules.update(getattr(p, "rules", (p.name,)))
+    for m in modules:
+        for ln, entries in m.allows.items():
+            for rule, _reason in entries:
+                if rule not in known_rules:
+                    kept.append(
+                        Finding(
+                            "lint-allow",
+                            m.rel,
+                            ln,
+                            f"allow({rule}) names no known rule "
+                            f"(known: {', '.join(sorted(known_rules))})",
+                        )
+                    )
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.msg))
+    stats = {
+        "files": len(modules),
+        "passes": [p.name for p in passes],
+        "suppressed": suppressed,
+    }
+    return kept, stats
+
+
+class Baseline:
+    """Grandfathered findings, committed as JSONL of finding keys.
+
+    `apply` splits live findings into (new, grandfathered) and reports
+    stale baseline entries; the driver fails on new AND stale, so the
+    file can only shrink — fixing a finding forces deleting its entry
+    in the same PR.
+
+    Keys are line-free but COUNTED: a file holding one grandfathered
+    finding and later growing a second identical one (same rule, path,
+    message) reports the extra occurrence as NEW — one baseline line
+    covers exactly one live finding."""
+
+    def __init__(self, keys=()):
+        self.counts: dict[str, int] = {}
+        for k in keys:
+            self.counts[k] = self.counts.get(k, 0) + 1
+
+    @property
+    def keys(self) -> set:
+        return set(self.counts)
+
+    @classmethod
+    def load(cls, path):
+        path = Path(path)
+        if not path.exists():
+            return cls()
+        keys = []
+        for line in path.read_text().splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            doc = json.loads(line)
+            keys.append(f"{doc['rule']}:{doc['path']}:{doc['msg']}")
+        return cls(keys)
+
+    @staticmethod
+    def write(path, findings):
+        with open(path, "w") as f:
+            for fd in sorted(findings, key=lambda x: x.key):
+                f.write(
+                    json.dumps(
+                        {
+                            "rule": fd.rule,
+                            "path": fd.path,
+                            "msg": fd.msg,
+                        }
+                    )
+                    + "\n"
+                )
+
+    def apply(self, findings):
+        """(new_findings, grandfathered_findings, stale_keys). Each
+        baseline entry absorbs at most ONE live finding; duplicates
+        beyond the counted entries are new, unconsumed entries are
+        stale."""
+        new, old = [], []
+        budget = dict(self.counts)
+        for f in findings:
+            if budget.get(f.key, 0) > 0:
+                budget[f.key] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        stale = sorted(k for k, n in budget.items() if n > 0)
+        return new, old, stale

@@ -1,0 +1,32 @@
+"""The registered lint passes — one invariant class per module.
+
+`all_passes()` is the set `scripts/lint.py` and the tier-1 gate run;
+adding a pass means adding a module here and appending its class. Keep
+each pass self-contained: scope selection, the rule, and the rationale
+live next to each other so a reviewer can audit the invariant without
+reading the framework.
+"""
+
+from lighthouse_tpu.analysis.passes.device_purity import DevicePurityPass
+from lighthouse_tpu.analysis.passes.exception_hygiene import (
+    ExceptionHygienePass,
+)
+from lighthouse_tpu.analysis.passes.handler_hygiene import (
+    HandlerHygienePass,
+)
+from lighthouse_tpu.analysis.passes.lock_discipline import (
+    LockDisciplinePass,
+)
+from lighthouse_tpu.analysis.passes.metric_names import MetricNamesPass
+
+PASS_CLASSES = (
+    DevicePurityPass,
+    LockDisciplinePass,
+    HandlerHygienePass,
+    ExceptionHygienePass,
+    MetricNamesPass,
+)
+
+
+def all_passes():
+    return [cls() for cls in PASS_CLASSES]

@@ -1,0 +1,449 @@
+"""Device-plane purity: no host syncs or nondeterminism in traced code,
+no uncached jit objects.
+
+Scope: ``ops/`` plus the two device backends (``bls/tpu_backend.py``,
+``kzg/tpu_backend.py``) — the code whose functions may execute under a
+``jax.jit`` trace.
+
+Rule ``device-purity``: inside any function REACHABLE from a jit entry
+point (``jax.jit(f)``, ``@functools.partial(jax.jit, ...)``,
+``pallas_call(kernel)`` — plus everything they transitively reference),
+flag:
+
+  * ``time.*`` — a clock read is baked in at trace time (and the canary
+    class: ``time.time()`` inside a jitted ops function);
+  * ``random.*`` / ``secrets.*`` / ``np.random.*`` — trace-time
+    nondeterminism (RLC scalars etc. must be sampled on the host and
+    passed in as arrays);
+  * ``os.environ`` — a trace-time config read that is NOT part of the
+    jit cache key silently pins the first value seen (the sanctioned
+    knobs are keyed through ``_impl_key`` and carry allows);
+  * ``.item()`` / ``int()``/``float()``/``bool()``/``np.asarray()`` on
+    a function parameter — host sync of a traced value (static shape
+    reads like ``x.shape[0]`` are exempt).
+
+The reachability walk is name-based and over-approximate by design: a
+false edge costs an allow comment, a missed edge costs a recompile or a
+wrong result in production.
+
+Rule ``jit-cache`` (the recompile-hazard half of the bucketed-pow2 lane
+convention): every ``jax.jit(...)`` call must produce a process-cached
+object — module level, a module-global rebinding, or a cache-dict
+store (``_jitted[key] = jax.jit(...)``). ``jax.jit(f)(x)`` inline and
+locally-bound jit objects build a fresh trace cache per call, which is
+exactly the hazard the per-(impl, shape-bucket) cache dicts exist to
+prevent.
+"""
+
+import ast
+
+from lighthouse_tpu.analysis.core import Finding, LintPass, attr_chain
+
+SCOPE_PREFIXES = ("ops/",)
+SCOPE_FILES = {"bls/tpu_backend.py", "kzg/tpu_backend.py"}
+
+# attribute reads that make an expression static at trace time
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+# dotted references through these roots never resolve to local
+# functions (prevents false reachability edges like np.kron -> a local
+# helper named `kron`); matched AFTER import-alias resolution, so
+# `import numpy as anything` still counts
+HOST_MODULES = {
+    "numpy", "jax", "os", "time", "math", "secrets", "random",
+    "functools", "itertools",
+}
+
+# a parameter annotated with a scalar Python type is trace-static by
+# signature (e.g. `exponent: int` in the fori_loop ladders)
+SCALAR_ANNOTATIONS = {"int", "float", "bool", "str", "bytes"}
+
+FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def in_scope(rel: str) -> bool:
+    return rel in SCOPE_FILES or any(
+        rel.startswith(p) for p in SCOPE_PREFIXES
+    )
+
+
+def _import_aliases(tree) -> dict:
+    """name -> canonical dotted target, from the module's imports:
+    `import numpy as np` -> np: numpy; `import time as _t` -> _t: time;
+    `from jax import jit` -> jit: jax.jit. Aliased imports must not
+    dodge the lint."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _resolve(chain, aliases: dict):
+    """Rewrite a dotted chain's head through the import aliases:
+    ['_t', 'time'] -> ['time', 'time']."""
+    if not chain:
+        return chain
+    target = aliases.get(chain[0])
+    if target is None:
+        return chain
+    return target.split(".") + chain[1:]
+
+
+def _is_jit_chain(chain) -> bool:
+    """A RESOLVED chain naming jax.jit (aliases already rewritten)."""
+    return chain is not None and (
+        chain == ["jit"] or (len(chain) >= 2 and chain[:2] == ["jax", "jit"])
+    )
+
+
+def _root_callable_name(node):
+    """The bare name of a function reference passed as a callable:
+    Name, Attribute tail, or the first arg of functools.partial."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        chain = attr_chain(node.func)
+        if chain and chain[-1] == "partial" and node.args:
+            return _root_callable_name(node.args[0])
+    return None
+
+
+def _walk_skipping_nested(body):
+    """Walk statements of one function body without descending into
+    nested function definitions (they are traced-checked separately)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FUNC_DEFS):
+                continue
+            stack.append(child)
+
+
+def _param_names(fn) -> set:
+    """Parameters that may carry traced values — scalar-annotated ones
+    are static by signature and excluded."""
+    a = fn.args
+    names = set()
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        ann = p.annotation
+        if (
+            isinstance(ann, ast.Name)
+            and ann.id in SCALAR_ANNOTATIONS
+        ) or (
+            # `x: int | None` style unions of scalars
+            isinstance(ann, ast.BinOp)
+            and all(
+                isinstance(side, ast.Name)
+                and side.id in SCALAR_ANNOTATIONS | {"None"}
+                or (
+                    isinstance(side, ast.Constant)
+                    and side.value is None
+                )
+                for side in (ann.left, ann.right)
+            )
+        ):
+            continue
+        names.add(p.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _is_static_expr(expr) -> bool:
+    """Shape/dtype reads and len() are trace-time constants."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            return True
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Name)
+            and n.func.id == "len"
+        ):
+            return True
+    return False
+
+
+def _rooted_at(expr, params: set) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id in params
+        for n in ast.walk(expr)
+    )
+
+
+class DevicePurityPass(LintPass):
+    name = "device-purity"
+    rules = ("device-purity", "jit-cache")
+    description = (
+        "no host syncs/nondeterminism reachable from jit-traced code; "
+        "every jit object process-cached (recompile hazard)"
+    )
+
+    def run(self, modules):
+        scoped = [m for m in modules if in_scope(m.rel)]
+        aliases = {m.rel: _import_aliases(m.tree) for m in scoped}
+        # function table: bare name -> [(module, def node)]
+        table: dict[str, list] = {}
+        for m in scoped:
+            for node in ast.walk(m.tree):
+                if isinstance(node, FUNC_DEFS):
+                    table.setdefault(node.name, []).append((m, node))
+
+        roots = self._jit_roots(scoped, aliases)
+        traced = self._reach(table, roots, aliases)
+
+        findings = []
+        for m, fn in sorted(
+            traced, key=lambda t: (t[0].rel, t[1].lineno)
+        ):
+            findings.extend(self._check_traced(m, fn, aliases[m.rel]))
+        for m in scoped:
+            findings.extend(self._check_jit_sites(m, aliases[m.rel]))
+        return findings
+
+    # ---------------------------------------------------- reachability
+
+    def _jit_roots(self, scoped, aliases) -> set:
+        roots = set()
+        for m in scoped:
+            al = aliases[m.rel]
+            for node in ast.walk(m.tree):
+                if isinstance(node, FUNC_DEFS):
+                    for dec in node.decorator_list:
+                        if self._decorator_is_jit(dec, al):
+                            roots.add(node.name)
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _resolve(attr_chain(node.func), al)
+                if _is_jit_chain(chain) or (
+                    chain and chain[-1] == "pallas_call"
+                ):
+                    for arg in node.args[:1]:
+                        name = _root_callable_name(arg)
+                        if name:
+                            roots.add(name)
+        return roots
+
+    @staticmethod
+    def _decorator_is_jit(dec, al) -> bool:
+        if _is_jit_chain(_resolve(attr_chain(dec), al)):
+            return True  # @jax.jit
+        if isinstance(dec, ast.Call):
+            if _is_jit_chain(_resolve(attr_chain(dec.func), al)):
+                return True  # @jax.jit(static_argnames=...)
+            chain = attr_chain(dec.func)
+            if chain and chain[-1] == "partial":
+                return any(
+                    _is_jit_chain(_resolve(attr_chain(a), al))
+                    for a in dec.args
+                )  # @functools.partial(jax.jit, ...)
+        return False
+
+    def _reach(self, table, roots, aliases) -> set:
+        """BFS over name-based reference edges from the jit roots.
+        Any Name/Attribute whose bare name matches a known function
+        counts as an edge (over-approximate on purpose); nested defs of
+        a traced function are traced too."""
+        traced: set = set()
+        frontier = [
+            entry for name in roots for entry in table.get(name, ())
+        ]
+        while frontier:
+            m, fn = frontier.pop()
+            key = (m, fn)
+            if key in traced:
+                continue
+            traced.add(key)
+            al = aliases[m.rel]
+            for node in ast.walk(fn):
+                if isinstance(node, FUNC_DEFS) and node is not fn:
+                    frontier.append((m, node))
+                    continue
+                name = None
+                if isinstance(node, ast.Name):
+                    name = node.id
+                elif isinstance(node, ast.Attribute):
+                    chain = _resolve(attr_chain(node), al)
+                    if chain and chain[0] in HOST_MODULES:
+                        continue  # np.kron is not a local `kron`
+                    name = node.attr
+                if name and name != fn.name and name in table:
+                    frontier.extend(table[name])
+        return traced
+
+    # ------------------------------------------------- traced-body rule
+
+    def _check_traced(self, m, fn, al):
+        params = _param_names(fn)
+        for node in _walk_skipping_nested(fn.body):
+            # maximal dotted chains only (walk visits sub-attributes);
+            # bare Names catch `from time import time` style aliases —
+            # Names inside a chain are handled by the Attribute branch
+            raw = None
+            if isinstance(node, ast.Attribute) and not isinstance(
+                m.parent(node), ast.Attribute
+            ):
+                raw = attr_chain(node)
+            elif (
+                isinstance(node, ast.Name)
+                and node.id in al
+                and not isinstance(m.parent(node), ast.Attribute)
+            ):
+                raw = [node.id]
+            if raw is not None:
+                chain = _resolve(raw, al)
+                if not chain:
+                    continue
+                head = chain[0]
+                shown = ".".join(raw)
+                if head == "time":
+                    yield self.finding(
+                        m,
+                        node,
+                        f"'{shown}' in jit-traced "
+                        f"'{fn.name}': host clock reads are baked in "
+                        "at trace time",
+                    )
+                elif head in ("random", "secrets") or chain[:2] == [
+                    "numpy", "random",
+                ]:
+                    yield self.finding(
+                        m,
+                        node,
+                        f"'{shown}' in jit-traced "
+                        f"'{fn.name}': trace-time nondeterminism — "
+                        "sample on the host, pass arrays in",
+                    )
+                elif chain[:2] == ["os", "environ"]:
+                    yield self.finding(
+                        m,
+                        node,
+                        f"os.environ read in jit-traced '{fn.name}': "
+                        "trace-time config must be part of the jit "
+                        "cache key (see bls.tpu_backend._impl_key)",
+                    )
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "item":
+                yield self.finding(
+                    m,
+                    node,
+                    f".item() in jit-traced '{fn.name}': host sync "
+                    "of a traced value",
+                )
+                continue
+            raw = attr_chain(func)
+            chain = _resolve(raw, al)
+            sync_name = None
+            if chain in (
+                ["numpy", "asarray"],
+                ["numpy", "array"],
+                ["jax", "device_get"],
+            ):
+                sync_name = ".".join(raw)
+            elif (
+                isinstance(func, ast.Name)
+                and func.id in ("int", "float", "bool")
+                and len(node.args) == 1
+            ):
+                sync_name = func.id + "()"
+            if sync_name is None or not node.args:
+                continue
+            arg = node.args[0]
+            if _rooted_at(arg, params) and not _is_static_expr(arg):
+                yield self.finding(
+                    m,
+                    node,
+                    f"{sync_name} on a parameter of jit-traced "
+                    f"'{fn.name}': host sync / device transfer of a "
+                    "traced value",
+                )
+
+    # ---------------------------------------------------- jit-cache rule
+
+    def _check_jit_sites(self, m, al):
+        # names bound at module level: the only legitimate cache homes
+        module_globals = {
+            t.id
+            for stmt in m.tree.body
+            if isinstance(stmt, ast.Assign)
+            for t in stmt.targets
+            if isinstance(t, ast.Name)
+        } | {
+            stmt.target.id
+            for stmt in m.tree.body
+            if isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+        }
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not _is_jit_chain(_resolve(attr_chain(node.func), al)):
+                continue
+            parent = m.parent(node)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                yield Finding(
+                    "jit-cache", m.rel, node.lineno,
+                    "jax.jit(...) invoked inline: a fresh trace cache "
+                    "per call — cache the jitted object",
+                )
+                continue
+            enclosing = None
+            for anc in m.ancestors(node):
+                if isinstance(anc, FUNC_DEFS):
+                    enclosing = anc
+                    break
+            if enclosing is None:
+                continue  # module-level singleton: one object per process
+            if self._is_cached_store(m, node, enclosing, module_globals):
+                continue
+            yield Finding(
+                "jit-cache", m.rel, node.lineno,
+                "jit object built inside a function but not stored in "
+                "a module-level cache (per-call retrace hazard; use a "
+                "cache dict keyed like _jitted[(impl, shape-bucket)])",
+            )
+
+    @staticmethod
+    def _is_cached_store(m, node, enclosing, module_globals) -> bool:
+        """True when the jit call's value lands in a process-level
+        home: a subscript of a MODULE-LEVEL container (`_jitted[key] =
+        ...`) or a `global`-declared rebind. A subscript of a function
+        local is a per-call dict — the retrace hazard, not a cache."""
+        globals_ = {
+            name
+            for n in ast.walk(enclosing)
+            if isinstance(n, ast.Global)
+            for name in n.names
+        }
+        for anc in m.ancestors(node):
+            if isinstance(anc, FUNC_DEFS):
+                return False
+            if isinstance(anc, ast.Assign):
+                for target in anc.targets:
+                    if isinstance(target, ast.Subscript):
+                        chain = attr_chain(target.value)
+                        root = chain[0] if chain else None
+                        if root in module_globals or root in globals_:
+                            return True
+                    if (
+                        isinstance(target, ast.Name)
+                        and target.id in globals_
+                    ):
+                        return True
+                return False
+        return False

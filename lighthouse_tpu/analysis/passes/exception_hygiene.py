@@ -1,0 +1,108 @@
+"""Exception hygiene: no silent ``except Exception`` swallows, no bare
+``except:`` at all.
+
+Five review rounds on PR 5 kept finding the same shape: a broad handler
+that eats an error the operator needed to see (or that a chaos test
+needed to assert on). The contract:
+
+Rule ``bare-except`` — ``except:`` (no type) is forbidden outright. It
+catches ``KeyboardInterrupt``/``SystemExit`` and cannot be justified;
+there is no allow for intent here, only for the named rule below.
+
+Rule ``except-swallow`` — an ``except Exception`` (or BaseException)
+handler must leave EVIDENCE, any of:
+
+  * re-raise (``raise`` anywhere in the body),
+  * use the bound exception (``except Exception as e`` + a reference
+    to ``e`` — error responses, result lists, reason strings),
+  * log it (a call through ``log``/``logger``/``logging`` or a
+    ``.debug/.info/.warning/.error/.exception`` method),
+  * count it (a terminal metric mutator ``.inc()``/``.observe()`` or a
+    journal ``.emit()`` — a bare ``.labels(...)`` or ``.set()`` proves
+    nothing and does not count),
+
+or carry ``# lint: allow(except-swallow): <reason>`` on the ``except``
+line — the reason documents WHY silence is the contract (version
+probes, decode-attempt loops, JWT validation returning False).
+
+Narrow handlers (``except ValueError`` etc.) are out of scope: naming
+the type is already the evidence of intent.
+"""
+
+import ast
+
+from lighthouse_tpu.analysis.core import Finding, LintPass, attr_chain
+
+BROAD_TYPES = {"Exception", "BaseException"}
+
+LOG_ROOTS = {"log", "logger", "logging", "LOG", "LOGGER"}
+LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical"}
+# counting evidence: terminal mutators only — bare `.labels(...)` or a
+# `.set()` (which also names threading.Event.set) prove nothing
+EVIDENCE_METHODS = {"inc", "observe", "emit"} | LOG_METHODS
+
+
+def _is_broad(handler) -> bool:
+    t = handler.type
+    if t is None:
+        return False  # bare-except is its own rule
+    chain = attr_chain(t)
+    return bool(chain) and chain[-1] in BROAD_TYPES
+
+
+def _handled(handler) -> bool:
+    bound = handler.name  # 'e' in `except Exception as e`, or None
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if bound and isinstance(node, ast.Name) and node.id == bound:
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr in EVIDENCE_METHODS:
+                    return True
+                chain = attr_chain(func)
+                if chain and chain[0] in LOG_ROOTS:
+                    return True
+            elif isinstance(func, ast.Name) and func.id in LOG_ROOTS:
+                return True
+    return False
+
+
+class ExceptionHygienePass(LintPass):
+    name = "except-swallow"
+    rules = ("except-swallow", "bare-except")
+    description = (
+        "except Exception must log/re-raise/count or carry an allow "
+        "reason; bare except: forbidden"
+    )
+
+    def run(self, modules):
+        findings = []
+        for m in modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if node.type is None:
+                    findings.append(
+                        Finding(
+                            "bare-except",
+                            m.rel,
+                            node.lineno,
+                            "bare 'except:' catches KeyboardInterrupt/"
+                            "SystemExit — name the exception type",
+                        )
+                    )
+                elif _is_broad(node) and not _handled(node):
+                    findings.append(
+                        Finding(
+                            "except-swallow",
+                            m.rel,
+                            node.lineno,
+                            "except Exception swallows silently — log "
+                            "it, count it, re-raise, or annotate "
+                            "'# lint: allow(except-swallow): why'",
+                        )
+                    )
+        return findings

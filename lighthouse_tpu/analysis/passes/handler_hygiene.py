@@ -1,0 +1,159 @@
+"""Handler hygiene: request-serving threads must snapshot shared state
+and must not run blocking device work inline.
+
+Scope: the HTTP API surface (``http_api/server.py`` — every function is
+on a ThreadingHTTPServer request path except construction/lifecycle)
+and the gossip hub (``network/gossip.py`` — deliver/publish callbacks
+run on whatever thread publishes).
+
+Rule ``handler-snapshot`` — the PR 6 scrape-race class: an HTTP thread
+iterating ``net.peers`` / ``proto.nodes`` while the import thread
+mutates them dies with ``RuntimeError: dictionary changed size`` (or
+serves a torn view). Any ``for``/comprehension whose iterable reads one
+of the known shared-mutable attributes (``peers``, ``nodes``,
+``quarantined``, ``subscriptions``, ``_seen`` — extend the set as new
+shared state grows) must take an atomic snapshot first: ``list(...)``,
+``dict(...)``, ``sorted(...)``, ``tuple(...)``, ``set(...)``, or
+``.copy()``/``.snapshot()``. ``x in shared`` membership tests and
+``len(shared)`` are single C-level ops and stay exempt.
+
+Rule ``handler-device-call`` — HTTP/gossip handlers may not call the
+blocking device entry points (a pairing batch holds the request thread
+for tens of milliseconds and serializes behind the import path's device
+queue). Device work routes through the beacon processor; the handler
+enqueues and returns.
+"""
+
+import ast
+
+from lighthouse_tpu.analysis.core import Finding, LintPass, attr_chain
+
+FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+SCOPE_FILES = {"http_api/server.py", "network/gossip.py"}
+EXEMPT_FUNCTIONS = {"__init__", "start", "stop", "log_message"}
+
+# attribute names read as shared mutable containers across threads
+SHARED_ATTRS = {"peers", "nodes", "quarantined", "subscriptions", "_seen"}
+
+# snapshot constructors: a fresh container the mutating thread never saw
+SNAPSHOT_CALLS = {
+    "list", "dict", "sorted", "tuple", "set", "frozenset",
+}
+SNAPSHOT_METHODS = {"copy", "snapshot"}
+# transparent wrappers: look through to the real iterable
+PASSTHROUGH_CALLS = {"enumerate", "reversed", "iter", "zip"}
+
+# blocking device-plane entry points (host->device dispatch + force)
+DEVICE_ENTRY_POINTS = {
+    "verify_signature_sets_tpu",
+    "verify_signature_set_batches_tpu",
+    "verify_signature_sets_tpu_individual",
+    "verify_blob_kzg_proof_batch_tpu",
+    "g1_msm_fixed_base_tpu",
+    "g1_msm_tpu",
+}
+
+
+def _shared_attr_name(expr):
+    """The shared attribute a bare (unsnapshotted) expression reads:
+    ``x.peers`` / ``x.peers.items()`` / ``getattr(x, "peers", {})`` —
+    or None when the expression is already a snapshot."""
+    if isinstance(expr, ast.Attribute) and expr.attr in SHARED_ATTRS:
+        return expr.attr
+    if isinstance(expr, ast.Call):
+        func = expr.func
+        chain = attr_chain(func)
+        # list(...) / dict(...) / sorted(...): snapshot — done
+        if chain and len(chain) == 1 and chain[0] in SNAPSHOT_CALLS:
+            return None
+        # .copy() / .snapshot(): snapshot — done
+        if isinstance(func, ast.Attribute) and (
+            func.attr in SNAPSHOT_METHODS
+        ):
+            return None
+        # .items()/.values()/.keys(): live view — check the receiver
+        if isinstance(func, ast.Attribute) and func.attr in (
+            "items", "values", "keys",
+        ):
+            return _shared_attr_name(func.value)
+        # .get(...) on a shared dict returns a VALUE, not the dict
+        if isinstance(func, ast.Attribute) and func.attr == "get":
+            return None
+        # enumerate/reversed/iter/zip: transparent — check the args
+        if chain and len(chain) == 1 and chain[0] in PASSTHROUGH_CALLS:
+            for a in expr.args:
+                hit = _shared_attr_name(a)
+                if hit:
+                    return hit
+            return None
+        # getattr(x, "peers", default) reads the live container
+        if (
+            isinstance(func, ast.Name)
+            and func.id == "getattr"
+            and len(expr.args) >= 2
+            and isinstance(expr.args[1], ast.Constant)
+            and expr.args[1].value in SHARED_ATTRS
+        ):
+            return expr.args[1].value
+    return None
+
+
+class HandlerHygienePass(LintPass):
+    name = "handler-snapshot"
+    rules = ("handler-snapshot", "handler-device-call")
+    description = (
+        "HTTP/gossip handlers snapshot shared mutable state before "
+        "iterating and never run blocking device work inline"
+    )
+
+    def run(self, modules):
+        findings = []
+        for m in modules:
+            if m.rel not in SCOPE_FILES:
+                continue
+            for fn in ast.walk(m.tree):
+                if not isinstance(fn, FUNC_DEFS):
+                    continue
+                if fn.name in EXEMPT_FUNCTIONS:
+                    continue
+                findings.extend(self._check_handler(m, fn))
+        return findings
+
+    def _check_handler(self, m, fn):
+        for node in ast.walk(fn):
+            iters = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append(node.iter)
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                       ast.GeneratorExp),
+            ):
+                iters.extend(g.iter for g in node.generators)
+            for it in iters:
+                attr = _shared_attr_name(it)
+                if attr:
+                    yield Finding(
+                        "handler-snapshot",
+                        m.rel,
+                        it.lineno,
+                        f"iterating shared '{attr}' without an atomic "
+                        f"snapshot in '{fn.name}' — wrap in list()/"
+                        "dict()/sorted() (mutating threads race the "
+                        "iterator)",
+                    )
+            if isinstance(node, ast.Call):
+                name = None
+                if isinstance(node.func, ast.Name):
+                    name = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    name = node.func.attr
+                if name in DEVICE_ENTRY_POINTS:
+                    yield Finding(
+                        "handler-device-call",
+                        m.rel,
+                        node.lineno,
+                        f"blocking device entry point '{name}' called "
+                        f"from handler '{fn.name}' — route through "
+                        "the beacon processor",
+                    )

@@ -1,0 +1,224 @@
+"""Lock discipline: writes to shared storage and guarded internal state
+must sit lexically under the owning lock.
+
+Two concrete invariant classes (both bought with real review rounds):
+
+Rule ``store-lock`` — the hot/cold store. ``HotColdDB`` serializes kv
+WRITES between the import path and the threaded background migrator
+behind ``self.lock`` (PR 6); the kv backends are individually atomic
+but multi-op sequences must not interleave. The rule: in any class that
+owns BOTH ``self.kv`` and ``self.lock``, every ``self.kv.put(...)`` /
+``self.kv.delete(...)`` must be lexically inside a ``with self.lock:``
+block. Reads stay lock-free by design (single atomic gets).
+``store/hot_cold.py``'s ``HotColdDB`` is additionally REQUIRED to own
+the lock — deleting the lock would otherwise silence the rule along
+with the bug (the kv-write-outside-lock canary).
+
+Rule ``guarded-attr`` — lock-owning infrastructure classes
+(``common/metrics.py``, ``common/events_journal.py``: Registry, metric
+families, Journal). Any method that mutates underscore-private state
+(``self._ring.append(...)``, ``self._seq += 1``,
+``self._children[k] = ...``) must do it under ``with self._lock:`` —
+the class of bug PR 6's scrape-vs-import RLock fix closed.
+``__init__`` is exempt (no aliasing before construction completes).
+"""
+
+import ast
+
+from lighthouse_tpu.analysis.core import Finding, LintPass, attr_chain
+
+FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# modules whose lock-owning classes get the guarded-attr rule; scoped
+# tightly because plenty of classes are single-threaded by contract
+# (RegistryBackedMetrics documents owner-thread writes + atomic
+# snapshot reads, for instance)
+GUARDED_MODULES = {"common/metrics.py", "common/events_journal.py"}
+
+# classes that MUST own a write lock: (module rel, class name, lock attr)
+REQUIRED_LOCKS = (("store/hot_cold.py", "HotColdDB", "lock"),)
+
+MUTATOR_METHODS = {
+    "append", "appendleft", "add", "clear", "pop", "popleft",
+    "popitem", "update", "extend", "remove", "discard", "insert",
+    "setdefault",
+}
+
+KV_WRITE_METHODS = {"put", "delete"}
+
+
+def _self_attr(node, names=None):
+    """'self.<attr>' -> attr name (optionally restricted), else None."""
+    chain = attr_chain(node)
+    if chain and len(chain) == 2 and chain[0] == "self":
+        if names is None or chain[1] in names:
+            return chain[1]
+    return None
+
+
+def _init_self_assigns(cls) -> set:
+    """Attribute names assigned as `self.X = ...` in __init__."""
+    out = set()
+    for node in cls.body:
+        if isinstance(node, FUNC_DEFS) and node.name == "__init__":
+            for n in ast.walk(node):
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            out.add(attr)
+    return out
+
+
+def _under_with_lock(module, node, lock_attr: str) -> bool:
+    """Is `node` lexically inside `with self.<lock_attr>:`? Stops at the
+    enclosing function boundary — a lock held by a CALLER is not lexical
+    evidence (that is what the RLock re-entry idiom is for)."""
+    for anc in module.ancestors(node):
+        if isinstance(anc, FUNC_DEFS):
+            return False
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                if _self_attr(item.context_expr, {lock_attr}):
+                    return True
+    return False
+
+
+def _enclosing_method(module, node):
+    for anc in module.ancestors(node):
+        if isinstance(anc, FUNC_DEFS):
+            return anc
+    return None
+
+
+class LockDisciplinePass(LintPass):
+    name = "store-lock"
+    rules = ("store-lock", "guarded-attr")
+    description = (
+        "kv-column writes under the store lock; Registry/Journal "
+        "internal-state mutation under their own locks"
+    )
+
+    def run(self, modules):
+        findings = []
+        by_rel = {m.rel: m for m in modules}
+        for m in modules:
+            for cls in [
+                n for n in ast.walk(m.tree) if isinstance(n, ast.ClassDef)
+            ]:
+                findings.extend(self._check_class(m, cls))
+        for rel, cls_name, lock_attr in REQUIRED_LOCKS:
+            m = by_rel.get(rel)
+            if m is None:
+                continue
+            cls = next(
+                (
+                    n
+                    for n in ast.walk(m.tree)
+                    if isinstance(n, ast.ClassDef) and n.name == cls_name
+                ),
+                None,
+            )
+            if cls is None or lock_attr not in _init_self_assigns(cls):
+                line = cls.lineno if cls is not None else 1
+                findings.append(
+                    Finding(
+                        "store-lock",
+                        rel,
+                        line,
+                        f"{cls_name} must own 'self.{lock_attr}' "
+                        "(serializes kv writes against the background "
+                        "migrator) — see store-lock rule",
+                    )
+                )
+        return findings
+
+    def _check_class(self, m, cls):
+        attrs = _init_self_assigns(cls)
+        # ---- store-lock: self.kv writes under self.lock
+        if "kv" in attrs and "lock" in attrs:
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if (
+                    chain
+                    and len(chain) == 3
+                    and chain[0] == "self"
+                    and chain[1] == "kv"
+                    and chain[2] in KV_WRITE_METHODS
+                ):
+                    meth = _enclosing_method(m, node)
+                    if meth is not None and meth.name == "__init__":
+                        continue
+                    if not _under_with_lock(m, node, "lock"):
+                        yield Finding(
+                            "store-lock",
+                            m.rel,
+                            node.lineno,
+                            f"self.kv.{chain[2]}() outside 'with "
+                            "self.lock' — kv writes must not "
+                            "interleave with the background migrator",
+                        )
+        # ---- guarded-attr: self._X mutation under self._lock
+        if m.rel not in GUARDED_MODULES or "_lock" not in attrs:
+            return
+        for node in ast.walk(cls):
+            attr, what = self._private_mutation(node)
+            if attr is None:
+                continue
+            meth = _enclosing_method(m, node)
+            if meth is None or meth.name == "__init__":
+                continue
+            if not _under_with_lock(m, node, "_lock"):
+                yield Finding(
+                    "guarded-attr",
+                    m.rel,
+                    node.lineno,
+                    f"{what} of self.{attr} outside 'with self._lock' "
+                    f"in {cls.name}.{meth.name} — scrape/import "
+                    "threads race unguarded internal state",
+                )
+
+    @staticmethod
+    def _private_mutation(node):
+        """(attr, description) when `node` mutates self._X, else
+        (None, None). _lock itself is exempt."""
+
+        def private(target):
+            a = _self_attr(target)
+            if a and a.startswith("_") and a != "_lock":
+                return a
+            return None
+
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                a = private(t)
+                if a:
+                    return a, "assignment"
+                if isinstance(t, ast.Subscript):
+                    a = private(t.value)
+                    if a:
+                        return a, "item assignment"
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if node.func.attr in MUTATOR_METHODS:
+                a = private(node.func.value)
+                if a:
+                    return a, f".{node.func.attr}()"
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                a = private(t)
+                if a:
+                    return a, "del"
+                if isinstance(t, ast.Subscript):
+                    a = private(t.value)
+                    if a:
+                        return a, "del item"
+        return None, None

@@ -1,0 +1,236 @@
+"""Metric-name + journal-event-kind lint, as a framework pass.
+
+This is `scripts/check_metric_names.py` folded into the one lint plane
+(that script is now a thin shim over this module; its `collect(root)` /
+`registered_event_kinds(root)` / `main(argv)` surface is preserved
+verbatim for tests and direct invocations). The contract is unchanged:
+
+  * every metric registered on the global REGISTRY uses a LITERAL name
+    matching ``lighthouse_tpu_[a-z0-9_]+``, registered at exactly ONE
+    call site (rule ``metric-name``);
+  * every journal ``emit`` call uses a LITERAL event kind registered in
+    ``common/events_journal.py``'s closed ``KINDS`` vocabulary (rule
+    ``journal-kind``).
+
+The registry-infrastructure module (``common/metrics.py``) stays exempt
+from the literal-name rule: RegistryBackedMetrics derives gauge names
+from mapping keys by design.
+"""
+
+import ast
+import re
+
+from lighthouse_tpu.analysis.core import Finding, LintPass, iter_modules
+
+REGISTRATION_METHODS = {
+    "counter",
+    "gauge",
+    "histogram",
+    "counter_vec",
+    "gauge_vec",
+    "histogram_vec",
+}
+NAME_RE = re.compile(r"^lighthouse_tpu_[a-z0-9_]+$")
+KIND_RE = re.compile(r"^[a-z0-9_]+$")
+# registry plumbing: name synthesis from mapping keys is the point
+EXEMPT_FILES = {"common/metrics.py"}
+EVENTS_MODULE = "common/events_journal.py"
+
+
+def _registry_call_name(node: ast.Call):
+    """'REGISTRY.<method>' call -> method name, else None."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    if fn.attr not in REGISTRATION_METHODS:
+        return None
+    if isinstance(fn.value, ast.Name) and fn.value.id == "REGISTRY":
+        return fn.attr
+    return None
+
+
+def _journal_emit_kind(node: ast.Call):
+    """A journal `emit` call -> its kind arg node, else None. Matches
+    `<anything>.journal.emit(...)`, `JOURNAL.emit(...)`, and
+    `journal.emit(...)` — the journal's only spelling conventions."""
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "emit"):
+        return None
+    recv = fn.value
+    if isinstance(recv, ast.Attribute) and recv.attr == "journal":
+        pass
+    elif isinstance(recv, ast.Name) and recv.id in ("JOURNAL", "journal"):
+        pass
+    else:
+        return None
+    return node.args[0] if node.args else ast.Constant(value=None)
+
+
+def _kinds_from_tree(tree) -> set:
+    """The closed KINDS vocabulary, parsed statically from the journal
+    module's AST (the lint must not import the package)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "KINDS"
+            for t in node.targets
+        ):
+            continue
+        kinds = set()
+        for lit in ast.walk(node.value):
+            if isinstance(lit, ast.Constant) and isinstance(
+                lit.value, str
+            ):
+                kinds.add(lit.value)
+        return kinds
+    return set()
+
+
+def _scan(modules):
+    """One walk, two output shapes: framework `Finding`s and the legacy
+    (sites, violation-strings) contract of check_metric_names.py."""
+    findings: list[Finding] = []
+    legacy: list[str] = []
+    sites: dict[str, list] = {}
+
+    events = next((m for m in modules if m.rel == EVENTS_MODULE), None)
+    kinds = _kinds_from_tree(events.tree) if events is not None else set()
+    for kind in sorted(kinds):
+        if not KIND_RE.match(kind):
+            msg = f"registered kind {kind!r} does not match [a-z0-9_]+"
+            legacy.append(f"{EVENTS_MODULE}: {msg}")
+            findings.append(Finding("journal-kind", EVENTS_MODULE, 1, msg))
+
+    def violation(rule, m, line, msg):
+        legacy.append(f"{m.rel}:{line}: {msg}")
+        findings.append(Finding(rule, m.rel, line, msg))
+
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind_arg = _journal_emit_kind(node)
+            if kind_arg is not None and m.rel != EVENTS_MODULE:
+                if not (
+                    isinstance(kind_arg, ast.Constant)
+                    and isinstance(kind_arg.value, str)
+                ):
+                    violation(
+                        "journal-kind", m, node.lineno,
+                        "journal event kind must be a string literal",
+                    )
+                elif kind_arg.value not in kinds:
+                    violation(
+                        "journal-kind", m, node.lineno,
+                        f"journal event kind {kind_arg.value!r} is not "
+                        f"registered in {EVENTS_MODULE} KINDS",
+                    )
+                continue
+            if _registry_call_name(node) is None:
+                continue
+            if m.rel in EXEMPT_FILES:
+                continue
+            if not node.args:
+                violation(
+                    "metric-name", m, node.lineno,
+                    "registry call without a name",
+                )
+                continue
+            first = node.args[0]
+            if not (
+                isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+            ):
+                violation(
+                    "metric-name", m, node.lineno,
+                    "metric name must be a string literal",
+                )
+                continue
+            name = first.value
+            if not NAME_RE.match(name):
+                violation(
+                    "metric-name", m, node.lineno,
+                    f"{name!r} does not match lighthouse_tpu_[a-z0-9_]+",
+                )
+            sites.setdefault(name, []).append((m.rel, node.lineno))
+
+    for name, where in sorted(sites.items()):
+        if len(where) > 1:
+            locs = ", ".join(f"{f}:{ln}" for f, ln in where)
+            legacy.append(
+                f"{name!r} registered at {len(where)} sites ({locs}); "
+                "register once and share the object"
+            )
+            files = ", ".join(sorted({f for f, _ in where}))
+            findings.append(
+                Finding(
+                    "metric-name",
+                    where[0][0],
+                    where[0][1],
+                    f"{name!r} registered at {len(where)} sites "
+                    f"({files}); register once and share the object",
+                )
+            )
+    return findings, sites, legacy
+
+
+class MetricNamesPass(LintPass):
+    name = "metric-name"
+    rules = ("metric-name", "journal-kind")
+    description = (
+        "literal single-site lighthouse_tpu_* metric names; literal "
+        "registered journal event kinds"
+    )
+
+    def run(self, modules):
+        findings, _sites, _legacy = _scan(modules)
+        return findings
+
+
+# ------------------------------------------------ legacy script surface
+
+
+def registered_event_kinds(package_root) -> set:
+    """Parse the closed KINDS vocabulary out of events_journal.py
+    (statically — the lint must not import the package)."""
+    from pathlib import Path
+
+    path = Path(package_root) / EVENTS_MODULE
+    if not path.exists():  # linting a tree without the journal module
+        return set()
+    return _kinds_from_tree(
+        ast.parse(path.read_text(), filename=str(path))
+    )
+
+
+def collect(package_root) -> tuple:
+    """Scan the package; returns (name -> [(file, line), ...],
+    violation strings) — the exact check_metric_names.py contract."""
+    modules, parse_findings = iter_modules(package_root)
+    _findings, sites, legacy = _scan(modules)
+    violations = [
+        f"{f.path}: {f.msg}" for f in parse_findings
+    ] + legacy
+    return sites, violations
+
+
+def main(argv=None) -> int:
+    import sys
+    from pathlib import Path
+
+    argv = argv if argv is not None else sys.argv[1:]
+    if argv:
+        root = Path(argv[0])
+    else:
+        root = (
+            Path(__file__).resolve().parents[2]
+        )  # .../lighthouse_tpu
+    sites, violations = collect(root)
+    if violations:
+        print(f"{len(violations)} metric-name violation(s):")
+        for v in violations:
+            print(f"  {v}")
+        return 1
+    print(f"{len(sites)} metric families OK under {root}")
+    return 0

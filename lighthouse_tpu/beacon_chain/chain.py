@@ -30,6 +30,7 @@ from lighthouse_tpu.beacon_chain.observed import (
 )
 from lighthouse_tpu.beacon_chain.operation_pool import OperationPool
 from lighthouse_tpu.common.events_journal import Journal
+from lighthouse_tpu.common.logging import get_logger
 from lighthouse_tpu.common.metrics import RegistryBackedMetrics
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.fork_choice import ForkChoice
@@ -53,6 +54,8 @@ from lighthouse_tpu.state_processing.pubkey_cache import PubkeyCache
 from lighthouse_tpu.store import HotColdDB, MemoryStore
 from lighthouse_tpu.types.containers import types_for
 from lighthouse_tpu.types.spec import Spec
+
+_LOG = get_logger("chain")
 
 SNAPSHOT_CACHE_SIZE = 4
 
@@ -551,8 +554,10 @@ class BeaconChain:
                     bytes(att.data.beacon_block_root),
                     att.data.target.epoch,
                 )
-            except Exception:
-                pass
+            except Exception as e:
+                # attestations for blocks fork choice never saw are
+                # routine during sync; anything else deserves a trace
+                _LOG.debug("on_attestation skipped: %s", e)
 
         self._cache_snapshot(block_root, state)
         self.metrics["blocks_imported"] += 1
@@ -705,7 +710,10 @@ class BeaconChain:
                 ],
                 backend=self.backend,
             )
-        except Exception:
+        except Exception as e:
+            # malformed points/unknown proposer index verify to False;
+            # the gossip caller treats that as an invalid sidecar
+            _LOG.debug("sidecar header verification errored: %s", e)
             return False
         if ok:
             self._verified_sidecar_headers[key] = None

@@ -117,9 +117,11 @@ class ValidatorMonitor:
                         for i in proposers_fn(epoch)
                         if i in self.registered
                     ]
+                # lint: allow(except-swallow): shuffle unavailable
                 except Exception:
                     # proposer shuffle unavailable (pruned state on a
-                    # checkpoint-synced node): report without it
+                    # checkpoint-synced node): report without it —
+                    # expected on checkpoint-synced nodes, not an error
                     self._expected_proposals[epoch] = []
             summary = self.epoch_summary(epoch)
             self.last_summary = summary

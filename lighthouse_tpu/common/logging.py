@@ -22,9 +22,23 @@ class KeyValueFormatter(logging.Formatter):
         return base
 
 
-def get_logger(name: str = "lighthouse_tpu", level=logging.INFO):
+def get_logger(name: str = "lighthouse_tpu", level=None):
+    """Named structured logger. Default level comes from
+    LIGHTHOUSE_TPU_LOG_LEVEL (debug|info|warning|error; default info) —
+    the knob that makes the hot-path `_LOG.debug(...)` evidence lines
+    reachable in the field without a code change."""
     logger = logging.getLogger(name)
     if not logger.handlers:
+        if level is None:
+            import os
+
+            level = getattr(
+                logging,
+                os.environ.get(
+                    "LIGHTHOUSE_TPU_LOG_LEVEL", "info"
+                ).upper(),
+                logging.INFO,
+            )
         h = logging.StreamHandler(sys.stderr)
         h.setFormatter(KeyValueFormatter())
         logger.addHandler(h)

@@ -214,7 +214,8 @@ def jwt_verify(secret: bytes, token: str, now: int | None = None) -> bool:
         iat = int(body["iat"])
         now = int(now if now is not None else time.time())
         return abs(now - iat) <= JWT_EXP_SLACK_SECS
-    except Exception:
+    # lint: allow(except-swallow): JWT validation maps any malformed
+    except Exception:  # token to False by contract
         return False
 
 

@@ -294,7 +294,8 @@ def _decode_checkpoint_state(raw_state: bytes, spec):
     for fork in reversed(list(t.state_classes)):
         try:
             cand = t.state_classes[fork].decode(raw_state)
-        except Exception:
+        # lint: allow(except-swallow): fork-probe decode loop; failure
+        except Exception:  # means "try the next fork class"
             continue
         if spec.fork_name_at_epoch(
             spec.slot_to_epoch(cand.slot)

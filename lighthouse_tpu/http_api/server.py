@@ -363,9 +363,12 @@ class BeaconApiServer:
             and parts[2] == "debug"
         ):
             if parts[3:5] == ["beacon", "heads"]:
-                proto = chain.fork_choice.proto
+                # ONE snapshot for both walks: the import thread appends
+                # to proto.nodes, and parent indices must agree with the
+                # enumeration they were computed against
+                nodes = list(chain.fork_choice.proto.nodes)
                 is_parent = {
-                    n.parent for n in proto.nodes if n.parent is not None
+                    n.parent for n in nodes if n.parent is not None
                 }
                 heads = [
                     {
@@ -374,7 +377,7 @@ class BeaconApiServer:
                         "execution_optimistic":
                             chain.fork_choice.is_optimistic(n.root),
                     }
-                    for i, n in enumerate(proto.nodes)
+                    for i, n in enumerate(nodes)
                     if i not in is_parent
                 ]
                 return {"data": heads}
@@ -384,11 +387,13 @@ class BeaconApiServer:
                 state = self._resolve_state(parts[5])
                 return (state.to_bytes(), "application/octet-stream")
             if parts[3] == "fork_choice":
-                proto = chain.fork_choice.proto
+                # snapshot before iterating AND before parent-index
+                # lookups — the import thread appends concurrently
+                proto_nodes = list(chain.fork_choice.proto.nodes)
                 nodes = []
-                for node in proto.nodes:
+                for node in proto_nodes:
                     parent_root = (
-                        proto.nodes[node.parent].root
+                        proto_nodes[node.parent].root
                         if node.parent is not None
                         else b""
                     )

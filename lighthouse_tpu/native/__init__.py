@@ -40,7 +40,8 @@ def build(force: bool = False) -> bool:
             cmd, check=True, capture_output=True, timeout=120
         )
         return True
-    except Exception:
+    # lint: allow(except-swallow): build probe; False selects the
+    except Exception:  # pure-python fallback
         return False
 
 

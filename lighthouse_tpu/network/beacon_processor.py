@@ -298,5 +298,6 @@ class BeaconProcessor:
             kind, payload = nxt
             try:
                 self._run_batch(kind, payload)
-            except Exception:  # worker errors must not kill the pool
+            # lint: allow(except-swallow): belt for the pool loop —
+            except Exception:  # _run_batch already counted handler_error
                 pass

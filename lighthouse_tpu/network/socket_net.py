@@ -41,6 +41,7 @@ import threading
 import time
 
 from lighthouse_tpu.common.locks import TimedLock
+from lighthouse_tpu.common.logging import TimeLatch, get_logger
 
 from lighthouse_tpu.network.gossip import (
     BAN_THRESHOLD,
@@ -70,6 +71,8 @@ from lighthouse_tpu.network.snappy_codec import (
     frame_compress,
     frame_decompress,
 )
+
+_LOG = get_logger("socket_net")
 
 KIND_HELLO = 1
 KIND_GOSSIP = 2
@@ -222,6 +225,7 @@ class SocketNet:
         self._req_id = 0
         self._req_lock = TimedLock("socket_net.rpc_req")
         self._stopping = False
+        self._heartbeat_latch = TimeLatch(30.0)
         # per-topic gossip mesh (gossipsub GRAFT/PRUNE control plane)
         self._mesh: dict[str, set[str]] = {}
         self._mesh_lock = TimedLock("socket_net.mesh")
@@ -538,8 +542,14 @@ class SocketNet:
             time.sleep(HEARTBEAT_INTERVAL)
             try:
                 self._maintain_mesh()
-            except Exception:
-                pass  # the heartbeat must survive transient peer churn
+            except Exception as e:
+                # the heartbeat must survive transient peer churn —
+                # visibly: a REPEATING failure here means the mesh is
+                # not being maintained, so it warns (rate-latched)
+                if self._heartbeat_latch.elapsed():
+                    _LOG.warning(
+                        "heartbeat mesh maintenance failing: %s", e
+                    )
 
     def _maintain_mesh(self):
         """Gossipsub heartbeat: graft under-degree topics up toward D,

@@ -34,6 +34,7 @@ import random
 import time
 
 from lighthouse_tpu.common.events_journal import JOURNAL
+from lighthouse_tpu.common.logging import get_logger
 from lighthouse_tpu.common.metrics import REGISTRY, RegistryBackedMetrics
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.network.gossip import (
@@ -49,6 +50,8 @@ from lighthouse_tpu.network.rpc import (
     RateLimitExceeded,
     RpcError,
 )
+
+_LOG = get_logger("sync")
 
 EPOCHS_PER_BATCH = 2
 # peer Status cache TTL: well under the 15 s status-bucket window, so a
@@ -181,8 +184,9 @@ class SyncManager:
         if rpc is not None:
             try:
                 rpc.goodbye(self._caller(), reason)
-            except Exception:
-                pass
+            except Exception as e:
+                # best-effort farewell: the peer is going away anyway
+                _LOG.debug("goodbye to %s failed: %s", peer_id, e)
         self.remove_peer(peer_id)
 
     def _caller(self) -> str:
@@ -198,8 +202,12 @@ class SyncManager:
         if self.hub is not None:
             try:
                 self.hub.report(peer_id, delta)
-            except Exception:
-                pass
+            except Exception as e:
+                # the score still counts locally; a hub glitch must not
+                # break the sync path — but it must be visible
+                _LOG.warning(
+                    "hub.report(%s, %s) failed: %s", peer_id, delta, e
+                )
 
     def _quarantine(self, peer_id: str, reason: str):
         self._downscore(peer_id, SCORE_INVALID_MESSAGE, reason)
@@ -699,10 +707,10 @@ class SyncManager:
             try:
                 self.chain.process_blob_sidecar(sc, verify_header=False)
                 fetched += 1
-            except Exception:
+            except Exception as e:
                 # duplicates on a re-queued range are expected; real
                 # mismatches surface as DA failures at import
-                pass
+                _LOG.debug("sidecar ingest skipped: %s", e)
         _SIDECARS_FETCHED.inc(fetched)
         self.metrics["sidecars_fetched"] += fetched
         return fetched

@@ -240,6 +240,7 @@ def use_mxu_conv() -> bool:
     functions after flipping it."""
     import os
 
+    # lint: allow(device-purity): trace-time knob, keyed via _impl_key
     return os.environ.get("LIGHTHOUSE_TPU_MXU_CONV") == "1"
 
 
@@ -255,6 +256,7 @@ def _conv_contract(prod, conv_tensor):
     plan item 2). Column sums stay < 2^31, so the recombination
     sum(part_n << 7n) is exact in int32 and the result is bit-identical
     to the VPU path (the relaxed-limb bound proofs are untouched)."""
+    # lint: allow(device-purity): conv_tensor is a static 0/1 host constant
     conv = np.asarray(conv_tensor)
     if not use_mxu_conv():
         return jnp.einsum("...ij,ijk->...k", prod, jnp.asarray(conv))
@@ -318,6 +320,7 @@ def apply_combo(x, matrix):
 
     Reduces twice: the offset pushes the value to ~448p, where one
     quotient-estimate pass only reaches ~2.55p (see module docstring)."""
+    # lint: allow(device-purity): matrix is a static recombination table
     m = np.asarray(matrix, dtype=np.int32)
     assert np.abs(m).sum(axis=1).max() <= _OFF_K, "combo L1 too large"
     y = jnp.einsum("os,...sn->...on", jnp.asarray(m), x)

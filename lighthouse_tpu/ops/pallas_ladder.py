@@ -92,6 +92,7 @@ def use_windowed_ladder() -> bool:
     (part of tpu_backend's jit-cache key)."""
     import os
 
+    # lint: allow(device-purity): trace-time knob, keyed via _impl_key
     v = os.environ.get("LIGHTHOUSE_TPU_LADDER", "")
     if v in ("", "0"):
         return False

@@ -106,6 +106,7 @@ def _const_col(limbs, name=None):
     an installed override (a traced in-kernel value) takes precedence."""
     if name is not None and name in _CONST_OVERRIDES:
         return _CONST_OVERRIDES[name]
+    # lint: allow(device-purity): limbs is a static host constant list
     return jnp.asarray(np.array(limbs, dtype=np.int32)[:, None])
 
 
@@ -139,6 +140,7 @@ def use_mxu_redc() -> str:
     flipping it."""
     import os
 
+    # lint: allow(device-purity): trace-time knob, keyed via _impl_key
     v = os.environ.get("LIGHTHOUSE_TPU_MXU_REDC", "")
     if v in ("", "0"):
         return ""
@@ -315,6 +317,7 @@ def apply_combo(x, matrix):
     """Slot recombination: (..., S_in, NB, B) -> (..., S_out, NB, B).
     Unrolled per output row over static small coefficients (rows L1 <= 36);
     double-reduced exactly like fieldb.apply_combo."""
+    # lint: allow(device-purity): matrix is a static recombination table
     m = np.asarray(matrix, dtype=np.int64)
     assert np.abs(m).sum(axis=1).max() <= fb._OFF_K, "combo L1 too large"
     off = _const_col(_OFF, "off")

@@ -64,6 +64,7 @@ def _combo2(vals, coeffs):
     """One apply_combo over a list of Fp2 bundles; `coeffs` (n_out, n_in)
     acts Fp2-componentwise."""
     x = jnp.concatenate(vals, axis=-3)
+    # lint: allow(device-purity): coeffs is a static integer matrix
     m = np.kron(np.asarray(coeffs, dtype=np.int64), np.eye(2, dtype=np.int64))
     y = tf.apply_combo(x, m.astype(np.int32))
     return [y[..., 2 * i : 2 * i + 2, :, :] for i in range(coeffs.shape[0])]

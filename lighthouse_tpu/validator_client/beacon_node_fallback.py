@@ -58,7 +58,8 @@ class BeaconNodeFallback:
                     cand.health = CandidateHealth.SYNCING
                 else:
                     cand.health = CandidateHealth.HEALTHY
-            except Exception:
+            # lint: allow(except-swallow): the exception IS the
+            except Exception:  # signal — any API failure means OFFLINE
                 cand.health = CandidateHealth.OFFLINE
 
     def _ranked(self):

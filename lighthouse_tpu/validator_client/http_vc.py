@@ -290,6 +290,7 @@ class HttpValidatorClient:
                 agg_json = self.client.get_aggregate_attestation(
                     slot, self.t.AttestationData.hash_tree_root(data)
                 )
+            # lint: allow(except-swallow): absence is expected
             except Exception:
                 continue  # nothing aggregated for this committee yet
             msg = self.t.AggregateAndProof(
@@ -391,8 +392,9 @@ class HttpValidatorClient:
                     c_json = self.client.get_sync_committee_contribution(
                         slot, subcommittee, head_root
                     )
+                # lint: allow(except-swallow): absence is expected
                 except Exception:
-                    continue
+                    continue  # no contribution for this subcommittee
                 msg = self.t.ContributionAndProof(
                     aggregator_index=index,
                     contribution=from_json(

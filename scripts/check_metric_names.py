@@ -1,195 +1,36 @@
 #!/usr/bin/env python
-"""Metric-name + journal-event-kind lint for the process registry.
+"""Metric-name + journal-event-kind lint — thin shim.
 
-Statically enforces the observability contract over the whole
-`lighthouse_tpu` package:
+The implementation moved into the repo-wide invariant-lint framework:
+`lighthouse_tpu/analysis/passes/metric_names.py` (one lint plane, one
+suppression syntax, one tier-1 gate — see scripts/lint.py). This shim
+preserves the original surface for tests and direct invocations:
 
-  * every metric registered on the global REGISTRY uses a LITERAL name
-    (dynamic names defeat grep, dashboards, and this lint);
-  * every name matches ``lighthouse_tpu_[a-z0-9_]+``;
-  * every name is registered at exactly ONE call site (one family, one
-    owner — lookups go through Registry.get/get_value, which have no
-    registration side effect);
-  * every lifecycle-journal `emit` call (``self.journal.emit(...)``,
-    ``JOURNAL.emit(...)``) uses a LITERAL event kind that is registered
-    in `common/events_journal.py`'s closed `KINDS` vocabulary and
-    matches ``[a-z0-9_]+`` — the journal's typed-event contract,
-    enforced the same way metric names are.
+  * ``collect(package_root) -> (sites, violations)``
+  * ``registered_event_kinds(package_root) -> set``
+  * ``main(argv) -> exit code`` (0 clean, 1 on violations)
 
-The registry-infrastructure module (common/metrics.py) is exempt from
-the literal-name rule: the RegistryBackedMetrics view derives gauge
-names from mapping keys by design (they still share the enforced
-``lighthouse_tpu_`` prefix).
-
-Run directly (exit 1 on violations) or via tests/test_metric_name_lint.py,
-which wires it into the tier-1 suite.
+Run directly (``python scripts/check_metric_names.py [root]``) or via
+tests/test_metric_name_lint.py, which wires it into tier-1.
 """
 
-import ast
-import re
 import sys
 from pathlib import Path
 
-REGISTRATION_METHODS = {
-    "counter",
-    "gauge",
-    "histogram",
-    "counter_vec",
-    "gauge_vec",
-    "histogram_vec",
-}
-NAME_RE = re.compile(r"^lighthouse_tpu_[a-z0-9_]+$")
-KIND_RE = re.compile(r"^[a-z0-9_]+$")
-# registry plumbing: name synthesis from mapping keys is the point
-EXEMPT_FILES = {"common/metrics.py"}
-EVENTS_MODULE = "common/events_journal.py"
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
-
-def _registry_call_name(node: ast.Call):
-    """'REGISTRY.<method>' call -> method name, else None."""
-    fn = node.func
-    if not isinstance(fn, ast.Attribute):
-        return None
-    if fn.attr not in REGISTRATION_METHODS:
-        return None
-    if isinstance(fn.value, ast.Name) and fn.value.id == "REGISTRY":
-        return fn.attr
-    return None
-
-
-def _journal_emit_kind(node: ast.Call):
-    """A journal `emit` call -> its kind arg node, else None. Matches
-    `<anything>.journal.emit(...)`, `JOURNAL.emit(...)`, and
-    `journal.emit(...)` — the journal's only spelling conventions."""
-    fn = node.func
-    if not (isinstance(fn, ast.Attribute) and fn.attr == "emit"):
-        return None
-    recv = fn.value
-    if isinstance(recv, ast.Attribute) and recv.attr == "journal":
-        pass
-    elif isinstance(recv, ast.Name) and recv.id in ("JOURNAL", "journal"):
-        pass
-    else:
-        return None
-    return node.args[0] if node.args else ast.Constant(value=None)
-
-
-def registered_event_kinds(package_root) -> set:
-    """Parse the closed KINDS vocabulary out of events_journal.py
-    (statically — the lint must not import the package)."""
-    path = Path(package_root) / EVENTS_MODULE
-    if not path.exists():  # linting a tree without the journal module
-        return set()
-    tree = ast.parse(path.read_text(), filename=str(path))
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        if not any(
-            isinstance(t, ast.Name) and t.id == "KINDS"
-            for t in node.targets
-        ):
-            continue
-        kinds = set()
-        for lit in ast.walk(node.value):
-            if isinstance(lit, ast.Constant) and isinstance(
-                lit.value, str
-            ):
-                kinds.add(lit.value)
-        return kinds
-    return set()
-
-
-def collect(package_root) -> tuple[dict, list]:
-    """Scan the package; returns (name -> [(file, line), ...], violations)."""
-    package_root = Path(package_root)
-    sites: dict[str, list] = {}
-    violations: list[str] = []
-    kinds = registered_event_kinds(package_root)
-    for kind in sorted(kinds):
-        if not KIND_RE.match(kind):
-            violations.append(
-                f"{EVENTS_MODULE}: registered kind {kind!r} does not "
-                "match [a-z0-9_]+"
-            )
-    for path in sorted(package_root.rglob("*.py")):
-        rel = path.relative_to(package_root).as_posix()
-        try:
-            tree = ast.parse(path.read_text(), filename=str(path))
-        except SyntaxError as e:
-            violations.append(f"{rel}: unparseable: {e}")
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            kind_arg = _journal_emit_kind(node)
-            if kind_arg is not None and rel != EVENTS_MODULE:
-                if not (
-                    isinstance(kind_arg, ast.Constant)
-                    and isinstance(kind_arg.value, str)
-                ):
-                    violations.append(
-                        f"{rel}:{node.lineno}: journal event kind must "
-                        "be a string literal"
-                    )
-                elif kind_arg.value not in kinds:
-                    violations.append(
-                        f"{rel}:{node.lineno}: journal event kind "
-                        f"{kind_arg.value!r} is not registered in "
-                        f"{EVENTS_MODULE} KINDS"
-                    )
-                continue
-            if _registry_call_name(node) is None:
-                continue
-            if rel in EXEMPT_FILES:
-                continue
-            if not node.args:
-                violations.append(
-                    f"{rel}:{node.lineno}: registry call without a name"
-                )
-                continue
-            first = node.args[0]
-            if not (
-                isinstance(first, ast.Constant)
-                and isinstance(first.value, str)
-            ):
-                violations.append(
-                    f"{rel}:{node.lineno}: metric name must be a string "
-                    "literal"
-                )
-                continue
-            name = first.value
-            if not NAME_RE.match(name):
-                violations.append(
-                    f"{rel}:{node.lineno}: {name!r} does not match "
-                    "lighthouse_tpu_[a-z0-9_]+"
-                )
-            sites.setdefault(name, []).append((rel, node.lineno))
-    for name, where in sorted(sites.items()):
-        if len(where) > 1:
-            locs = ", ".join(f"{f}:{ln}" for f, ln in where)
-            violations.append(
-                f"{name!r} registered at {len(where)} sites ({locs}); "
-                "register once and share the object"
-            )
-    return sites, violations
-
-
-def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    root = (
-        Path(argv[0])
-        if argv
-        else Path(__file__).resolve().parent.parent / "lighthouse_tpu"
-    )
-    sites, violations = collect(root)
-    if violations:
-        print(f"{len(violations)} metric-name violation(s):")
-        for v in violations:
-            print(f"  {v}")
-        return 1
-    print(f"{len(sites)} metric families OK under {root}")
-    return 0
-
+from lighthouse_tpu.analysis.passes.metric_names import (  # noqa: E402,F401
+    EVENTS_MODULE,
+    EXEMPT_FILES,
+    KIND_RE,
+    NAME_RE,
+    REGISTRATION_METHODS,
+    collect,
+    main,
+    registered_event_kinds,
+)
 
 if __name__ == "__main__":
     raise SystemExit(main())

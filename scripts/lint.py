@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Invariant-linter driver: every registered pass over the package.
+
+Usage:
+  python scripts/lint.py                 # text report, exit 1 on findings
+  python scripts/lint.py --jsonl         # one JSON object per finding
+  python scripts/lint.py --rule store-lock --rule except-swallow
+  python scripts/lint.py --list-rules
+  python scripts/lint.py --write-baseline   # grandfather current findings
+
+Semantics (the tier-1 gate in tests/test_lint.py runs the same code):
+
+  * exit 0 — no findings beyond the committed baseline AND no stale
+    baseline entries;
+  * exit 1 — NEW findings (fix them or '# lint: allow(rule): reason'
+    them), or STALE baseline entries (the finding was fixed — delete
+    its line from the baseline in the same PR). The baseline only
+    shrinks.
+
+The baseline lives at scripts/lint_baseline.jsonl (committed; shipped
+empty — every finding on day one was fixed or reason-annotated).
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from lighthouse_tpu.analysis import Baseline, run_passes  # noqa: E402
+from lighthouse_tpu.analysis.passes import all_passes  # noqa: E402
+
+DEFAULT_ROOT = REPO / "lighthouse_tpu"
+DEFAULT_BASELINE = REPO / "scripts" / "lint_baseline.jsonl"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=str(DEFAULT_ROOT))
+    ap.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        help="only report these rules (repeatable); disables the "
+        "stale-baseline check, which needs the full finding set",
+    )
+    ap.add_argument("--jsonl", action="store_true")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE))
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="grandfather every current finding into the baseline file",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    passes = all_passes()
+    if args.list_rules:
+        for p in passes:
+            for rule in getattr(p, "rules", (p.name,)):
+                print(f"{rule:22s} {p.description}")
+        return 0
+
+    findings, stats = run_passes(args.root, passes)
+    if args.rule:
+        wanted = set(args.rule)
+        findings = [f for f in findings if f.rule in wanted]
+
+    if args.write_baseline:
+        if args.rule:
+            # a filtered view would overwrite every OTHER rule's
+            # grandfathered entries — refuse
+            print("--write-baseline cannot be combined with --rule")
+            return 2
+        # lint-allow (malformed suppressions) and parse (broken files)
+        # are fix-only: grandfathering them would make the marker
+        # permanent while the underlying problem stays invisible
+        to_write = [
+            f for f in findings if f.rule not in ("lint-allow", "parse")
+        ]
+        skipped = len(findings) - len(to_write)
+        Baseline.write(args.baseline, to_write)
+        msg = f"wrote {len(to_write)} finding(s) to {args.baseline}"
+        if skipped:
+            msg += f" ({skipped} lint-allow/parse finding(s) NOT " \
+                "grandfathered — fix those)"
+        print(msg)
+        return 0
+
+    baseline = Baseline.load(args.baseline)
+    new, grandfathered, stale = baseline.apply(findings)
+    if args.rule:
+        stale = []  # partial view cannot judge staleness
+
+    if args.jsonl:
+        for f in new:
+            print(json.dumps(f.to_dict()))
+        for key in stale:
+            print(json.dumps({"rule": "stale-baseline", "key": key}))
+        return 1 if (new or stale) else 0
+
+    for f in new:
+        print(f.format())
+    for key in stale:
+        print(
+            f"stale baseline entry (finding fixed — delete its line): "
+            f"{key}"
+        )
+    status = (
+        f"{len(new)} finding(s), {len(grandfathered)} grandfathered, "
+        f"{len(stale)} stale baseline entr(ies) — "
+        f"{stats['files']} files, {len(passes)} passes, "
+        f"{stats['suppressed']} suppressed"
+    )
+    print(status)
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,706 @@
+"""Tier-1 wiring + fixture tests for the repo-wide invariant linter
+(lighthouse_tpu/analysis + scripts/lint.py).
+
+The load-bearing test is `test_package_lint_clean`: ALL passes over ALL
+of `lighthouse_tpu/` with the committed (empty) baseline — reintroducing
+any canary regression (a kv write outside the store lock, a time.time()
+inside a jitted ops function, an unsnapshotted shared-state iteration in
+an HTTP handler, a silent except swallow, a bad metric name) fails
+tier-1 here. `test_canary_regressions_fail` proves exactly that against
+a mutated copy of the real tree.
+"""
+
+import importlib.util
+import json
+import shutil
+import sys
+import time
+from pathlib import Path
+
+_ROOT = Path(__file__).resolve().parent.parent
+_PKG = _ROOT / "lighthouse_tpu"
+
+from lighthouse_tpu.analysis import Baseline, run_passes  # noqa: E402
+from lighthouse_tpu.analysis.passes import all_passes  # noqa: E402
+
+
+def _write_tree(tmp_path, files: dict) -> Path:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return tmp_path
+
+
+def _run(tmp_path, files: dict):
+    findings, _stats = run_passes(_write_tree(tmp_path, files), all_passes())
+    return findings
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------- the tier-1 gate
+
+
+def test_package_lint_clean():
+    """Every pass, the whole package, the committed baseline: clean —
+    and fast enough to sit in tier-1."""
+    t0 = time.perf_counter()
+    findings, stats = run_passes(_PKG, all_passes())
+    elapsed = time.perf_counter() - t0
+    baseline = Baseline.load(_ROOT / "scripts" / "lint_baseline.jsonl")
+    new, grandfathered, stale = baseline.apply(findings)
+    assert [f.format() for f in new] == []
+    assert stale == []
+    # the shipped baseline is EMPTY: every day-one finding was fixed or
+    # reason-annotated at the site — keep it that way
+    assert baseline.keys == set()
+    assert stats["files"] > 100
+    assert len(stats["passes"]) >= 5
+    assert elapsed < 20.0, f"lint took {elapsed:.1f}s — budget blown"
+
+
+def test_canary_regressions_fail(tmp_path):
+    """The three acceptance-criteria canaries, injected into a copy of
+    the REAL tree, each trip their pass."""
+    root = tmp_path / "pkg"
+    shutil.copytree(
+        _PKG, root, ignore=shutil.ignore_patterns("__pycache__")
+    )
+
+    def inject(rel, old, new):
+        p = root / rel
+        src = p.read_text()
+        assert src.count(old) == 1, f"canary anchor drifted in {rel}"
+        p.write_text(src.replace(old, new))
+
+    # 1. kv write outside the store lock
+    inject(
+        "store/hot_cold.py",
+        "    def put_block(self, root: bytes, signed_block) -> None:",
+        "    def put_block_unlocked(self, root, data):\n"
+        "        self.kv.put(COL_BLOCK, root, data)\n\n"
+        "    def put_block(self, root: bytes, signed_block) -> None:",
+    )
+    # 2. time.time() inside a jitted ops function
+    kzg = root / "ops" / "kzg_verify.py"
+    kzg.write_text(
+        kzg.read_text()
+        + "\n\nimport time as _t\nimport jax as _jax\n\n"
+        "def _canary_traced(x):\n"
+        "    return x * _t.time()\n\n"
+        "_CANARY = _jax.jit(_canary_traced)\n"
+    )
+    # 3. unsnapshotted shared-state iteration in an HTTP handler
+    inject(
+        "http_api/server.py",
+        'for pid in list(getattr(net, "peers", {}))',
+        'for pid in getattr(net, "peers", {})',
+    )
+
+    findings, _ = run_passes(root, all_passes())
+    rules = set(_rules(findings))
+    assert "store-lock" in rules
+    assert "device-purity" in rules
+    assert "handler-snapshot" in rules
+    # and each canary is attributed to the file it was injected into
+    by_rule = {f.rule: f.path for f in findings}
+    assert by_rule["store-lock"] == "store/hot_cold.py"
+    assert by_rule["device-purity"] == "ops/kzg_verify.py"
+    assert by_rule["handler-snapshot"] == "http_api/server.py"
+
+
+# ------------------------------------------------- device purity fixtures
+
+
+def test_device_purity_from_import_alias_cannot_dodge(tmp_path):
+    """`from time import time as now` / `from random import random` must
+    flag exactly like the dotted spellings (review finding)."""
+    findings = _run(
+        tmp_path,
+        {
+            "ops/bad.py": (
+                "from time import time as now\n"
+                "from random import random as rnd\n"
+                "import jax\n\n"
+                "def kernel(x):\n"
+                "    return x * now() + rnd()\n\n"
+                "F = jax.jit(kernel)\n"
+            )
+        },
+    )
+    assert _rules(findings) == ["device-purity", "device-purity"]
+    msgs = "\n".join(f.msg for f in findings)
+    assert "now" in msgs and "rnd" in msgs
+
+
+def test_device_purity_flags_clock_and_transitive_reach(tmp_path):
+    findings = _run(
+        tmp_path,
+        {
+            "ops/bad.py": (
+                "import time\n"
+                "import jax\n\n"
+                "def helper(x):\n"
+                "    return x * time.time()\n\n"
+                "def kernel(x):\n"
+                "    return helper(x)\n\n"
+                "F = jax.jit(kernel)\n"
+            )
+        },
+    )
+    assert _rules(findings) == ["device-purity"]
+    assert "time.time" in findings[0].msg
+    assert findings[0].line == 5
+
+
+def test_device_purity_flags_nondeterminism_env_and_sync(tmp_path):
+    findings = _run(
+        tmp_path,
+        {
+            "ops/bad.py": (
+                "import os\n"
+                "import random\n"
+                "import numpy as np\n"
+                "import jax\n\n"
+                "def kernel(x):\n"
+                "    r = random.random()\n"
+                "    mode = os.environ.get('KNOB')\n"
+                "    v = int(x)\n"
+                "    h = np.asarray(x)\n"
+                "    i = x.item()\n"
+                "    n = int(x.shape[0])\n"  # static: not flagged
+                "    return v + r\n\n"
+                "F = jax.jit(kernel)\n"
+            )
+        },
+    )
+    msgs = "\n".join(f.msg for f in findings)
+    assert len(findings) == 5, msgs
+    assert ".item()" in msgs
+    assert "nondeterminism" in msgs
+    assert "os.environ" in msgs
+    assert "int()" in msgs
+    assert "np.asarray" in msgs
+
+
+def test_device_purity_host_side_clean(tmp_path):
+    """The production dispatch idiom: host timing + bucketed jit cache
+    around a pure traced impl — no findings."""
+    findings = _run(
+        tmp_path,
+        {
+            "ops/good.py": (
+                "import time\n"
+                "import jax\n\n"
+                "_jitted = {}\n\n"
+                "def _impl(x):\n"
+                "    return x + 1\n\n"
+                "def dispatch(x):\n"
+                "    t0 = time.perf_counter()\n"
+                "    fn = _jitted.get('k')\n"
+                "    if fn is None:\n"
+                "        fn = _jitted['k'] = jax.jit(_impl)\n"
+                "    out = fn(x)\n"
+                "    return out, time.perf_counter() - t0\n"
+            )
+        },
+    )
+    assert findings == []
+
+
+def test_jit_cache_rules(tmp_path):
+    findings = _run(
+        tmp_path,
+        {
+            "ops/bad.py": (
+                "import jax\n\n"
+                "def f(x):\n"
+                "    return x\n\n"
+                "J = jax.jit(f)\n\n"  # module-level: fine
+                "def inline(x):\n"
+                "    return jax.jit(f)(x)\n\n"  # fresh cache per call
+                "def local_only(x):\n"
+                "    g = jax.jit(f)\n"  # uncached local
+                "    return g(x)\n\n"
+                "_G = None\n\n"
+                "def global_rebind(x):\n"
+                "    global _G\n"
+                "    if _G is None:\n"
+                "        _G = jax.jit(f)\n"  # cached global: fine
+                "    return _G(x)\n\n"
+                "_CACHE = {}\n\n"
+                "def dict_cached(x):\n"
+                "    _CACHE['k'] = jax.jit(f)\n"  # module dict: fine
+                "    return _CACHE['k'](x)\n\n"
+                "def local_dict(x):\n"
+                "    d = {}\n"
+                "    d['k'] = jax.jit(f)\n"  # per-call dict: hazard
+                "    return d['k'](x)\n"
+            )
+        },
+    )
+    jit = [f for f in findings if f.rule == "jit-cache"]
+    assert len(jit) == 3
+    assert any("inline" in f.msg for f in jit)
+
+
+def test_device_purity_out_of_scope_module_ignored(tmp_path):
+    findings = _run(
+        tmp_path,
+        {
+            "beacon_chain/hosty.py": (
+                "import time\nimport jax\n\n"
+                "def kernel(x):\n"
+                "    return x * time.time()\n\n"
+                "F = jax.jit(kernel)\n"
+            )
+        },
+    )
+    assert _rules(findings) == []
+
+
+# ------------------------------------------------ lock discipline fixtures
+
+
+_STORE_TMPL = (
+    "import threading\n\n"
+    "COL = b'c'\n\n\n"
+    "class HotColdDB:\n"
+    "    def __init__(self, kv):\n"
+    "        self.kv = kv\n"
+    "        self.lock = threading.RLock()\n\n"
+    "    def put_locked(self, k, v):\n"
+    "        with self.lock:\n"
+    "            self.kv.put(COL, k, v)\n\n"
+    "    def get(self, k):\n"
+    "        return self.kv.get(COL, k)\n"
+)
+
+
+def test_store_lock_clean_and_violation(tmp_path):
+    assert _run(tmp_path / "a", {"store/hot_cold.py": _STORE_TMPL}) == []
+    findings = _run(
+        tmp_path / "b",
+        {
+            "store/hot_cold.py": _STORE_TMPL
+            + (
+                "\n    def put_unlocked(self, k, v):\n"
+                "        self.kv.put(COL, k, v)\n"
+                "        self.kv.delete(COL, k)\n"
+            )
+        },
+    )
+    assert _rules(findings) == ["store-lock", "store-lock"]
+    assert "outside 'with self.lock'" in findings[0].msg
+
+
+def test_store_lock_requires_hotcolddb_lock(tmp_path):
+    findings = _run(
+        tmp_path,
+        {
+            "store/hot_cold.py": (
+                "class HotColdDB:\n"
+                "    def __init__(self, kv):\n"
+                "        self.kv = kv\n"
+            )
+        },
+    )
+    assert _rules(findings) == ["store-lock"]
+    assert "must own 'self.lock'" in findings[0].msg
+
+
+def test_guarded_attr_mutation_outside_lock(tmp_path):
+    src = (
+        "import threading\n\n\n"
+        "class Registry:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._metrics = {}\n\n"
+        "    def good(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._metrics[k] = v\n\n"
+        "    def bad(self, k, v):\n"
+        "        self._metrics[k] = v\n\n"
+        "    def bad_mutator(self, k):\n"
+        "        self._metrics.pop(k)\n"
+    )
+    findings = _run(tmp_path / "a", {"common/metrics.py": src})
+    assert _rules(findings) == ["guarded-attr", "guarded-attr"]
+    assert "Registry.bad" in findings[0].msg
+    # same class outside the guarded modules: out of scope
+    assert _run(tmp_path / "b", {"common/other.py": src}) == []
+
+
+# ----------------------------------------------- handler hygiene fixtures
+
+
+def test_handler_snapshot_fixtures(tmp_path):
+    findings = _run(
+        tmp_path,
+        {
+            "http_api/server.py": (
+                "class Api:\n"
+                "    def handle_get(self, path):\n"
+                "        a = [p for p in self.net.peers]\n"  # bad
+                "        for k in self.hub.peers.items():\n"  # bad
+                "            pass\n"
+                "        for p in getattr(self.net, 'peers', {}):\n"  # bad
+                "            pass\n"
+                "        b = [p for p in list(self.net.peers)]\n"
+                "        c = dict(self.hub.peers)\n"
+                "        for q in sorted(self.s.quarantined.copy()):\n"
+                "            pass\n"
+                "        for k, v in dict(self.hub.peers).items():\n"
+                "            pass\n"
+                "        return a, b, c\n"
+            )
+        },
+    )
+    snap = [f for f in findings if f.rule == "handler-snapshot"]
+    assert [f.line for f in snap] == [3, 4, 6]
+
+
+def test_handler_device_call_flagged(tmp_path):
+    findings = _run(
+        tmp_path,
+        {
+            "http_api/server.py": (
+                "from lighthouse_tpu.bls.tpu_backend import (\n"
+                "    verify_signature_sets_tpu,\n"
+                ")\n\n\n"
+                "class Api:\n"
+                "    def handle_post(self, body):\n"
+                "        return verify_signature_sets_tpu([])\n"
+            )
+        },
+    )
+    assert "handler-device-call" in _rules(findings)
+
+
+# --------------------------------------------- exception hygiene fixtures
+
+
+def test_exception_hygiene_fixtures(tmp_path):
+    findings = _run(
+        tmp_path,
+        {
+            "network/thing.py": (
+                "log = None\n"
+                "C = None\n\n\n"
+                "def silent():\n"
+                "    try:\n"
+                "        work()\n"
+                "    except Exception:\n"  # bad
+                "        pass\n\n\n"
+                "def bare():\n"
+                "    try:\n"
+                "        work()\n"
+                "    except:\n"  # bad, unconditionally
+                "        pass\n\n\n"
+                "def logged():\n"
+                "    try:\n"
+                "        work()\n"
+                "    except Exception as e:\n"
+                "        log.warning('failed: %s', e)\n\n\n"
+                "def counted():\n"
+                "    try:\n"
+                "        work()\n"
+                "    except Exception:\n"
+                "        C.labels('x').inc()\n\n\n"
+                "def reraises():\n"
+                "    try:\n"
+                "        work()\n"
+                "    except Exception:\n"
+                "        raise\n\n\n"
+                "def uses_binding():\n"
+                "    try:\n"
+                "        work()\n"
+                "    except Exception as e:\n"
+                "        return str(e)\n\n\n"
+                "def narrow():\n"
+                "    try:\n"
+                "        work()\n"
+                "    except ValueError:\n"  # narrow: out of scope
+                "        pass\n\n\n"
+                "def event_set_is_not_evidence(ev):\n"
+                "    try:\n"
+                "        work()\n"
+                "    except Exception:\n"  # bad: Event.set() != metric
+                "        ev.set()\n"
+            )
+        },
+    )
+    assert sorted(_rules(findings)) == [
+        "bare-except", "except-swallow", "except-swallow",
+    ]
+
+
+# --------------------------------------------- suppression + baseline
+
+
+_SWALLOW = (
+    "def f():\n"
+    "    try:\n"
+    "        g()\n"
+    "    except Exception:{comment}\n"
+    "        pass\n"
+)
+
+
+def test_suppression_round_trip(tmp_path):
+    # no allow: finding
+    f1 = _run(tmp_path / "a", {"m.py": _SWALLOW.format(comment="")})
+    assert _rules(f1) == ["except-swallow"]
+    # allow with reason: suppressed
+    f2 = _run(
+        tmp_path / "b",
+        {
+            "m.py": _SWALLOW.format(
+                comment="  # lint: allow(except-swallow): probe only"
+            )
+        },
+    )
+    assert f2 == []
+    # allow without a reason suppresses NOTHING: the original finding
+    # stays live (so it cannot be laundered into a baseline) and the
+    # malformed allow is surfaced alongside it
+    f3 = _run(
+        tmp_path / "c",
+        {"m.py": _SWALLOW.format(comment="  # lint: allow(except-swallow)")},
+    )
+    assert _rules(f3) == ["except-swallow", "lint-allow"]
+    # allow naming an unknown rule: surfaced
+    f4 = _run(
+        tmp_path / "d",
+        {
+            "m.py": _SWALLOW.format(comment="")
+            + "\nX = 1  # lint: allow(not-a-rule): whatever\n"
+        },
+    )
+    assert sorted(_rules(f4)) == ["except-swallow", "lint-allow"]
+    # allow on the line ABOVE the flagged line also suppresses
+    f5 = _run(
+        tmp_path / "e",
+        {
+            "m.py": (
+                "def f():\n"
+                "    try:\n"
+                "        g()\n"
+                "    # lint: allow(except-swallow): probe only\n"
+                "    except Exception:\n"
+                "        pass\n"
+            )
+        },
+    )
+    assert f5 == []
+    # the allow spelling inside a STRING LITERAL is not a comment and
+    # must not suppress anything (review finding: comments come from
+    # the tokenizer, not substring search)
+    f6 = _run(
+        tmp_path / "f",
+        {
+            "m.py": (
+                "def f():\n"
+                "    try:\n"
+                "        g(\"# lint: allow(except-swallow): nope\")\n"
+                "    except Exception:\n"
+                "        pass\n"
+            )
+        },
+    )
+    assert _rules(f6) == ["except-swallow"]
+
+
+def test_baseline_round_trip(tmp_path):
+    tree = {"m.py": _SWALLOW.format(comment="")}
+    root = _write_tree(tmp_path / "pkg", tree)
+    findings, _ = run_passes(root, all_passes())
+    assert len(findings) == 1
+
+    bl_path = tmp_path / "baseline.jsonl"
+    Baseline.write(bl_path, findings)
+    bl = Baseline.load(bl_path)
+
+    # grandfathered: not new, not stale
+    new, old, stale = bl.apply(findings)
+    assert new == [] and len(old) == 1 and stale == []
+
+    # finding fixed -> baseline entry goes stale (must be deleted)
+    (root / "m.py").write_text("def f():\n    g()\n")
+    fixed, _ = run_passes(root, all_passes())
+    new, old, stale = bl.apply(fixed)
+    assert new == [] and old == [] and len(stale) == 1
+
+    # a NEW finding is never absorbed by someone else's baseline entry
+    (root / "n.py").write_text(_SWALLOW.format(comment=""))
+    findings2, _ = run_passes(root, all_passes())
+    new, _old, _stale = bl.apply(findings2)
+    assert [f.path for f in new] == ["n.py"]
+
+    # line moves do NOT churn the baseline (keys are line-free)
+    (root / "m.py").write_text(
+        "# shifted\n\n" + _SWALLOW.format(comment="")
+    )
+    findings3, _ = run_passes(root, all_passes())
+    new, old, stale = bl.apply(findings3)
+    assert ([f.path for f in new], len(old)) == (["n.py"], 1)
+
+    # a SECOND identical finding in the same file is NEW — one
+    # baseline line absorbs exactly one live finding (review finding)
+    (root / "n.py").unlink()
+    (root / "m.py").write_text(
+        _SWALLOW.format(comment="")
+        + "\n\n"
+        + _SWALLOW.format(comment="").replace("def f", "def f2")
+    )
+    findings4, _ = run_passes(root, all_passes())
+    assert len(findings4) == 2
+    new, old, stale = bl.apply(findings4)
+    assert (len(new), len(old), stale) == (1, 1, [])
+
+
+# ------------------------------------------------------- driver CLI
+
+
+def _load_driver():
+    spec = importlib.util.spec_from_file_location(
+        "lint_driver", _ROOT / "scripts" / "lint.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_driver_exit_codes_and_jsonl(tmp_path, capsys):
+    driver = _load_driver()
+    root = _write_tree(
+        tmp_path / "pkg", {"m.py": _SWALLOW.format(comment="")}
+    )
+    bl = tmp_path / "bl.jsonl"
+
+    # findings, no baseline -> exit 1, jsonl parses
+    rc = driver.main(
+        ["--root", str(root), "--baseline", str(bl), "--jsonl"]
+    )
+    lines = [
+        json.loads(x)
+        for x in capsys.readouterr().out.strip().splitlines()
+    ]
+    assert rc == 1
+    assert lines[0]["rule"] == "except-swallow"
+    assert lines[0]["path"] == "m.py"
+
+    # write-baseline grandfathers them -> exit 0
+    assert (
+        driver.main(
+            ["--root", str(root), "--baseline", str(bl),
+             "--write-baseline"]
+        )
+        == 0
+    )
+    capsys.readouterr()
+    assert driver.main(["--root", str(root), "--baseline", str(bl)]) == 0
+
+    # fixing the finding makes the entry stale -> exit 1 again
+    (root / "m.py").write_text("def f():\n    g()\n")
+    capsys.readouterr()
+    rc = driver.main(["--root", str(root), "--baseline", str(bl)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "stale" in out
+
+    # the real package against the real baseline: exit 0 (same gate as
+    # test_package_lint_clean, through the CLI surface)
+    capsys.readouterr()
+    assert driver.main([]) == 0
+
+
+def test_driver_rule_filter_and_list(tmp_path, capsys):
+    driver = _load_driver()
+    root = _write_tree(
+        tmp_path / "pkg",
+        {
+            "m.py": _SWALLOW.format(comment=""),
+            "store/hot_cold.py": "class HotColdDB:\n    pass\n",
+        },
+    )
+    bl = tmp_path / "bl.jsonl"
+    rc = driver.main(
+        ["--root", str(root), "--baseline", str(bl), "--jsonl",
+         "--rule", "store-lock"]
+    )
+    lines = [
+        json.loads(x)
+        for x in capsys.readouterr().out.strip().splitlines()
+    ]
+    assert rc == 1
+    assert {d["rule"] for d in lines} == {"store-lock"}
+
+    # --write-baseline with a filtered view would clobber other
+    # rules' grandfathered entries: refused
+    assert (
+        driver.main(
+            ["--root", str(root), "--baseline", str(bl),
+             "--rule", "store-lock", "--write-baseline"]
+        )
+        == 2
+    )
+    capsys.readouterr()
+
+    # a reason-less allow cannot be laundered through --write-baseline:
+    # the original finding stays live and is itself baselined, but the
+    # lint-allow marker is refused, so fixing the allow is forced
+    (root / "m.py").write_text(
+        _SWALLOW.format(comment="  # lint: allow(except-swallow)")
+    )
+    assert (
+        driver.main(
+            ["--root", str(root), "--baseline", str(bl),
+             "--write-baseline"]
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    assert "NOT grandfathered" in out
+    rc = driver.main(["--root", str(root), "--baseline", str(bl)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "lint-allow" in out
+
+    assert driver.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in (
+        "device-purity", "jit-cache", "store-lock", "guarded-attr",
+        "handler-snapshot", "handler-device-call", "except-swallow",
+        "bare-except", "metric-name", "journal-kind",
+    ):
+        assert rule in out
+
+
+# ------------------------------------------- metric pass in the framework
+
+
+def test_metric_pass_runs_in_framework(tmp_path):
+    findings = _run(
+        tmp_path,
+        {
+            "a.py": (
+                "from lighthouse_tpu.common.metrics import REGISTRY\n"
+                "REGISTRY.counter('BadName')\n"
+                "J = None\n"
+                "JOURNAL = J\n"
+                "JOURNAL.emit('unregistered_kind')\n"
+            ),
+            "common/events_journal.py": (
+                "KINDS = frozenset({'good_kind'})\n"
+            ),
+        },
+    )
+    rules = sorted(_rules(findings))
+    assert rules == ["journal-kind", "metric-name"]
+
+
+def test_unparseable_file_is_a_finding(tmp_path):
+    findings = _run(tmp_path, {"broken.py": "def f(:\n"})
+    assert _rules(findings) == ["parse"]
