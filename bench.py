@@ -23,12 +23,17 @@ real TPU measurement (live or replayed); the CPU-fallback path-proof number
 is explicitly false.
 
 Env knobs:
-  BENCH_IMPL=xla|txla|mxu|pallas|ptail|predc|predcbf|pw2   kernel path (default xla)
+  BENCH_IMPL=xla|txla|mxu|pallas|ptail|predc   kernel path (default xla)
+  BENCH_IMPL=chain|vredc|mulsqr   legacy-form A/B partners of the
+      defaults (double-add ladders / VPU REDC / generic-mul squaring);
+      pw2 and predcbf are RETIRED labels (now the defaults) and exit(4)
   BENCH_NSETS=N             batch size override
   BENCH_REQUIRE_TPU=1       exit(3) instead of any CPU fallback/replay
   BENCH_SMOKE=1             small batch
   BENCH_CONFIG=oppool32k|sync512|block|replay32   BASELINE configs #4/#2/#3/#5
   BENCH_CONFIG=kzg|kzgfold  KZG producer MSM / verify fold-factor configs
+  BENCH_CONFIG=ladder       unified window-kernel vs legacy-ladder A/B
+                            at 64-bit and 255-bit scalar widths
 """
 
 import json
@@ -140,6 +145,7 @@ def _active_metric():
         "grouped64": "grouped_verify_throughput",
         "kzg": "kzg_commit_msm_throughput",
         "kzgfold": "kzg_batch_fold_factor",
+        "ladder": "ladder_unified_speedup",
     }.get(cfg, "verify_signature_sets_throughput")
 
 
@@ -199,6 +205,14 @@ def main():
     on any failure the CPU fallback runs in-process so the driver always
     gets exactly one JSON line on stdout."""
     import subprocess
+
+    from lighthouse_tpu.bench_impl import validate_impl
+
+    # Validate the impl label BEFORE the replay short-circuit: a
+    # retired or unknown BENCH_IMPL must exit 4 here, not be answered
+    # with a replayed recorded measurement (the config-level
+    # apply_impl_env calls only run once a measurement is attempted).
+    validate_impl(os.environ.get("BENCH_IMPL", "xla"))
 
     if os.environ.get("BENCH_INNER") == "1":
         jax, platform = _ensure_backend()
@@ -276,6 +290,8 @@ def _measure(jax, platform):
         return _measure_kzg_msm(jax, platform)
     if config == "kzgfold":
         return _measure_kzg_fold(jax, platform)
+    if config == "ladder":
+        return _measure_ladder(jax, platform)
     return _measure_sigsets(jax, platform)
 
 
@@ -293,14 +309,17 @@ def _resolve_impl_fn(jax, platform, grouped: bool = False):
 
     impl = os.environ.get("BENCH_IMPL", "xla")
     apply_impl_env(impl)
-    if grouped and impl in ("txla", "ptail"):
+    if grouped and impl == "txla":
         print(
-            f"bench: grouped64 has no {impl} program; use "
-            "xla|mxu|pallas|pw2|predc|predcbf",
+            "bench: grouped64 has no txla program; use "
+            "xla|mxu|pallas|ptail|predc|chain|vredc|mulsqr",
             file=sys.stderr,
         )
         sys.exit(4)
-    if impl in ("pallas", "ptail", "predc", "predcbf", "pw2"):
+    if impl in ("pallas", "ptail", "predc", "chain", "vredc", "mulsqr"):
+        # the legacy-form A/B labels (chain/vredc/mulsqr) measure the
+        # default program family — pallas on hardware — with ONE form
+        # flipped back by the env knob apply_impl_env just set
         fn = jax.jit(
             functools.partial(
                 batch_verify.verify_signature_sets_grouped_pallas
@@ -310,7 +329,7 @@ def _resolve_impl_fn(jax, platform, grouped: bool = False):
                 # the kernel body in interpret mode so the JSON line
                 # still lands
                 interpret=(platform == "cpu"),
-                **({} if grouped else {"tail": impl == "ptail"}),
+                tail=impl == "ptail",
             )
         )
     elif impl == "txla":
@@ -591,6 +610,83 @@ def _measure_kzg_fold(jax, platform):
         "singles_p50_s": round(singles_p50, 4),
         "compile_s": round(compile_s, 1),
         "valid_for_headline": bool(on_tpu and n >= 8),
+    }
+
+
+def _measure_ladder(jax, platform):
+    """Unified windowed-ladder vs legacy double-add chain A/B at the
+    two production scalar widths: 64-bit (the RLC width, at the
+    grouped64-shaped lane count — on the grouped shape the ladders ARE
+    the cost floor) and 255-bit (the KZG lane width, at the flat-4096
+    shape). Reports the throughput ratio unified/legacy per width;
+    `value` is the MIN of the two (>= 1.0 = the unified kernel
+    dominates at both widths). Point equality of the two kernels is
+    asserted at warm-up on every run."""
+    import functools  # noqa: F401  (parity with the other configs)
+    import random as _random
+
+    import numpy as np
+
+    from lighthouse_tpu.ops import curve
+    from lighthouse_tpu.ops import window_ladder as wl
+
+    if platform == "cpu":
+        # CPU-XLA A/B path-proof shapes (the in-PR evidence while the
+        # tunnel is down); hardware sweeps use the full lane counts.
+        # 256 lanes is the smallest width where per-op dispatch
+        # overhead stops swamping the op-count cut (at 64 lanes the
+        # two kernels measure ~equal on XLA:CPU; 2026-08-04 diag)
+        shapes = ((64, 256, "grouped64"), (255, 256, "flat4096"))
+        reps = 3
+    else:
+        n64 = int(os.environ.get("BENCH_NSETS") or 30720)
+        shapes = ((64, n64, "grouped64"), (255, 4096, "flat4096"))
+        reps = 5
+
+    rnd = _random.Random(11)
+    eq_fn = jax.jit(curve.PG1.eq)
+    fields = {}
+    ratios = []
+    for width, lanes, shape_name in shapes:
+        scalars = [rnd.getrandbits(width) for _ in range(lanes)]
+        bits = jax.device_put(
+            jax.numpy.asarray(curve.scalars_to_bits(scalars, width))
+        )
+        pt = curve.PG1.generator_like((lanes,))
+        fn_w = wl.jitted_ladder("G1", impl="window")
+        fn_c = wl.jitted_ladder("G1", impl="chain")
+        out_w = jax.block_until_ready(fn_w(pt, bits))
+        out_c = jax.block_until_ready(fn_c(pt, bits))
+        assert bool(np.asarray(eq_fn(out_w, out_c)).all()), (
+            f"ladder: unified kernel disagrees with the chain at "
+            f"{width}-bit"
+        )
+        p50 = {}
+        for label, fn in (("window", fn_w), ("chain", fn_c)):
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(pt, bits))
+                times.append(time.perf_counter() - t0)
+            p50[label] = sorted(times)[len(times) // 2]
+        ratio = p50["chain"] / p50["window"]
+        ratios.append(ratio)
+        fields[f"ratio_w{width}"] = round(ratio, 3)
+        fields[f"p50_window_w{width}_s"] = round(p50["window"], 4)
+        fields[f"p50_chain_w{width}_s"] = round(p50["chain"], 4)
+        fields[f"lanes_w{width}"] = lanes
+
+    on_tpu = platform in ("tpu", "axon")
+    return {
+        "metric": "ladder_unified_speedup",
+        "value": round(min(ratios), 3),
+        "unit": "x",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "impl": "window_vs_chain",
+        "n_sets": shapes[0][1],
+        **fields,
+        "valid_for_headline": bool(on_tpu and shapes[0][1] >= 30720),
     }
 
 

@@ -1,29 +1,74 @@
 """BENCH_IMPL validation + env side effects, shared by every benchmark
 config (bench.py configs and lighthouse_tpu.bench_replay) so an impl
-added or renamed in one place cannot be silently mislabeled in another."""
+added or renamed in one place cannot be silently mislabeled in another.
+
+Since the unified windowed-ladder PR the DEFAULT device path is:
+signed-digit window ladders (ops/window_ladder), the FP12_SQR squaring
+program, and bf16 MXU-REDC on real TPU. The labels that used to select
+those forms as experiments (pw2, predcbf) are RETIRED and exit(4); the
+LEGACY forms they replaced are the A/B partners now:
+
+  chain   — legacy per-bit double-add ladders (LIGHTHOUSE_TPU_LADDER)
+  vredc   — legacy unrolled-VPU Montgomery REDC (LIGHTHOUSE_TPU_MXU_REDC=0)
+  mulsqr  — legacy generic-multiply Fp12 squaring (LIGHTHOUSE_TPU_FP12_SQR)
+"""
 
 import os
 import sys
 
 KNOWN_IMPLS = (
-    "xla", "mxu", "pallas", "ptail", "txla", "predc", "predcbf", "pw2",
+    "xla", "mxu", "pallas", "ptail", "txla", "predc",
+    "chain", "vredc", "mulsqr",
 )
 
+# retired labels -> the message explaining the A/B partner that
+# replaced them (the old experimental form IS the default now)
+RETIRED_IMPLS = {
+    "pw2": (
+        "the signed-digit window ladder is the DEFAULT now; A/B the"
+        " legacy double-add chain with BENCH_IMPL=chain"
+    ),
+    "predcbf": (
+        "bf16 MXU-REDC is the DEFAULT device form on TPU now; A/B the"
+        " legacy VPU chain with BENCH_IMPL=vredc"
+    ),
+}
 
-def apply_impl_env(impl: str, what: str = "bench") -> None:
-    """Validate `impl` and apply its process-env side effects. Exits 4
-    on an unknown impl — a typo must not measure the default path under
-    its label."""
+
+def validate_impl(impl: str, what: str = "bench") -> None:
+    """Exit 4 on an unknown OR retired impl — a typo must not measure
+    the default path under its label, and a retired label must not
+    silently measure the new default under the old experimental name.
+    Called by `apply_impl_env` and ALSO by bench.py's outer watchdog
+    stage, whose replay short-circuit would otherwise answer a retired
+    label with a recorded measurement before any config validated it."""
+    if impl in RETIRED_IMPLS:
+        print(
+            f"{what}: BENCH_IMPL={impl} is retired — {RETIRED_IMPLS[impl]}",
+            file=sys.stderr,
+        )
+        sys.exit(4)
     if impl not in KNOWN_IMPLS:
         print(f"{what}: unknown BENCH_IMPL {impl!r}", file=sys.stderr)
         sys.exit(4)
+
+
+def apply_impl_env(impl: str, what: str = "bench") -> None:
+    """Validate `impl` and apply its process-env side effects (exits 4
+    on unknown/retired — see `validate_impl`)."""
+    validate_impl(impl, what)
     if impl == "mxu":
         os.environ["LIGHTHOUSE_TPU_MXU_CONV"] = "1"
     if impl == "predc":
-        # pallas kernels with the static REDC convolutions on the MXU
+        # pallas kernels with the static REDC convolutions as int8 MXU
+        # matmuls (the non-default operand form; bf16 is the default)
         os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "i8"
-    if impl == "predcbf":
-        os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "bf16"
-    if impl == "pw2":
-        # pallas kernels with the windowed-2 RLC ladder
-        os.environ["LIGHTHOUSE_TPU_LADDER"] = "w2"
+    if impl == "ptail":
+        # pallas kernels + the fused in-kernel final-exponentiation tail
+        os.environ["LIGHTHOUSE_TPU_TAIL"] = "1"
+    if impl == "chain":
+        os.environ["LIGHTHOUSE_TPU_LADDER"] = "chain"
+    if impl == "vredc":
+        os.environ["LIGHTHOUSE_TPU_MXU_REDC"] = "0"
+    if impl == "mulsqr":
+        os.environ["LIGHTHOUSE_TPU_FP12_SQR"] = "mul"

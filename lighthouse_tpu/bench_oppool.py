@@ -113,10 +113,13 @@ def measure(jax, platform) -> dict:
         from lighthouse_tpu.bench_impl import apply_impl_env
 
         apply_impl_env(impl, what="oppool32k")
-        if impl in ("txla", "ptail"):
+        # ptail is dispatchable now (the fused tail rides the backend's
+        # unified dispatch via LIGHTHOUSE_TPU_TAIL); only the
+        # bench-only transposed program stays out of reach
+        if impl == "txla":
             print(
                 f"oppool32k: BENCH_IMPL={impl} has no backend dispatch;"
-                " use xla|mxu|pallas|predc|predcbf",
+                " use xla|mxu|pallas|ptail|predc|chain|vredc|mulsqr",
                 file=sys.stderr,
             )
             sys.exit(4)

@@ -46,16 +46,16 @@ def measure(jax, platform):
 
         apply_impl_env(impl, what="replay32")
         # The harness verifies through the bls backend dispatch, which
-        # only knows the xla|pallas program pair (+ the MXU env knobs
-        # apply_impl_env just set). txla (bench-only transposed layout)
-        # and ptail (in-kernel final exp) exist only as standalone bench
-        # programs — accepting them here would measure the plain
-        # xla/pallas path under their label, the exact mislabeling the
-        # exit-4 rule exists to prevent.
-        if impl in ("txla", "ptail"):
+        # knows the xla|pallas program pair plus every form knob
+        # apply_impl_env just set (ladder/REDC/squaring/tail — all part
+        # of _impl_key now, so ptail IS dispatchable). txla (bench-only
+        # transposed layout) exists only as a standalone bench program —
+        # accepting it would measure the plain path under its label,
+        # the exact mislabeling the exit-4 rule exists to prevent.
+        if impl == "txla":
             print(
                 f"replay32: BENCH_IMPL={impl} has no backend dispatch;"
-                " use xla|mxu|pallas|predc|predcbf",
+                " use xla|mxu|pallas|ptail|predc|chain|vredc|mulsqr",
                 file=sys.stderr,
             )
             sys.exit(4)

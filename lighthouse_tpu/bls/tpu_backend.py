@@ -136,13 +136,16 @@ def _use_pallas() -> bool:
 
 
 def _impl_key():
-    """(use_pallas, MXU_REDC form, MXU_CONV on, windowed ladder) —
-    everything read at trace time that changes the compiled program,
-    NORMALIZED the way the kernels consume it (tfield.use_mxu_redc maps
-    "1"/"i8" to one form; fieldb only tests MXU_CONV == "1") so
+    """(use_pallas, MXU_REDC form, MXU_CONV on, ladder kind, FP12
+    squaring form, fused tail) — everything read at trace time that
+    changes the compiled program, NORMALIZED the way the kernels
+    consume it (tfield.use_mxu_redc maps "1"/"i8" to one form and
+    resolves the on-TPU default; window_ladder.ladder_impl resolves
+    the default window kernel; fieldb only tests MXU_CONV == "1") so
     equivalent spellings share one trace instead of recompiling."""
-    from lighthouse_tpu.ops import tfield
-    from lighthouse_tpu.ops.pallas_ladder import use_windowed_ladder
+    from lighthouse_tpu.ops import tfield, tower
+    from lighthouse_tpu.ops.pallas_tail import use_fused_tail
+    from lighthouse_tpu.ops.window_ladder import ladder_impl
 
     import os
 
@@ -150,13 +153,22 @@ def _impl_key():
         _use_pallas(),
         tfield.use_mxu_redc(),
         os.environ.get("LIGHTHOUSE_TPU_MXU_CONV") == "1",
-        use_windowed_ladder(),
+        ladder_impl(),
+        tower.use_fp12_sqr(),
+        use_fused_tail(),
     )
 
 
 def _verify_impl(use_pallas: bool):
     if use_pallas:
-        return batch_verify.verify_signature_sets_pallas
+        import functools
+
+        from lighthouse_tpu.ops.pallas_tail import use_fused_tail
+
+        return functools.partial(
+            batch_verify.verify_signature_sets_pallas,
+            tail=use_fused_tail(),
+        )
     return batch_verify.verify_signature_sets
 
 
@@ -189,7 +201,14 @@ def _indexed_verify(
 
 def _grouped_impl(use_pallas: bool):
     if use_pallas:
-        return batch_verify.verify_signature_sets_grouped_pallas
+        import functools
+
+        from lighthouse_tpu.ops.pallas_tail import use_fused_tail
+
+        return functools.partial(
+            batch_verify.verify_signature_sets_grouped_pallas,
+            tail=use_fused_tail(),
+        )
     return batch_verify.verify_signature_sets_grouped
 
 

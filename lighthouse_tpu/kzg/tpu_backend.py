@@ -4,7 +4,9 @@ Two device workloads share this boundary:
 
 * `verify_blob_kzg_proof_batch` (consumer side): challenge hashing,
   polynomial evaluation, decompression + subgroup checks, RLC sampling
-  on the host; the 3N RLC scalar ladders, the two pair folds, and the
+  on the host; the 3N lane scalar multiples (ONE dispatch into the
+  shared signed-digit window kernel, ops/window_ladder — the same
+  plane the signature RLC ladders use), the two pair folds, and the
   two-pair Miller loop + final exponentiation on device
   (ops/kzg_verify).
 
@@ -43,18 +45,38 @@ _MSM_DEVICE_BATCHES = REGISTRY.counter_vec(
 
 MIN_BUCKET = 2
 
-_JIT = None
+# jit objects keyed by everything the device graph reads at trace time
+# (ladder kernel kind, MXU-REDC form, MXU_CONV, FP12 squaring form) —
+# same convention as the bls jit caches: flipping a knob mid-process
+# retraces, never silently reuses; lane buckets retrace INSIDE the
+# cached jit object.
+_JITTED: dict = {}
+
+
+def _impl_key():
+    import os
+
+    from lighthouse_tpu.ops import tfield, tower
+    from lighthouse_tpu.ops.window_ladder import ladder_impl
+
+    return (
+        ladder_impl(),
+        tfield.use_mxu_redc(),
+        os.environ.get("LIGHTHOUSE_TPU_MXU_CONV") == "1",
+        tower.use_fp12_sqr(),
+    )
 
 
 def _get_fn():
-    global _JIT
-    if _JIT is None:
+    key = _impl_key()
+    fn = _JITTED.get(key)
+    if fn is None:
         import jax
 
         from lighthouse_tpu.ops.kzg_verify import verify_kzg_proof_batch
 
-        _JIT = jax.jit(verify_kzg_proof_batch)
-    return _JIT
+        fn = _JITTED[key] = jax.jit(verify_kzg_proof_batch)
+    return fn
 
 
 def _bucket(n: int) -> int:
@@ -140,8 +162,11 @@ _MSM_JIT: dict = {}
 
 def _get_msm_fn(kind: str, c: int):
     """Jitted MSM graph + affine conversion, one jit object per
-    (graph kind, window width); shape buckets retrace inside it."""
-    key = (kind, c)
+    (graph kind, window width, MXU_CONV form); shape buckets retrace
+    inside it."""
+    from lighthouse_tpu.ops import fieldb as _fb
+
+    key = (kind, c, _fb.use_mxu_conv())
     fn = _MSM_JIT.get(key)
     if fn is None:
         import jax

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.crypto.constants import G1_X, G1_Y, P, R
 from lighthouse_tpu.ops import curve, fieldb as fb, pairing
+from lighthouse_tpu.ops import window_ladder as wl
 
 NB = fb.NB
 
@@ -59,9 +60,10 @@ def aggregate_pubkeys(pubkeys_g1_aff, key_mask):
 
 def rlc_combined_signature(sigs_g2_aff, rand_bits, set_mask):
     """sum_i r_i * sig_i -> single projective G2 point. Masked-out lanes
-    enter as the identity and stay the identity through the ladder."""
+    enter as the identity and stay the identity through the ladder
+    (the shared window kernel — ops.window_ladder)."""
     sig_proj = curve.PG2.from_affine(sigs_g2_aff, set_mask)
-    sig_r = curve.PG2.mul_scalar_bits(sig_proj, rand_bits)
+    sig_r = wl.ladder(curve.PG2, sig_proj, rand_bits)
     return curve.PG2.sum_axis(sig_r, axis=0)
 
 
@@ -98,7 +100,7 @@ def miller_inputs(
     with span("trace/pubkey_aggregation"):
         agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)
     with span("trace/rlc_ladder_g1"):
-        agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
+        agg_pk_r = wl.ladder(curve.PG1, agg_pk, rand_bits)
     pk_aff = curve.PG1.to_affine(agg_pk_r)
 
     with span("trace/rlc_ladder_g2"):
@@ -164,7 +166,7 @@ def grouped_miller_inputs(
             curve.PG1.from_affine(pubkeys_g1_aff, key_mask), axis=2
         )
     with span("trace/rlc_ladder_g1"):
-        agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
+        agg_pk_r = wl.ladder(curve.PG1, agg_pk, rand_bits)
     # fold each group's RLC'd pubkeys into one point per message
     with span("trace/msm_group_fold"):
         grp_pk = curve.PG1.sum_axis(agg_pk_r, axis=1)  # (G,)
@@ -173,7 +175,7 @@ def grouped_miller_inputs(
     # signature side is unchanged by grouping: one global RLC sum
     with span("trace/rlc_ladder_g2"):
         sig_proj = curve.PG2.from_affine(sigs_g2_aff, set_mask)
-        sig_r = curve.PG2.mul_scalar_bits(sig_proj, rand_bits)
+        sig_r = wl.ladder(curve.PG2, sig_proj, rand_bits)
         sig_acc = curve.PG2.sum_axis(
             curve.PG2.sum_axis(sig_r, axis=1), axis=0
         )
@@ -212,11 +214,14 @@ def verify_signature_sets_grouped_pallas(
     group_mask,
     block_b: int = 128,
     interpret: bool = False,
+    tail: bool = False,
 ):
     """The grouped check with the RLC ladders and the (G+1)-pair Miller
     loop running as the same fused Pallas kernels the flat path uses —
     ladders over the flattened (G*Sg) lane axis, Miller over the G+1
-    merged pairs (via the shared _pairs_to_verdict_pallas tail)."""
+    merged pairs (via the shared _pairs_to_verdict_pallas tail; with
+    `tail=True` the fold + final exponentiation run in-kernel, same
+    knob as the flat path — part of the backend's unified dispatch)."""
     from lighthouse_tpu.ops import tcurve, tfield as tf
     from lighthouse_tpu.ops.pallas_ladder import ladder_pallas
 
@@ -264,7 +269,7 @@ def verify_signature_sets_grouped_pallas(
     )
     return _pairs_to_verdict_pallas(
         g1_side, g2_side, pair_mask, block_b=block_b,
-        interpret=interpret,
+        interpret=interpret, tail=tail,
     )
 
 
@@ -380,9 +385,10 @@ def verify_signature_sets_t(
     bits_t = jnp.transpose(rand_bits).astype(jnp.int32)  # (64, S)
 
     # G1: per-set aggregate (tree fold over K), transposed RLC ladder
+    # (the shared window kernel via the transposed dispatcher)
     agg_pk = aggregate_pubkeys(pubkeys_g1_aff, key_mask)
     agg_t = tuple(tf.from_batchlead(c) for c in agg_pk)
-    pk_r_t = tcurve.TPG1.mul_scalar_bits(agg_t, bits_t)
+    pk_r_t = wl.ladder_t(tcurve.TPG1, agg_t, bits_t)
     pk_r = tuple(tf.to_batchlead(c) for c in pk_r_t)
     pk_aff = curve.PG1.to_affine(pk_r)
 
@@ -391,7 +397,7 @@ def verify_signature_sets_t(
     # power-of-two count, so identity-pad its INPUT, not the ladder's.
     sx, sy = (tf.from_batchlead(c) for c in sigs_g2_aff)
     sig_t = tcurve.TPG2.from_affine((sx, sy), set_mask)
-    sig_r_t = tcurve.TPG2.mul_scalar_bits(sig_t, bits_t)
+    sig_r_t = wl.ladder_t(tcurve.TPG2, sig_t, bits_t)
     pad = _next_pow2(S) - S
     if pad:
         ident = tcurve.TPG2.identity(pad)

@@ -8,9 +8,12 @@ scalars r_i,
       * e( -sum_i r_i W_i,  [tau]G2 )  ==  1.
 
 The lane layout carries every scalar-multiplied point of the identity
-through ONE projective double-add ladder (complete RCB formulas — all
-inputs are host-subgroup-checked at decompression, so the r-torsion
-precondition holds):
+through ONE dispatch into the shared signed-digit window kernel
+(`ops.window_ladder` — the same plane the signature RLC ladders use;
+the legacy 3N independent 255-bit double-add ladders are retired, kept
+only behind LIGHTHOUSE_TPU_LADDER=chain for A/B). Complete RCB
+formulas — all inputs are host-subgroup-checked at decompression, so
+the r-torsion precondition holds:
 
     lanes [0,   N)   : C_i  with scalar r_i
     lanes [N,  2N)   : W_i  with scalar r_i * z_i mod r
@@ -32,6 +35,7 @@ import jax.numpy as jnp
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.crypto.constants import G2_X, G2_Y, P, R
 from lighthouse_tpu.ops import curve, fieldb as fb, pairing
+from lighthouse_tpu.ops import window_ladder as wl
 
 NB = fb.NB
 
@@ -71,7 +75,9 @@ def verify_kzg_proof_batch(
     n = L // 3
     pts = curve.PG1.from_affine(pts_g1_aff, lane_mask)
     with span("trace/kzg_rlc_ladder"):
-        pts_r = curve.PG1.mul_scalar_bits(pts, scalar_bits)
+        # the ONE shared window kernel (ops.window_ladder.ladder), not
+        # an independent per-lane double-add chain
+        pts_r = wl.ladder(curve.PG1, pts, scalar_bits)
 
     aux = curve.PG1.from_affine(aux_g1_aff, aux_mask)
     with span("trace/kzg_pair_fold"):

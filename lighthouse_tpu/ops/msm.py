@@ -30,9 +30,13 @@ code path):
   scale with.
 
 Scalar digit decomposition (`signed_digit_arrays`) happens on the host
-(numpy, exact bigint), mirroring how `ops.kzg_verify` receives
-host-built scalar bit matrices. Both graphs return ONE projective PG1
-point; callers convert via `curve.PG1.to_affine`.
+(numpy, exact bigint). The signed-digit machinery itself now lives in
+`ops.window_ladder` — the ONE windowed-ladder plane shared with the
+per-lane RLC ladders of `ops.batch_verify` and the KZG lane ladders of
+`ops.kzg_verify`; this module re-exports it specialized to the
+255-bit subgroup-order width so the MSM graphs and the ladders cannot
+drift. Both graphs return ONE projective PG1 point; callers convert
+via `curve.PG1.to_affine`.
 
 Host-side policy (which points, subgroup checks, setup caching) lives
 in `lighthouse_tpu.kzg`; the pure-bigint Pippenger oracle these graphs
@@ -46,57 +50,35 @@ import jax.numpy as jnp
 
 from lighthouse_tpu.crypto.constants import R
 from lighthouse_tpu.ops import curve, fieldb as fb
+from lighthouse_tpu.ops import window_ladder as wl
 
 NB = fb.NB
 
-WINDOW_BITS = 4  # default window width c; B = 2^(c-1) = 8 bucket magnitudes
+WINDOW_BITS = wl.WINDOW_BITS  # default window width c; B = 2^(c-1) magnitudes
 SCALAR_BITS = R.bit_length()  # 255
 
 
 def num_windows(c: int = WINDOW_BITS) -> int:
-    """Window count for signed base-2^c digits of scalars < r.
-
-    The top window holds SCALAR_BITS - c*(W0-1) bits plus an incoming
-    carry; an extra window is needed only when that can exceed the
-    signed bound 2^(c-1) (e.g. c=5: 51 windows of 5 bits leave a 5-bit
-    top digit whose carry overflows; c=4 leaves 3 bits and never does).
-    """
-    w0 = -(-SCALAR_BITS // c)
-    top_bits = SCALAR_BITS - c * (w0 - 1)
-    if (1 << top_bits) - 1 + 1 > (1 << (c - 1)):
-        return w0 + 1
-    return w0
+    """Window count for signed base-2^c digits of scalars < r — the
+    shared `window_ladder.num_windows` at the subgroup-order width."""
+    return wl.num_windows(SCALAR_BITS, c)
 
 
 def signed_digits(s: int, c: int = WINDOW_BITS) -> list:
     """One scalar -> W signed base-2^c digits, LSB-first, each in
-    [-(2^(c-1) - 1), 2^(c-1)]: sum_w d_w 2^(cw) == s mod r."""
-    s %= R
-    half = 1 << (c - 1)
-    full = 1 << c
-    out = []
-    carry = 0
-    for _ in range(num_windows(c)):
-        t = (s & (full - 1)) + carry
-        s >>= c
-        if t > half:
-            out.append(t - full)
-            carry = 1
-        else:
-            out.append(t)
-            carry = 0
-    assert carry == 0 and s == 0
-    return out
+    [-(2^(c-1) - 1), 2^(c-1)]: sum_w d_w 2^(cw) == s mod r. The shared
+    `window_ladder.signed_digits` at the subgroup-order width."""
+    return wl.signed_digits(s % R, c, SCALAR_BITS)
 
 
 def signed_digit_arrays(scalars, c: int = WINDOW_BITS):
     """Host: scalars -> (mags, negs): (W, N) int32 digit magnitudes in
     [0, 2^(c-1)] and (W, N) bool negation flags, window-major (the scan
-    axis of both device graphs)."""
-    digits = np.array(
-        [signed_digits(s, c) for s in scalars], dtype=np.int32
-    ).T  # (W, N)
-    return np.abs(digits), digits < 0
+    axis of both device graphs). Callers pass scalars already reduced
+    mod r (the tpu backends do)."""
+    return wl.signed_digit_arrays(
+        [s % R for s in scalars], c, SCALAR_BITS
+    )
 
 
 def _identity_point():

@@ -1,11 +1,22 @@
 """Pallas TPU kernel: per-lane RLC scalar ladders fused in VMEM.
 
 The G2 ladder (sum_i r_i * sig_i) is the second-hottest stage of batch
-verification after the Miller loop: 64 double-add iterations per lane,
-each a pair of RCB complete-formula point ops. This kernel keeps the
-accumulator, the doubling chain, and all intermediates in VMEM for the
-whole ladder; the XLA level then tree-folds the per-lane multiples.
-Works for G1 (w=1) and G2 (w=2) via ops.tcurve.
+verification after the Miller loop. This kernel keeps the accumulator,
+the multiple table, and all intermediates in VMEM for the whole ladder;
+the XLA level then tree-folds the per-lane multiples. Works for G1
+(w=1) and G2 (w=2) via ops.tcurve.
+
+Three kernel bodies, selected by `ops.window_ladder.ladder_impl()`
+(the one LIGHTHOUSE_TPU_LADDER knob shared with the XLA planes):
+
+  * "window" (DEFAULT) — the unified signed-digit window kernel: the
+    scalar bits are recoded to window-major signed digits at the XLA
+    level (`window_ladder.recode_bits`, one cheap int32 scan) and the
+    kernel runs W windows of c doublings + ONE complete add against a
+    VMEM multiple table (tcurve.window_table/window_step) — ~17 adds +
+    72 doublings for 64-bit scalars vs the chain's 64 + 64;
+  * "w2" — the earlier 2-bit unsigned window (kept for A/B);
+  * "chain" — the legacy per-bit double-add (A/B via BENCH_IMPL=chain).
 """
 
 import functools
@@ -18,6 +29,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from lighthouse_tpu.ops import tcurve, tfield as tf
+from lighthouse_tpu.ops import window_ladder as wl
 
 NB = tf.NB
 
@@ -86,19 +98,28 @@ def _ladder_kernel_w2(group, n_bits, x_ref, y_ref, z_ref, bits_ref,
         ox_ref[:], oy_ref[:], oz_ref[:] = acc
 
 
-def use_windowed_ladder() -> bool:
-    """LIGHTHOUSE_TPU_LADDER selects the kernel: "w2" = the windowed
-    2-bit ladder, ""/unset = the double-add chain. Read at trace time
-    (part of tpu_backend's jit-cache key)."""
-    import os
+def _ladder_kernel_w4(group, n_windows, c, x_ref, y_ref, z_ref, mags_ref,
+                      negs_ref, consts_ref, redc_ref, ox_ref, oy_ref,
+                      oz_ref):
+    """The unified signed-digit window kernel (MSB-first): per window
+    c doublings + ONE complete add against the in-VMEM multiple table
+    [0..2^(c-1)]·P, digit sign applied by negating y. Digits arrive
+    pre-recoded (window_ladder.recode_bits at the XLA level)."""
+    with tf.const_overrides(
+        **_overrides(consts_ref[:]), **tf.redc_overrides(redc_ref[:])
+    ):
+        pt = (x_ref[:], y_ref[:], z_ref[:])
+        B = pt[0].shape[-1]
+        table = group.window_table(pt, c)
 
-    # lint: allow(device-purity): trace-time knob, keyed via _impl_key
-    v = os.environ.get("LIGHTHOUSE_TPU_LADDER", "")
-    if v in ("", "0"):
-        return False
-    if v == "w2":
-        return True
-    raise ValueError(f"LIGHTHOUSE_TPU_LADDER={v!r}: use w2 or unset")
+        def body(j, acc):
+            w_i = n_windows - 1 - j  # MSB-first over LSB-first storage
+            return group.window_step(
+                acc, table, mags_ref[w_i], negs_ref[w_i] == 1, c
+            )
+
+        acc = jax.lax.fori_loop(0, n_windows, body, group.identity(B))
+        ox_ref[:], oy_ref[:], oz_ref[:] = acc
 
 
 def ladder_pallas(
@@ -107,27 +128,28 @@ def ladder_pallas(
     group_name: str = "G2",
     block_b: int = 128,
     interpret: bool = False,
-    windowed: bool | None = None,
+    kind: str | None = None,
 ):
     """Per-lane scalar ladder on PROJECTIVE inputs: pt = (X, Y, Z)
     bundles (w, NB, B) (identity lanes pass through as the identity),
     bits (n_bits, B) int32 LSB-first. Returns projective (X, Y, Z).
 
-    `windowed` None resolves LIGHTHOUSE_TPU_LADDER HERE, outside the
-    jit — the kernel choice must be part of the jit key, or flipping
-    the env var after a first trace would silently reuse the old
-    kernel."""
-    if windowed is None:
-        windowed = use_windowed_ladder()
+    `kind` None resolves LIGHTHOUSE_TPU_LADDER HERE
+    (window_ladder.ladder_impl — "window" default / "w2" / "chain"),
+    outside the jit — the kernel choice must be part of the jit key, or
+    flipping the env var after a first trace would silently reuse the
+    old kernel."""
+    if kind is None:
+        kind = wl.ladder_impl()
     return _ladder_pallas(
         pt, bits, group_name=group_name, block_b=block_b,
-        interpret=interpret, windowed=windowed,
+        interpret=interpret, kind=kind,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("group_name", "block_b", "interpret", "windowed"),
+    static_argnames=("group_name", "block_b", "interpret", "kind"),
 )
 def _ladder_pallas(
     pt,
@@ -135,14 +157,14 @@ def _ladder_pallas(
     group_name: str = "G2",
     block_b: int = 128,
     interpret: bool = False,
-    windowed: bool = False,
+    kind: str = "window",
 ):
     group = tcurve.TPG2 if group_name == "G2" else tcurve.TPG1
     w = group.w
     X, Y, Z = pt
     B = X.shape[-1]
     n_bits = bits.shape[0]
-    if windowed and n_bits % 2:
+    if kind == "w2" and n_bits % 2:
         bits = jnp.concatenate(
             [bits, jnp.zeros((1, B), bits.dtype)]
         )
@@ -156,9 +178,6 @@ def _ladder_pallas(
             memory_space=pltpu.VMEM,
         )
 
-    bits_spec = pl.BlockSpec(
-        (n_bits, block_b), lambda i: (0, i), memory_space=pltpu.VMEM
-    )
     const_spec = pl.BlockSpec(
         (4, NB, 1), lambda i: (0, 0, 0), memory_space=pltpu.VMEM
     )
@@ -167,7 +186,34 @@ def _ladder_pallas(
     )
 
     shape = jax.ShapeDtypeStruct((w, NB, B), jnp.int32)
-    kernel = _ladder_kernel_w2 if windowed else _ladder_kernel
+    if kind == "window":
+        c = wl.WINDOW_BITS
+        # recode at the XLA level (cheap int32 scan); the kernel reads
+        # window-major digit magnitudes + sign flags from VMEM
+        mags, negs = wl.recode_bits(jnp.moveaxis(bits, 0, -1), c)
+        n_windows = mags.shape[0]
+        dig_spec = pl.BlockSpec(
+            (n_windows, block_b), lambda i: (0, i),
+            memory_space=pltpu.VMEM,
+        )
+        ox, oy, oz = pl.pallas_call(
+            functools.partial(_ladder_kernel_w4, group, n_windows, c),
+            out_shape=(shape, shape, shape),
+            grid=grid,
+            in_specs=[spec(w), spec(w), spec(w), dig_spec, dig_spec,
+                      const_spec, redc_spec],
+            out_specs=(spec(w), spec(w), spec(w)),
+            interpret=interpret,
+        )(
+            X, Y, Z, mags, negs.astype(jnp.int32), _consts_array(),
+            tf.redc_mats_array(),
+        )
+        return ox, oy, oz
+
+    bits_spec = pl.BlockSpec(
+        (n_bits, block_b), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    kernel = _ladder_kernel_w2 if kind == "w2" else _ladder_kernel
     ox, oy, oz = pl.pallas_call(
         functools.partial(kernel, group, n_bits),
         out_shape=(shape, shape, shape),

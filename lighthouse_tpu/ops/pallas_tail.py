@@ -34,6 +34,27 @@ NB = tf.NB
 from lighthouse_tpu.ops.pallas_ladder import _consts_array, _overrides
 
 
+def use_fused_tail() -> bool:
+    """LIGHTHOUSE_TPU_TAIL=1 runs the product fold + final
+    exponentiation inside this fused VMEM kernel on the Pallas verify
+    path (BENCH_IMPL=ptail); ""/unset keeps them at the XLA level
+    (measured equal on v5e — PERF_NOTES: ptail ~= pallas, the final
+    exp is not the bottleneck — so the simpler XLA tail stays the
+    default and the kernel is one knob away). Read at trace time —
+    part of the backend jit cache key (_impl_key), so the tail choice
+    rides the same unified dispatch as the ladder/REDC/squaring
+    knobs."""
+    import os
+
+    # lint: allow(device-purity): trace-time knob, keyed via _impl_key
+    v = os.environ.get("LIGHTHOUSE_TPU_TAIL", "")
+    if v in ("", "0"):
+        return False
+    if v == "1":
+        return True
+    raise ValueError(f"LIGHTHOUSE_TPU_TAIL={v!r}: use 1, 0, or unset")
+
+
 def _kernel(
     pbits_ref, xbits_ref, f_ref, consts_ref, frob_ref, redc_ref, out_ref
 ):

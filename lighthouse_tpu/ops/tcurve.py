@@ -70,6 +70,13 @@ class TProjective:
             tf.select(valid, one, iz),
         )
 
+    def neg(self, pt):
+        return (
+            pt[0],
+            tf.apply_combo(pt[1], -np.eye(self.w, dtype=np.int32)),
+            pt[2],
+        )
+
     # ---------------------------------------------------------- group ops
 
     def add(self, p, q):
@@ -159,6 +166,37 @@ class TProjective:
 
         acc, _ = jax.lax.scan(step, self.identity(B), digits)
         return acc
+
+    # --------------------------------- signed-digit window ladder pieces
+    # (the transposed half of ops.window_ladder's unified plane — the
+    # recode and dispatch live there; these are the layout-local steps
+    # shared by the XLA-level ladder_t and the Pallas w4 kernel)
+
+    def window_table(self, pt, c: int):
+        """[identity, P, 2P, .., B·P] multiples (B = 2^(c-1)); even
+        entries by doubling, odd by one add — complete formulas make
+        the identity entry and identity input lanes exact."""
+        B = pt[0].shape[-1]
+        table = [self.identity(B), pt]
+        for d in range(2, (1 << (c - 1)) + 1):
+            table.append(
+                self.double(table[d // 2])
+                if d % 2 == 0
+                else self.add(table[-1], pt)
+            )
+        return tuple(table)
+
+    def window_step(self, acc, table, mag, neg, c: int):
+        """acc <- [2^c] acc + sign·table[mag] — one signed-digit
+        window: c doublings + ONE complete add + a select chain over
+        the B+1 static table entries. mag (B,) int32, neg (B,) bool."""
+        for _ in range(c):
+            acc = self.double(acc)
+        t = table[0]
+        for d in range(1, len(table)):
+            t = self.select(mag == d, table[d], t)
+        t = self.select(neg, self.neg(t), t)
+        return self.add(acc, t)
 
     def sum_lanes(self, pt, axis: int = -1):
         """Tree-fold the lane axis down to ONE point (1-lane bundles).

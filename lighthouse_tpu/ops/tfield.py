@@ -126,23 +126,41 @@ def reduce_small(x):
 # ------------------------------------------------------------- multiplies
 
 
+def _tpu_backend() -> bool:
+    """True when this process computes on real TPU hardware (the MXU
+    default only makes sense where there IS an MXU)."""
+    import jax
+
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    # lint: allow(except-swallow): no readable backend == not a TPU
+    except Exception:
+        return False
+
+
 def use_mxu_redc() -> str:
     """Route the two STATIC convolutions of Montgomery REDC (by N' and
     by p) through MXU matmuls. LIGHTHOUSE_TPU_MXU_REDC selects the
     operand form: "1"/"i8" = int8 x int8 -> int32; "bf16" = bfloat16
     operands with f32 accumulation (exact: 7-bit digits give column
     sums <= 2^19 << 2^24, and bf16 matmul is the most-trodden Mosaic
-    lowering). "" = off (the unrolled VPU chain). Unlike the failed
-    data-conv int8 path (fieldb._conv_contract, measured slower
-    2026-07-31), the MXU here consumes RAW limb digits against
+    lowering); "0" = forced off (the legacy unrolled VPU chain, A/B via
+    BENCH_IMPL=vredc). ""/unset resolves the DEFAULT device form: bf16
+    on real TPU hardware (the Toeplitz matmuls replace ~57 of ~90 VPU
+    FMA stages per Montgomery product), the VPU chain on the CPU mesh
+    (XLA:CPU runs the FMA chain faster and has no MXU to feed). Unlike
+    the failed data-conv int8 path (fieldb._conv_contract, measured
+    slower 2026-07-31), the MXU here consumes RAW limb digits against
     precomputed Toeplitz digit matrices — no VPU-computed products
-    feed it. Read at trace time — build fresh jitted functions after
-    flipping it."""
+    feed it. Read at trace time — part of the backend jit cache keys
+    (_impl_key); build fresh jitted functions after flipping it."""
     import os
 
     # lint: allow(device-purity): trace-time knob, keyed via _impl_key
     v = os.environ.get("LIGHTHOUSE_TPU_MXU_REDC", "")
-    if v in ("", "0"):
+    if v == "":
+        return "bf16" if _tpu_backend() else ""
+    if v == "0":
         return ""
     if v == "1":
         return "i8"

@@ -166,9 +166,33 @@ def fp12_mul(a, b):
     return fp2.bilinear(a, b, FP12_MUL)
 
 
+def use_fp12_sqr() -> bool:
+    """LIGHTHOUSE_TPU_FP12_SQR selects the Miller/final-exp squaring
+    program: ""/unset -> the dedicated 12-product complex-squaring
+    program (the DEFAULT device form, ~14% fewer Miller products);
+    "mul" -> the legacy generic 18-product multiply, kept ONLY for A/B
+    (BENCH_IMPL=mulsqr). Both forms are byte-identical on the committed
+    vectors (tests/test_pairing_device.py). Read at trace time — part
+    of the backend jit cache keys (_impl_key)."""
+    import os
+
+    # lint: allow(device-purity): trace-time knob, keyed via _impl_key
+    v = os.environ.get("LIGHTHOUSE_TPU_FP12_SQR", "")
+    if v in ("", "sqr"):
+        return True
+    if v == "mul":
+        return False
+    raise ValueError(
+        f"LIGHTHOUSE_TPU_FP12_SQR={v!r}: use mul, sqr, or unset"
+    )
+
+
 def fp12_sqr(a):
     # dedicated complex-squaring program: 12 products vs the mul's 18
-    return fp2.bilinear(a, a, FP12_SQR)
+    # (the legacy generic multiply stays reachable for A/B only)
+    if use_fp12_sqr():
+        return fp2.bilinear(a, a, FP12_SQR)
+    return fp2.bilinear(a, a, FP12_MUL)
 
 
 def fp12_conj(a):

@@ -40,7 +40,12 @@ def bilinear(x, y, prog):
 
 def fp12_sqr(f):
     # dedicated complex-squaring program: 12 products vs the mul's 18
-    return bilinear(f, f, FP12_SQR)
+    # (knob + default shared with the batch-leading plane: tower.py)
+    from lighthouse_tpu.ops.tower import use_fp12_sqr
+
+    if use_fp12_sqr():
+        return bilinear(f, f, FP12_SQR)
+    return bilinear(f, f, FP12_MUL)
 
 
 def fp12_mul(a, b):

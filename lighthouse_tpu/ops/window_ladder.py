@@ -1,0 +1,284 @@
+"""One signed-digit windowed-ladder plane for every per-lane scalar multiple.
+
+Before this module the repo carried THREE independent scalar-ladder
+forms: the per-bit double-add chain in `curve.ProjectiveGroup
+.mul_scalar_bits` (the RLC ladders of `ops.batch_verify` at 64 bits and
+the 3N independent 255-bit ladders of `ops.kzg_verify`), the transposed
+chain in `ops.tcurve`, and the signed-digit window machinery of
+`ops.msm` (which only served the MSM fold graphs). This module is the
+single plane they all dispatch through now:
+
+* **host digit decomposition** — `signed_digits` / `signed_digit_arrays`
+  generalized from `ops.msm` to ARBITRARY scalar widths (64-bit RLC
+  scalars, 255-bit KZG lane scalars), including the top-window carry
+  slot when the signed bound overflows;
+* **device recoding** — `recode_bits` turns the LSB-first bit matrices
+  every verify entry point already marshals into window-major signed
+  digits ON DEVICE (one cheap int32 scan), so no caller signature
+  changes and the sharded/Pallas input builders stay bit-matrix shaped;
+* **the window kernel** — `mul_scalar_bits_windowed` (batch-leading
+  `curve.PG1`/`PG2` plane) and `mul_scalar_bits_windowed_t` (transposed
+  `tcurve` plane): per window, c doublings + ONE complete add against a
+  per-lane multiple table [0..2^(c-1)]·P selected by digit magnitude
+  and conditionally negated. At c = 4 that is ~17 adds + 72 doublings
+  for a 64-bit scalar vs the chain's 64 + 64 (~1.7x fewer field
+  multiplies, and the same ~1.9x at 255 bits: 64 adds + 260 doublings
+  vs 255 + 255) — see PERF_NOTES "unified windowed-ladder plane";
+* **the dispatchers** — `ladder` / `ladder_t` route every caller
+  through one `LIGHTHOUSE_TPU_LADDER` knob ("" = the window kernel, the
+  default device path; "chain" = the legacy double-add, kept for A/B
+  via BENCH_IMPL=chain; "w2" = the Pallas 2-bit unsigned window). Every
+  future ladder win lands in the signature AND KZG planes at once.
+
+Completeness: the RCB complete formulas make the identity table entry
+and masked identity lanes exact, so there is no started-flag and no
+collision precondition — any scalar width works, matching the contract
+of `ProjectiveGroup.mul_scalar_bits`. The digit sign only negates the
+y-coordinate (a no-op on the identity representative (0 : -1 : 0)).
+
+`ops.msm` re-exports the host decomposition (its fixed 255-bit width is
+this module's machinery specialized to the subgroup order), so the MSM
+bucket graphs and the per-lane ladders cannot drift.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.ops import curve, fieldb as fb
+
+WINDOW_BITS = 4  # default window width c; digit magnitudes in [0, 2^(c-1)]
+
+
+def num_windows(nbits: int, c: int = WINDOW_BITS) -> int:
+    """Window count for signed base-2^c digits of scalars < 2^nbits.
+
+    The top window holds nbits - c*(W0-1) bits plus an incoming carry;
+    an extra window is needed only when that can exceed the signed
+    bound 2^(c-1) (e.g. nbits=64, c=4: 16 windows leave a 4-bit top
+    digit whose carry overflows -> 17; nbits=255, c=4 leaves 3 bits and
+    never does -> 64)."""
+    w0 = -(-nbits // c)
+    top_bits = nbits - c * (w0 - 1)
+    if (1 << top_bits) - 1 + 1 > (1 << (c - 1)):
+        return w0 + 1
+    return w0
+
+
+def signed_digits(s: int, c: int = WINDOW_BITS, nbits: int | None = None):
+    """One scalar in [0, 2^nbits) -> W signed base-2^c digits,
+    LSB-first, each in [-(2^(c-1) - 1), 2^(c-1)]:
+    sum_w d_w 2^(cw) == s exactly."""
+    if nbits is None:
+        nbits = max(1, s.bit_length())
+    assert 0 <= s < (1 << nbits), (s, nbits)
+    half = 1 << (c - 1)
+    full = 1 << c
+    out = []
+    carry = 0
+    for _ in range(num_windows(nbits, c)):
+        t = (s & (full - 1)) + carry
+        s >>= c
+        if t > half:
+            out.append(t - full)
+            carry = 1
+        else:
+            out.append(t)
+            carry = 0
+    assert carry == 0 and s == 0
+    return out
+
+
+def signed_digit_arrays(scalars, c: int = WINDOW_BITS, nbits: int = 255):
+    """Host: scalars -> (mags, negs): (W, N) int32 digit magnitudes in
+    [0, 2^(c-1)] and (W, N) bool negation flags, window-major (the scan
+    axis of the device graphs)."""
+    digits = np.array(
+        [signed_digits(s, c, nbits) for s in scalars], dtype=np.int32
+    ).T  # (W, N)
+    return np.abs(digits), digits < 0
+
+
+def recode_bits(bits, c: int = WINDOW_BITS):
+    """Device: (..., nbits) int32 LSB-first 0/1 bits -> window-major
+    signed digits ((W, ...) int32 magnitudes, (W, ...) bool negation
+    flags) — the exact `signed_digits` rule as one cheap int32 carry
+    scan, so callers keep marshalling the bit matrices they always
+    did and the recoding costs nothing next to one group op."""
+    nbits = bits.shape[-1]
+    W = num_windows(nbits, c)
+    pad = W * c - nbits
+    if pad:
+        widths = [(0, 0)] * (bits.ndim - 1) + [(0, pad)]
+        bits = jnp.pad(bits, widths)
+    # lint: allow(device-purity): static power-of-two weight table
+    weights = jnp.asarray(np.array([1 << i for i in range(c)], np.int32))
+    u = (bits.reshape(bits.shape[:-1] + (W, c)) * weights).sum(axis=-1)
+    u = jnp.moveaxis(u, -1, 0)  # (W, ...) unsigned window values
+    half = 1 << (c - 1)
+    full = 1 << c
+
+    def step(carry, uw):
+        t = uw + carry
+        over = t > half
+        mag = jnp.where(over, full - t, t)
+        # a borrowed-to-zero digit (t == 2^c) is sign-free — matches
+        # the host rule exactly (signed_digits emits +0 there)
+        neg = over & (mag > 0)
+        return over.astype(uw.dtype), (mag, neg)
+
+    carry0 = jnp.zeros(u.shape[1:], u.dtype)
+    # the top window absorbs the final carry by construction
+    # (num_windows adds the extra slot exactly when it could overflow)
+    _, (mags, negs) = jax.lax.scan(step, carry0, u)
+    return mags, negs
+
+
+# ------------------------------------------------- batch-leading kernel
+
+
+def _window_table(group, pt, c: int):
+    """[identity, P, 2P, .., B·P] multiples (B = 2^(c-1)); even entries
+    by doubling, odd by one add — complete formulas make the identity
+    entry and identity input lanes exact."""
+    table = [group.identity_like(pt), pt]
+    for d in range(2, (1 << (c - 1)) + 1):
+        table.append(
+            group.double(table[d // 2])
+            if d % 2 == 0
+            else group.add(table[-1], pt)
+        )
+    return table
+
+
+def _select_signed(group, table, mag, neg):
+    """table[mag] with the sign applied to y (select chain over the
+    B+1 static entries — elementwise wheres, no gather/scatter)."""
+    t = table[0]
+    for d in range(1, len(table)):
+        t = group.select(mag == d, table[d], t)
+    return group.select(neg, group.neg(t), t)
+
+
+def mul_scalar_bits_windowed(group, pt, bits, c: int = WINDOW_BITS):
+    """The unified signed-digit window ladder on the batch-leading
+    plane: pt = (X, Y, Z) `curve.ProjectiveGroup` bundles with leading
+    batch axes, bits (..., nbits) int32 LSB-first (any width). Per
+    window: c complete doublings + one complete add. Same contract as
+    `group.mul_scalar_bits` (identity lanes ride through)."""
+    mags, negs = recode_bits(bits, c)  # (W,) + batch
+
+    table = _window_table(group, pt, c)
+
+    def body(acc, wd):
+        mag, neg = wd
+        for _ in range(c):
+            acc = group.double(acc)
+        return group.add(acc, _select_signed(group, table, mag, neg)), None
+
+    acc, _ = jax.lax.scan(
+        body, group.identity_like(pt), (mags, negs), reverse=True
+    )
+    return acc
+
+
+# --------------------------------------------------- transposed kernel
+
+
+def mul_scalar_bits_windowed_t(group, pt, bits, c: int = WINDOW_BITS):
+    """The same window ladder on the transposed (batch-last) plane:
+    pt = (X, Y, Z) `tcurve.TProjective` bundles (w, NB, B), bits
+    (nbits, B) int32 LSB-first. Shares `recode_bits` and the per-window
+    step with the batch-leading form via tcurve.window_table/step."""
+    mags, negs = recode_bits(jnp.moveaxis(bits, 0, -1), c)  # (W, B)
+    table = group.window_table(pt, c)
+    B = pt[0].shape[-1]
+
+    def body(acc, wd):
+        mag, neg = wd
+        return group.window_step(acc, table, mag, neg, c), None
+
+    acc, _ = jax.lax.scan(
+        body, group.identity(B), (mags, negs), reverse=True
+    )
+    return acc
+
+
+# ------------------------------------------------------------ dispatch
+
+
+def ladder_impl() -> str:
+    """LIGHTHOUSE_TPU_LADDER selects the scalar-ladder kernel family
+    for EVERY per-lane ladder (signature RLC, KZG lanes, transposed and
+    Pallas planes): ""/unset -> "window" (the unified signed-digit
+    window kernel — the default device path); "chain" -> the legacy
+    per-bit double-add chain (A/B only, BENCH_IMPL=chain); "w2" -> the
+    2-bit unsigned window (Pallas/transposed planes; the batch-leading
+    plane maps it to "window"). Read at trace time — part of every
+    dispatching jit cache key (bls/kzg `_impl_key`)."""
+    import os
+
+    # lint: allow(device-purity): trace-time knob, keyed via _impl_key
+    v = os.environ.get("LIGHTHOUSE_TPU_LADDER", "")
+    if v in ("", "0", "window"):
+        return "window"
+    if v in ("chain", "w2"):
+        return v
+    raise ValueError(
+        f"LIGHTHOUSE_TPU_LADDER={v!r}: use window, chain, w2, or unset"
+    )
+
+
+def ladder(group, pt, bits, c: int = WINDOW_BITS, impl: str | None = None):
+    """THE per-lane scalar-multiple entry point for the batch-leading
+    plane — `ops.batch_verify`, `ops.kzg_verify`, and the sharded
+    builders all dispatch here, so a ladder improvement lands in the
+    signature and KZG planes at once. impl=None resolves the
+    LIGHTHOUSE_TPU_LADDER knob (callers under jit are keyed by it)."""
+    if impl is None:
+        impl = ladder_impl()
+    if impl == "chain":
+        return group.mul_scalar_bits(pt, bits)
+    # "w2" is a transposed/Pallas kernel choice; this plane's windowed
+    # form is the signed-digit kernel either way
+    return mul_scalar_bits_windowed(group, pt, bits, c=c)
+
+
+def ladder_t(group, pt, bits, c: int = WINDOW_BITS, impl: str | None = None):
+    """`ladder` for the transposed plane (`tcurve.TPG1`/`TPG2`):
+    the XLA-level txla pipeline and the Pallas kernel wrappers."""
+    if impl is None:
+        impl = ladder_impl()
+    if impl == "chain":
+        return group.mul_scalar_bits(pt, bits)
+    if impl == "w2":
+        return group.mul_scalar_bits_w2(pt, bits)
+    return mul_scalar_bits_windowed_t(group, pt, bits, c=c)
+
+
+# jit objects per (group, c, impl, MXU form) — keyed like the bls jit
+# caches by everything read at trace time, so flipping a knob
+# mid-process retraces instead of silently reusing a stale trace;
+# (width, lanes) shape buckets retrace INSIDE the cached jit object.
+_JITTED: dict = {}
+
+
+def jitted_ladder(
+    group_name: str = "G1",
+    c: int = WINDOW_BITS,
+    impl: str | None = None,
+):
+    """Process-cached jitted ladder entry (bench A/B + warm scripts)."""
+    if impl is None:
+        impl = ladder_impl()
+    key = (group_name, c, impl, fb.use_mxu_conv())
+    fn = _JITTED.get(key)
+    if fn is None:
+        group = curve.PG2 if group_name == "G2" else curve.PG1
+        fn = _JITTED[key] = jax.jit(
+            functools.partial(ladder, group, c=c, impl=impl)
+        )
+    return fn

@@ -46,6 +46,7 @@ def _shard_map(f, mesh, in_specs, out_specs):
 
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.ops import batch_verify, curve, pairing, tower
+from lighthouse_tpu.ops import window_ladder as wl
 
 # trace-time observability: which reduction strategy each sharded
 # program was built with (fires once per trace, not per dispatch) and
@@ -172,8 +173,8 @@ def sharded_verify_signature_sets(mesh, ring: bool = False):
             mesh, ring, curve.PG1, partial_pk, "keys"
         )
 
-        # ---- per-set RLC scale + affinize
-        agg_pk_r = curve.PG1.mul_scalar_bits(agg_pk, rand_bits)
+        # ---- per-set RLC scale + affinize (the shared window kernel)
+        agg_pk_r = wl.ladder(curve.PG1, agg_pk, rand_bits)
         pk_x, pk_y, pk_inf = curve.PG1.to_affine(agg_pk_r)
 
         # ---- sets-axis: global RLC-combined signature partial
@@ -227,12 +228,13 @@ def sharded_verify_signature_sets_grouped(mesh, ring: bool = False):
         agg = curve.PG1.sum_axis(
             curve.PG1.from_affine(pubkeys, key_mask), axis=2
         )
-        agg_r = curve.PG1.mul_scalar_bits(agg, rand_bits)
+        agg_r = wl.ladder(curve.PG1, agg, rand_bits)
         grp_pk = curve.PG1.sum_axis(agg_r, axis=1)  # local (G/n,)
         pk_x, pk_y, pk_inf = curve.PG1.to_affine(grp_pk)
 
         # ---- global RLC signature sum partial (both grid axes local)
-        sig_r = curve.PG2.mul_scalar_bits(
+        sig_r = wl.ladder(
+            curve.PG2,
             curve.PG2.from_affine(sigs, set_mask), rand_bits
         )
         local_sig = curve.PG2.sum_axis(

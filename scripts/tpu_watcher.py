@@ -46,49 +46,48 @@ SWEEP_COOLDOWN = 1800      # seconds after a successful sweep
 PROBE_TIMEOUT = 90
 MEASURE_TIMEOUT = 1500     # per-config deadline (fresh compile included)
 
-# (impl, n_sets) sweep. All five impls have hardware numbers from
-# 2026-07-31: xla 1,470 @1024 / mxu 1,008 (int8 digit decomposition
-# loses at these contraction shapes) / txla 2,299 / pallas 5,425 @1024
-# and 8,433 @4096 / ptail ~= pallas (the final exp is not the
-# bottleneck). Throughput rises with batch size (~90 ms fixed cost
-# amortizing over ~97 us/sig linear cost), so the recurring sweep
-# tracks the Pallas path at growing batch sizes, with xla@1024 as the
-# per-sweep reference point. 30720 ~= the mainnet full-slot load
-# (BASELINE.md north-star config).
-# Entries are (impl, n_sets) or (impl, n_sets, BENCH_CONFIG).
-# The unproven MXU-REDC forms run LAST, predcbf before predc: the one
-# observed predc (int8 einsum) attempt burned the full 1500 s compile
-# deadline and then the tunnel died, while bf16 is the most-trodden
-# Mosaic matmul lowering — so a repeat of the compile blow-up must not
-# cost the headline and BASELINE-config measurements queued before it
+# (impl, n_sets) sweep; entries are (impl, n_sets) or
+# (impl, n_sets, BENCH_CONFIG).
+#
+# Ordered so the NEW DEFAULT device path measures FIRST on tunnel
+# return (the tunnel routinely dies mid-sweep — the headline must not
+# queue behind A/B partners): since the unified-ladder PR the default
+# `pallas` path IS signed-digit window ladders + FP12_SQR + bf16
+# MXU-REDC, so entries 1-3 are the hardware claims the PR staged —
+# unified ladder on grouped64 (where ladders ARE the cost floor) and
+# the flat 4096 shape, then the FP12_SQR headline at the 30720
+# full-slot shape. The legacy-form A/B partners (chain = double-add
+# ladders, vredc = VPU REDC chain) and the ladder microbench follow,
+# then the re-pointed KZG plane (kzg/kzgfold now dispatch the shared
+# window kernel), then the BASELINE configs. The unproven int8
+# MXU-REDC form stays LAST: the one observed predc attempt burned the
+# full 1500 s compile deadline before the tunnel died
 # (scripts/probe_mxu_forms.py settles the matmul-form question with
-# bounded micro-kernels first).
+# bounded micro-kernels first). Prior hardware numbers (2026-07-31):
+# xla 1,470 @1024 / pallas 5,425 @1024, 8,433 @4096, 9,824 @30720 /
+# ptail ~= pallas / mxu 1,008 (dead end).
 SWEEP = [
-    ("xla", 1024),
-    ("pallas", 4096),
-    # the committee-shaped full-slot load (30720 sets over 64 messages,
-    # G+1 Miller loops): the shape the 150k north star actually means —
-    # measured right after the distinct-message headline configs
-    ("pallas", 30720),
+    # --- the new defaults first
     ("pallas", 30720, "grouped64"),
-    # windowed-2 RLC ladder A/B: on the grouped shape the ladders ARE
-    # the dominant cost (the Miller loops collapsed to G+1), so the
-    # ~25% ladder-op cut shows up ~proportionally there
-    ("pw2", 30720, "grouped64"),
-    ("pw2", 4096),
+    ("pallas", 4096),
+    ("pallas", 30720),
+    # --- legacy-form A/B partners + the ladder microbench
+    ("chain", 30720, "grouped64"),
+    ("chain", 4096),
+    ("xla", 30720, "ladder"),
+    ("vredc", 4096),
+    ("vredc", 30720),
+    # --- KZG plane on the re-pointed shared window kernel
+    ("xla", 4, "kzg"),
+    ("xla", 4096, "kzg"),
+    ("xla", 8, "kzgfold"),
+    # --- per-sweep reference point + BASELINE configs
+    ("xla", 1024),
     ("pallas", 64, "sync512"),
     ("pallas", 132, "block"),
     ("pallas", 32, "replay32"),
     ("pallas", 32768, "oppool32k"),
-    # KZG plane (PR 4): producer commit-MSM throughput on the
-    # fixed-base windowed device graph at the minimal-preset and
-    # mainnet blob shapes, then the ops/kzg_verify fold factor the
-    # ROADMAP has pending (ref curve: 0.89x/2.69x/5.10x at N=1/4/8)
-    ("xla", 4, "kzg"),
-    ("xla", 4096, "kzg"),
-    ("xla", 8, "kzgfold"),
-    ("predcbf", 4096),
-    ("predcbf", 30720),
+    # --- unproven compile-blow-up risk last
     ("predc", 4096),
 ]
 

@@ -1,0 +1,87 @@
+"""Warm the repo-local JAX compile cache (.jax_cache) with the unified
+windowed-ladder plane's graphs at the tier-1 lane shapes, so the tier-1
+dot count does not regress from cold compiles after the ladder-default
+flip (PR 8). Covers: the window kernel at both production scalar widths
+(64-bit RLC, 255-bit KZG lanes) on PG1/PG2, the re-pointed small-lane
+KZG verify graph (bucket 2 — the tier-1 verdict-agreement shape), and
+the 3/4-set flat verify graphs the tier-1 device tests compile.
+
+Run: python scripts/warm_ladder.py            (CPU, ~10-15 min cold,
+                                               seconds warm)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu.backend import (  # noqa: E402
+    enable_compile_cache,
+    force_cpu_backend,
+)
+
+enable_compile_cache()
+force_cpu_backend(8)
+
+
+def _t(label, fn):
+    t0 = time.time()
+    fn()
+    print(f"{label}: {time.time() - t0:.1f}s", flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lighthouse_tpu.ops import curve
+    from lighthouse_tpu.ops import window_ladder as wl
+
+    # window kernel, both widths, both groups, tier-1 lane counts
+    for group_name, group in (("G1", curve.PG1), ("G2", curve.PG2)):
+        for nbits, lanes in ((64, 4), (64, 8), (255, 4)):
+            bits = jnp.asarray(
+                curve.scalars_to_bits(
+                    [i + 1 for i in range(lanes)], nbits
+                )
+            )
+            pt = group.generator_like((lanes,))
+            fn = wl.jitted_ladder(group_name, impl="window")
+            _t(
+                f"ladder {group_name} w{nbits} lanes={lanes}",
+                lambda: jax.block_until_ready(fn(pt, bits)),
+            )
+
+    # flat verify graphs at the tier-1 set shapes (4 sets x 1/3 keys)
+    from lighthouse_tpu import testing as td
+    from lighthouse_tpu.ops import batch_verify
+
+    for max_keys in (1, 3):
+        args = td.make_signature_set_batch(4, max_keys=max_keys, seed=1)
+        fn = jax.jit(batch_verify.verify_signature_sets)
+        _t(
+            f"verify_signature_sets keys={max_keys}",
+            lambda: np.asarray(fn(*args)),
+        )
+
+    # the re-pointed KZG verify graph at the smallest bucket (tier-1
+    # verdict-agreement shape: 3*2 lanes + aux)
+    from lighthouse_tpu import kzg
+
+    n = 4
+    blob = b"".join((3 * i + 2).to_bytes(32, "big") for i in range(n))
+    setup = kzg.dev_setup(n)
+    comm = kzg.blob_to_kzg_commitment(blob, setup)
+    proof = kzg.compute_blob_kzg_proof(blob, comm, setup)
+    _t(
+        "kzg verify bucket=2",
+        lambda: kzg.verify_blob_kzg_proof_batch(
+            [blob], [comm], [proof], backend="tpu", setup=setup, seed=3
+        ),
+    )
+
+
+if __name__ == "__main__":
+    main()

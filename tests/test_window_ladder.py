@@ -1,0 +1,300 @@
+"""The unified signed-digit windowed-ladder plane (ops.window_ladder):
+digit roundtrips at both production scalar widths (64-bit RLC, 255-bit
+KZG lanes) including the top-window carry, host/device recode
+agreement, window-kernel vs legacy-chain point equality on the
+batch-leading and transposed planes, the dispatch knobs, and the keyed
+jit caches (flipping a knob retraces, never silently reuses)."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lighthouse_tpu.ops import curve, tcurve, tfield as tf
+from lighthouse_tpu.ops import window_ladder as wl
+
+rnd = random.Random(1234)
+
+
+def test_signed_digits_roundtrip_both_widths():
+    """sum d_w 2^(cw) == s exactly at 64-bit and 255-bit widths, digits
+    inside the signed bound, including scalars that carry through every
+    window into the top slot."""
+    for nbits in (64, 255):
+        for c in (4, 5):
+            W = wl.num_windows(nbits, c)
+            half = 1 << (c - 1)
+            cases = [
+                0,
+                1,
+                (1 << nbits) - 1,  # all-ones: carries end to end
+                (1 << nbits) - half,  # borrows straight into the top
+                rnd.getrandbits(nbits),
+                rnd.getrandbits(nbits),
+            ]
+            for s in cases:
+                d = wl.signed_digits(s, c, nbits)
+                assert len(d) == W, (nbits, c)
+                assert all(-half < x <= half for x in d), (s, c)
+                assert sum(x << (c * i) for i, x in enumerate(d)) == s
+
+    # the carry slot exists exactly where it must: a 4-bit top digit
+    # can overflow the signed bound (64/4), a 3-bit one cannot (255/4)
+    assert wl.num_windows(64, 4) == 17
+    assert wl.num_windows(255, 4) == 64
+
+
+def test_device_recode_matches_host_digits():
+    """recode_bits (the in-graph int32 carry scan) is byte-identical to
+    the host signed_digits rule at both widths — magnitudes AND sign
+    flags (a borrowed-to-zero digit is sign-free on both sides)."""
+    for nbits in (64, 255):
+        scalars = [
+            0,
+            1,
+            (1 << nbits) - 1,
+            (1 << nbits) - 8,
+            rnd.getrandbits(nbits),
+        ]
+        bits = jnp.asarray(curve.scalars_to_bits(scalars, nbits))
+        mags, negs = jax.jit(wl.recode_bits)(bits)
+        hm, hn = wl.signed_digit_arrays(scalars, 4, nbits)
+        assert np.array_equal(np.asarray(mags), hm), nbits
+        assert np.array_equal(np.asarray(negs), hn), nbits
+
+
+def test_msm_machinery_is_the_shared_plane():
+    """ops.msm re-exports this module's decomposition at the 255-bit
+    subgroup-order width — the MSM graphs and the per-lane ladders
+    cannot drift."""
+    from lighthouse_tpu.crypto.constants import R
+    from lighthouse_tpu.ops import msm
+
+    assert msm.WINDOW_BITS == wl.WINDOW_BITS
+    for s in (0, 1, R - 1, rnd.randrange(R)):
+        assert msm.signed_digits(s) == wl.signed_digits(s, 4, 255)
+    assert msm.num_windows(4) == wl.num_windows(255, 4)
+    assert msm.num_windows(5) == wl.num_windows(255, 5)
+
+
+def test_windowed_matches_chain_batch_leading():
+    """The window kernel == the legacy double-add chain on the
+    batch-leading plane (PG1 + PG2, 64-bit RLC width), including the
+    zero scalar and an identity input lane."""
+    scalars = [0, 1, (1 << 64) - 1, 0xDEADBEEFCAFE1234]
+    bits = jnp.asarray(curve.scalars_to_bits(scalars, 64))
+    for group in (curve.PG1, curve.PG2):
+        gen = group.generator_like((len(scalars),))
+        # lane 3 as the identity: must ride through both kernels
+        mask = jnp.asarray(np.array([True, True, True, False]))
+        pt = group.select(mask, gen, group.identity_like(gen))
+        wnd = jax.jit(
+            lambda p, b, g=group: wl.ladder(g, p, b, impl="window")
+        )(pt, bits)
+        ch = jax.jit(
+            lambda p, b, g=group: wl.ladder(g, p, b, impl="chain")
+        )(pt, bits)
+        assert np.asarray(jax.jit(group.eq)(wnd, ch)).all(), group.name
+
+
+def test_windowed_255_matches_reference_scalar_mul():
+    """255-bit width (the KZG lane ladder) against the pure-bigint
+    reference ground truth — no 255-step chain compile needed."""
+    from lighthouse_tpu.crypto.constants import R
+    from lighthouse_tpu.crypto.ref_curve import G1 as RG1
+    from lighthouse_tpu.ops import fieldb as fb
+
+    def pack_affine(affs):
+        xs = np.stack([fb.pack_ints([a[0] if a else 0]) for a in affs])
+        ys = np.stack([fb.pack_ints([a[1] if a else 0]) for a in affs])
+        mask = jnp.asarray(np.array([a is not None for a in affs]))
+        return curve.PG1.from_affine(
+            (fb.to_mont(jnp.asarray(xs)), fb.to_mont(jnp.asarray(ys))),
+            mask,
+        )
+
+    scalars = [0, 1, R - 1, rnd.randrange(R)]
+    pts = [RG1.mul_scalar(RG1.generator, k + 2) for k in range(4)]
+    dp = pack_affine([RG1.to_affine(p) for p in pts])
+    bits = jnp.asarray(curve.scalars_to_bits(scalars, 255))
+    out = jax.jit(
+        lambda p, b: wl.mul_scalar_bits_windowed(curve.PG1, p, b)
+    )(dp, bits)
+
+    want_pts = [RG1.mul_scalar(p, k) for p, k in zip(pts, scalars)]
+    want = pack_affine(
+        [None if RG1.is_infinity(p) else RG1.to_affine(p) for p in want_pts]
+    )
+    assert np.asarray(jax.jit(curve.PG1.eq)(out, want)).all()
+
+
+def test_windowed_matches_chain_transposed():
+    """ladder_t: window kernel == chain == w2 on the tcurve plane."""
+    scalars = [0, 1, (1 << 64) - 1, 0x0123456789ABCDEF]
+    bits_t = jnp.asarray(
+        np.array(
+            [[(s >> i) & 1 for s in scalars] for i in range(64)], np.int32
+        )
+    )
+    gen = curve.PG2.generator_like((4,))
+    gx, gy = (tf.from_batchlead(c) for c in (gen[0], gen[1]))
+    mask = jnp.asarray(np.array([True, True, True, False]))
+    pt = tcurve.TPG2.from_affine((gx, gy), mask)
+
+    def eq_lanes(a, b):
+        a_bl = tuple(tf.to_batchlead(c) for c in a)
+        b_bl = tuple(tf.to_batchlead(c) for c in b)
+        return np.asarray(curve.PG2.eq(a_bl, b_bl))
+
+    chain = jax.jit(
+        lambda p, b: wl.ladder_t(tcurve.TPG2, p, b, impl="chain")
+    )(pt, bits_t)
+    wnd = jax.jit(
+        lambda p, b: wl.ladder_t(tcurve.TPG2, p, b, impl="window")
+    )(pt, bits_t)
+    w2 = jax.jit(
+        lambda p, b: wl.ladder_t(tcurve.TPG2, p, b, impl="w2")
+    )(pt, bits_t)
+    assert eq_lanes(chain, wnd).all()
+    assert eq_lanes(chain, w2).all()
+
+
+def test_ladder_impl_knob(monkeypatch):
+    """""/unset -> the window kernel (the default device path); chain
+    and w2 select the legacy forms; anything else fails loud."""
+    monkeypatch.delenv("LIGHTHOUSE_TPU_LADDER", raising=False)
+    assert wl.ladder_impl() == "window"
+    for v, want in (("", "window"), ("0", "window"), ("window", "window"),
+                    ("chain", "chain"), ("w2", "w2")):
+        monkeypatch.setenv("LIGHTHOUSE_TPU_LADDER", v)
+        assert wl.ladder_impl() == want
+    monkeypatch.setenv("LIGHTHOUSE_TPU_LADDER", "w3")
+    with pytest.raises(ValueError):
+        wl.ladder_impl()
+
+
+def test_fp12_sqr_knob_and_forms_agree(monkeypatch):
+    """The FP12 squaring knob: default = the dedicated 12-product
+    program; "mul" = the legacy generic multiply — byte-identical
+    canonically (the oracle-agreement half of flipping the default)."""
+    from lighthouse_tpu.ops import fieldb as fb, tower
+
+    monkeypatch.delenv("LIGHTHOUSE_TPU_FP12_SQR", raising=False)
+    assert tower.use_fp12_sqr() is True
+    monkeypatch.setenv("LIGHTHOUSE_TPU_FP12_SQR", "mul")
+    assert tower.use_fp12_sqr() is False
+    monkeypatch.setenv("LIGHTHOUSE_TPU_FP12_SQR", "bogus")
+    with pytest.raises(ValueError):
+        tower.use_fp12_sqr()
+    monkeypatch.delenv("LIGHTHOUSE_TPU_FP12_SQR", raising=False)
+
+    rng = np.random.default_rng(7)
+    ints = [int.from_bytes(rng.bytes(48), "big") for _ in range(12)]
+    fp6s = [
+        tuple((ints[i * 6 + 2 * j], ints[i * 6 + 2 * j + 1]) for j in range(3))
+        for i in range(2)
+    ]
+    bundle = tower.fp12_pack([(fp6s[0], fp6s[1])])
+    sq = np.asarray(fb.canon(jax.jit(tower.fp12_sqr)(bundle)))
+    monkeypatch.setenv("LIGHTHOUSE_TPU_FP12_SQR", "mul")
+    # fresh trace (no module-level jit cache for the raw tower fn)
+    legacy = np.asarray(fb.canon(jax.jit(tower.fp12_sqr)(bundle)))
+    assert np.array_equal(sq, legacy)
+
+
+def test_mxu_redc_default_resolution(monkeypatch):
+    """Unset resolves the DEFAULT device form: the VPU chain on this
+    CPU mesh (no MXU to feed), "0" forces the legacy chain, the
+    explicit forms still parse."""
+    monkeypatch.delenv("LIGHTHOUSE_TPU_MXU_REDC", raising=False)
+    assert tf.use_mxu_redc() == ""  # CPU mesh: no MXU
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU_REDC", "0")
+    assert tf.use_mxu_redc() == ""
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU_REDC", "1")
+    assert tf.use_mxu_redc() == "i8"
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU_REDC", "bf16")
+    assert tf.use_mxu_redc() == "bf16"
+    # the on-TPU branch is what the default resolves through
+    monkeypatch.delenv("LIGHTHOUSE_TPU_MXU_REDC", raising=False)
+    monkeypatch.setattr(tf, "_tpu_backend", lambda: True)
+    assert tf.use_mxu_redc() == "bf16"
+
+
+def test_jitted_ladder_cache_is_knob_keyed(monkeypatch):
+    """Same key -> the same jit object; flipping the ladder knob ->
+    a NEW jit object (retrace, never silent reuse) — the bls jit-cache
+    convention on the unified kernel's own cache."""
+    monkeypatch.delenv("LIGHTHOUSE_TPU_LADDER", raising=False)
+    a = wl.jitted_ladder("G1")
+    assert wl.jitted_ladder("G1") is a
+    monkeypatch.setenv("LIGHTHOUSE_TPU_LADDER", "chain")
+    b = wl.jitted_ladder("G1")
+    assert b is not a
+    assert wl.jitted_ladder("G1") is b
+
+
+def test_backend_impl_keys_cover_the_new_knobs(monkeypatch):
+    """bls and kzg _impl_key change when any of the new trace-time
+    knobs flip — the keyed-jit-cache discipline the lint pass pins."""
+    from lighthouse_tpu.bls import tpu_backend as bls_be
+    from lighthouse_tpu.kzg import tpu_backend as kzg_be
+
+    for var in ("LIGHTHOUSE_TPU_LADDER", "LIGHTHOUSE_TPU_FP12_SQR",
+                "LIGHTHOUSE_TPU_TAIL", "LIGHTHOUSE_TPU_MXU_REDC"):
+        monkeypatch.delenv(var, raising=False)
+    base_bls = bls_be._impl_key()
+    base_kzg = kzg_be._impl_key()
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_LADDER", "chain")
+    assert bls_be._impl_key() != base_bls
+    assert kzg_be._impl_key() != base_kzg
+    monkeypatch.delenv("LIGHTHOUSE_TPU_LADDER")
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_FP12_SQR", "mul")
+    assert bls_be._impl_key() != base_bls
+    assert kzg_be._impl_key() != base_kzg
+    monkeypatch.delenv("LIGHTHOUSE_TPU_FP12_SQR")
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_TAIL", "1")
+    assert bls_be._impl_key() != base_bls
+    monkeypatch.delenv("LIGHTHOUSE_TPU_TAIL")
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU_REDC", "bf16")
+    assert bls_be._impl_key() != base_bls
+    assert kzg_be._impl_key() != base_kzg
+
+
+def test_retired_bench_impls_exit_4():
+    """pw2/predcbf are the defaults now; their labels exit(4) instead
+    of silently measuring the default under an experimental name."""
+    from lighthouse_tpu.bench_impl import KNOWN_IMPLS, apply_impl_env
+
+    for retired in ("pw2", "predcbf"):
+        assert retired not in KNOWN_IMPLS
+        with pytest.raises(SystemExit) as e:
+            apply_impl_env(retired)
+        assert e.value.code == 4
+    with pytest.raises(SystemExit) as e:
+        apply_impl_env("typo")
+    assert e.value.code == 4
+
+
+def test_legacy_bench_impls_set_the_env_forms(monkeypatch):
+    from lighthouse_tpu.bench_impl import apply_impl_env
+
+    import os
+
+    for var in ("LIGHTHOUSE_TPU_LADDER", "LIGHTHOUSE_TPU_FP12_SQR",
+                "LIGHTHOUSE_TPU_MXU_REDC", "LIGHTHOUSE_TPU_TAIL"):
+        monkeypatch.delenv(var, raising=False)
+    apply_impl_env("chain")
+    assert os.environ["LIGHTHOUSE_TPU_LADDER"] == "chain"
+    apply_impl_env("vredc")
+    assert os.environ["LIGHTHOUSE_TPU_MXU_REDC"] == "0"
+    assert tf.use_mxu_redc() == ""
+    apply_impl_env("mulsqr")
+    assert os.environ["LIGHTHOUSE_TPU_FP12_SQR"] == "mul"
+    apply_impl_env("ptail")
+    assert os.environ["LIGHTHOUSE_TPU_TAIL"] == "1"
