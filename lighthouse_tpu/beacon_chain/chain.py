@@ -280,16 +280,41 @@ class BeaconChain:
         return max(self.head_state.slot, self.fork_choice.current_slot)
 
     def _fc_checkpoint(self, cp) -> tuple:
-        """A (epoch, root) checkpoint safe for fork choice: roots the
-        proto array cannot know — epoch-0 zero roots, and on a
-        checkpoint-synced chain any root from BEFORE the anchor — clamp
-        to the chain's anchor root (the reference initializes its
-        ForkChoiceStore the same way: everything starts at the anchor,
-        client/src/config.rs:31-34 + fork_choice anchor init)."""
+        """A (epoch, root) checkpoint safe for fork choice. Roots the
+        proto array legitimately cannot know clamp to the chain's
+        anchor root (the reference initializes its ForkChoiceStore the
+        same way: everything starts at the anchor, client/src/config.rs:
+        31-34 + fork_choice anchor init). The clamp is SCOPED: only the
+        epoch-0 zero-root sentinel and checkpoints at or below the
+        anchor/finalized boundary qualify (pre-anchor history on a
+        checkpoint-synced chain; pruned-proto roots from a late side
+        branch carrying a stale finalized vote). An unknown root ABOVE
+        that boundary is evidence of a corrupt state or a broken proto
+        array — it raises instead of silently becoming the anchor
+        (ADVICE r5)."""
         root = bytes(cp.root)
-        if cp.epoch == 0 or root not in self.fork_choice.proto.indices:
-            root = self.genesis_root
-        return (cp.epoch, root)
+        if cp.epoch == 0 and root == ZERO_BYTES32:
+            return (0, self.genesis_root)
+        if root in self.fork_choice.proto.indices:
+            return (cp.epoch, root)
+        clamp_slot = max(
+            getattr(self, "anchor_slot", 0),
+            self.spec.epoch_start_slot(self.finalized_checkpoint.epoch),
+        )
+        if (
+            self.spec.epoch_start_slot(cp.epoch) <= clamp_slot
+            or root == self.genesis_root
+        ):
+            return (cp.epoch, self.genesis_root)
+        _LOG.warning(
+            "fork-choice checkpoint (epoch %d, 0x%s) above the anchor "
+            "boundary (slot %d) is unknown to the proto array",
+            int(cp.epoch), root.hex()[:12], clamp_slot,
+        )
+        raise BlockError(
+            f"unknown fork-choice checkpoint root 0x{root.hex()[:12]} "
+            f"at epoch {int(cp.epoch)} above anchor boundary"
+        )
 
     def set_slot(self, slot: int):
         self.fork_choice.set_slot(slot)
@@ -500,8 +525,16 @@ class BeaconChain:
             block_root, signed_block, state, spec
         )
 
-        # store + fork choice
+        # store + fork choice. Checkpoints resolve FIRST: _fc_checkpoint
+        # can now raise on a corrupt above-anchor root, and that abort
+        # must happen before the first store mutation — a block the
+        # canonical index serves while fork choice never saw it would
+        # make the detected corruption worse, not better
         with span("import/store_fork_choice"):
+            justified = self._fc_checkpoint(
+                state.current_justified_checkpoint
+            )
+            finalized = self._fc_checkpoint(state.finalized_checkpoint)
             self.store.put_block(block_root, signed_block)
             # persistence point for blob sidecars: only blocks that
             # actually import get their (verified) sidecars on disk, so
@@ -510,10 +543,6 @@ class BeaconChain:
                 self.store.put_blob_sidecar(block_root, sc)
             self.store.put_hot_state(state)
             self.store.set_canonical_block_root(block.slot, block_root)
-            justified = self._fc_checkpoint(
-                state.current_justified_checkpoint
-            )
-            finalized = self._fc_checkpoint(state.finalized_checkpoint)
             exec_status, exec_hash = self._execution_verdict(block, engine)
             self.fork_choice.on_block(
                 block.slot,
@@ -831,6 +860,14 @@ class BeaconChain:
         )
         if bytes(block.state_root) != cached_state_root(state):
             raise BlockError("state root mismatch")
+        # checkpoints resolve BEFORE the store writes (same atomicity
+        # contract as the gossip path: a _fc_checkpoint abort must not
+        # leave the canonical index pointing at a block fork choice
+        # never saw)
+        justified = self._fc_checkpoint(
+            state.current_justified_checkpoint
+        )
+        finalized = self._fc_checkpoint(state.finalized_checkpoint)
         self.store.put_block(block_root, signed_block)
         for sc in self.da_checker.verified_sidecars(block_root):
             self.store.put_blob_sidecar(block_root, sc)
@@ -843,8 +880,8 @@ class BeaconChain:
             block.slot,
             block_root,
             parent_root,
-            self._fc_checkpoint(state.current_justified_checkpoint),
-            self._fc_checkpoint(state.finalized_checkpoint),
+            justified,
+            finalized,
             execution_status=exec_status,
             execution_block_hash=exec_hash,
         )
@@ -1211,7 +1248,11 @@ class BeaconChain:
         return block
 
     def produce_block_unsigned(
-        self, slot: int, randao_reveal: bytes, graffiti: bytes = b"\x00" * 32
+        self,
+        slot: int,
+        randao_reveal: bytes,
+        graffiti: bytes = b"\x00" * 32,
+        blob_kzg_commitments=(),
     ):
         """Unsigned block for `slot` on the canonical head — the VC-facing
         half of block production (beacon_chain.rs:3014 produce_block /
@@ -1219,13 +1260,24 @@ class BeaconChain:
         /eth/v2/validator/blocks/{slot}): attestations packed from the
         operation pool by greedy max-cover, slashings/exits from the pool,
         the sync aggregate from pooled contributions, and the post-state
-        root computed with signatures skipped."""
+        root computed with signatures skipped. `blob_kzg_commitments`
+        (bellatrix-or-later bodies) binds the producer's blobs to the
+        block — the per-node production path the network simulator's
+        blob slots run on."""
         state, fork_name, proposer = self._open_production(slot)
         body = self.t.block_body_classes[fork_name](
             **self._packed_body_fields(
                 state, slot, fork_name, randao_reveal, graffiti
             )
         )
+        if blob_kzg_commitments:
+            if fork_name != "bellatrix":
+                raise BlockError(
+                    "blob commitments need a bellatrix-or-later body"
+                )
+            body.blob_kzg_commitments = [
+                bytes(c) for c in blob_kzg_commitments
+            ]
         if fork_name == "bellatrix":
             builder = getattr(self, "payload_builder", None)
             if builder is not None:

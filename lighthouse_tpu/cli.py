@@ -247,8 +247,80 @@ def cmd_bn(args):
     return 0
 
 
+def build_http_vc(
+    urls, keypairs, spec, slashing_db_path=None, use_builder=False
+):
+    """The `vc --beacon-node-url` wiring: one URL talks straight to a
+    BeaconNodeHttpClient, several wrap in BeaconNodeFallback (health
+    ranking + per-request failover) behind the same client surface.
+    Returns a ready HttpValidatorClient."""
+    from lighthouse_tpu.http_api.client import BeaconNodeHttpClient
+    from lighthouse_tpu.validator_client.beacon_node_fallback import (
+        BeaconNodeFallback,
+        FallbackBeaconNodeClient,
+    )
+    from lighthouse_tpu.validator_client.http_vc import (
+        HttpValidatorClient,
+    )
+    from lighthouse_tpu.validator_client.slashing_protection import (
+        SlashingProtectionDB,
+    )
+
+    clients = [BeaconNodeHttpClient(u) for u in urls]
+    if len(clients) == 1:
+        client = clients[0]
+    else:
+        fallback = BeaconNodeFallback.from_clients(clients)
+        fallback.update_health()
+        client = FallbackBeaconNodeClient(fallback)
+    return HttpValidatorClient(
+        client,
+        list(keypairs),
+        spec,
+        slashing_db=SlashingProtectionDB(slashing_db_path or ":memory:"),
+        use_builder=use_builder,
+    )
+
+
+def _cmd_vc_http(args):
+    """Run the HTTP-only duty loop against live beacon node(s): the VC
+    reaches the BN exclusively over the REST API (validator_client/
+    src/lib.rs production shape), following the BN's own genesis clock."""
+    from lighthouse_tpu import bls
+
+    spec = _spec_for(args.network)
+    keypairs = bls.interop_keypairs(args.validators)
+    vc = build_http_vc(
+        args.beacon_node_url, keypairs, spec,
+        slashing_db_path=args.slashing_db,
+    )
+    genesis_time = int(vc.client.get_genesis()["genesis_time"])
+    sps = spec.SECONDS_PER_SLOT
+    start_slot = max(1, (int(time.time()) - genesis_time) // sps + 1)
+    for slot in range(start_slot, start_slot + args.slots):
+        wait = genesis_time + slot * sps - time.time()
+        if wait > 0:
+            time.sleep(wait)
+        vc.run_slot(slot)
+    print(
+        json.dumps(
+            {
+                "slots": args.slots,
+                "beacon_nodes": list(args.beacon_node_url),
+                "proposed": vc.metrics["blocks_proposed"],
+                "attestations": vc.metrics["attestations_published"],
+                "aggregates": vc.metrics["aggregates_published"],
+                "publish_errors": vc.metrics["publish_errors"],
+            }
+        )
+    )
+    return 0
+
+
 def cmd_vc(args):
-    """Run validator duties against an in-process dev node for N slots."""
+    """Run validator duties: against live beacon node(s) over HTTP when
+    --beacon-node-url is given (repeat the flag for a ranked fallback
+    list), else against an in-process dev node for N slots."""
     from lighthouse_tpu.harness import Harness
     from lighthouse_tpu.beacon_chain import BeaconChain
     from lighthouse_tpu.validator_client import (
@@ -256,6 +328,8 @@ def cmd_vc(args):
         ValidatorClient,
     )
 
+    if args.beacon_node_url:
+        return _cmd_vc_http(args)
     spec = _spec_for(args.network)
     h = Harness(spec, args.validators, backend=args.bls_backend)
     chain = BeaconChain(h.state.copy(), spec, backend=args.bls_backend)
@@ -606,6 +680,14 @@ def build_parser():
     vc.add_argument("--slots", type=int, default=8)
     vc.add_argument("--slashing-db", default=None)
     vc.add_argument("--bls-backend", default="ref")
+    vc.add_argument(
+        "--beacon-node-url",
+        action="append",
+        default=None,
+        help="beacon node REST URL; repeat for a ranked fallback list "
+        "— the VC then talks HTTP only (HttpValidatorClient), never "
+        "an in-process chain",
+    )
     vc.set_defaults(fn=cmd_vc)
 
     acct = sub.add_parser("account", help="keys & keystores")

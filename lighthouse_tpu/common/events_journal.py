@@ -74,6 +74,12 @@ KINDS = frozenset(
         "peer_quarantine",
         # validator monitor
         "validator_summary",
+        # network simulator (sim/orchestrator): fault timeline entries —
+        # partitions applied/lifted, eclipses, offline windows, spam
+        # floods, kv crashes — landed in every affected node's journal so
+        # a chaos run's forensic record is self-describing (invariant
+        # checks learn fault windows from the journal, not internals)
+        "sim_fault",
     }
 )
 

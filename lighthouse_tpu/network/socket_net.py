@@ -122,6 +122,12 @@ class _PeerConn:
         self.alive = True
         self.listen_port = None
         self.udp_port = None
+        # conditioner hold queue: [remaining_sends, kind, body] entries —
+        # a delayed/reordered frame waits here until `remaining_sends`
+        # later frames have passed on this directed pair (deterministic
+        # "delay by k sends", no wall-clock dependence)
+        self.held: list = []
+        self.held_lock = TimedLock("socket_net.peer_held")
 
     def close(self):
         self.alive = False
@@ -202,13 +208,37 @@ class SocketNet:
         host: str = "127.0.0.1",
         rpc_server=None,
         on_peer_connected=None,
+        on_peer_disconnected=None,
+        conditioner=None,
+        mesh_enabled: bool = True,
+        forward_gate=None,
     ):
+        """`conditioner` (sim/conditioner.NetworkConditioner) sits on the
+        OUTBOUND edge of every gossip frame and RPC call: seeded
+        per-directed-peer-pair drop/delay/reorder/duplicate decisions
+        plus schedulable partition masks, so a multi-node simulation
+        replays byte-identically from one seed. `mesh_enabled=False`
+        forces flood-to-interested fanout (the deterministic topology
+        simulations need — the mesh heartbeat samples an RNG on a timer
+        thread). `on_peer_disconnected` fires when a peer's connection
+        drops for ANY reason (read EOF, send failure, ban), so the sync
+        manager's peer table cannot hold a dead proxy forever."""
         self.node_id = node_id
         self.t = types
         self.spec = spec
         self.host = host
         self.rpc_server = rpc_server
         self.on_peer_connected = on_peer_connected
+        self.on_peer_disconnected = on_peer_disconnected
+        self.conditioner = conditioner
+        self.mesh_enabled = mesh_enabled
+        # gossipsub propagation gating (behaviour validation mode): a
+        # message failing the node's CHEAP structural validation is
+        # delivered locally (for scoring) but NEVER forwarded — invalid
+        # spam must not ride honest nodes deeper into the mesh, and the
+        # penalty must land on the ORIGINAL sender, not on whichever
+        # honest forwarder's frame won a thread race
+        self.forward_gate = forward_gate
         self.deliver = None  # set by join()
         self.local_topics: set[str] = set()
         self.peers: dict[str, _PeerConn] = {}
@@ -271,7 +301,7 @@ class SocketNet:
         mid = message_id(topic_str.encode() + data)
         if self._seen_check_and_add(mid):
             return 0
-        return self._fanout(topic_str, data, exclude=None)
+        return self._fanout(topic_str, data, exclude=None, mid=mid)
 
     def _seen_check_and_add(self, mid: bytes) -> bool:
         """True if `mid` was already seen; otherwise records it and
@@ -475,8 +505,17 @@ class SocketNet:
                 return
             if topic_str in self.local_topics and self.deliver is not None:
                 self.deliver(topic_str, payload, conn.node_id)
-            # flood onward to other interested peers
-            self._fanout(topic_str, payload, exclude=conn.node_id)
+            # flood onward to other interested peers — unless the
+            # node's cheap structural validation rejects the payload
+            # (invalid messages are not propagated; gossipsub's
+            # validate-before-forward contract)
+            if (
+                self.forward_gate is None
+                or self.forward_gate(topic_str, payload)
+            ):
+                self._fanout(
+                    topic_str, payload, exclude=conn.node_id, mid=mid
+                )
         elif kind == KIND_SUB:
             conn.topics.update(json.loads(body).get("topics", []))
         elif kind == KIND_GRAFT:
@@ -503,7 +542,9 @@ class SocketNet:
                 out.append((status, chunks))
                 event.set()
 
-    def _fanout(self, topic_str: str, payload: bytes, exclude) -> int:
+    def _fanout(
+        self, topic_str: str, payload: bytes, exclude, mid: bytes = None
+    ) -> int:
         body = (
             struct.pack("<H", len(topic_str))
             + topic_str.encode()
@@ -512,7 +553,7 @@ class SocketNet:
         with self._mesh_lock:
             mesh = set(self._mesh.get(topic_str, ()))
         mesh.discard(exclude)
-        use_mesh = len(mesh) >= MESH_D_LO
+        use_mesh = self.mesh_enabled and len(mesh) >= MESH_D_LO
         sent = 0
         for conn in list(self.peers.values()):
             if not conn.alive or conn.node_id == exclude:
@@ -525,11 +566,72 @@ class SocketNet:
             if use_mesh and conn.node_id not in mesh:
                 continue
             try:
-                _send_frame(conn.sock, conn.lock, KIND_GOSSIP, body)
-                sent += 1
+                if self._conditioned_send(conn, KIND_GOSSIP, body, mid):
+                    sent += 1
             except OSError:
                 self._drop(conn)
         return sent
+
+    def _conditioned_send(
+        self, conn: _PeerConn, kind: int, body: bytes, mid
+    ) -> bool:
+        """Send one gossip frame through the conditioner (when present):
+        the per-(src, dst, message-id) plan decides copies (0 = drop,
+        2 = duplicate) and a hold count (deliver only after that many
+        LATER frames pass on this pair — delay/reorder without wall
+        clocks). Decisions key on the message id, not a call counter, so
+        thread interleaving between pairs cannot shift the fault
+        sequence — the same (seed, pair, message) always gets the same
+        fate."""
+        cnd = self.conditioner
+        if cnd is None or mid is None:
+            _send_frame(conn.sock, conn.lock, kind, body)
+            return True
+        plan = cnd.plan_gossip(self.node_id, conn.node_id, mid)
+        sent = False
+        ready = []
+        with conn.held_lock:
+            # age PRE-EXISTING holds by this send opportunity first —
+            # a frame held in THIS call must wait for LATER frames,
+            # not release against itself
+            still = []
+            for item in conn.held:
+                item[0] -= 1
+                if item[0] <= 0:
+                    ready.append((item[1], item[2]))
+                else:
+                    still.append(item)
+            conn.held = still
+            if plan.copies:
+                for _ in range(plan.copies):
+                    if plan.hold > 0:
+                        conn.held.append([plan.hold, kind, body])
+                    else:
+                        ready.append((kind, body))
+        for k, b in ready:
+            _send_frame(conn.sock, conn.lock, k, b)
+            sent = True
+        return sent
+
+    def flush_conditioned(self) -> int:
+        """Force-deliver every held (delayed/reordered) frame — the
+        simulator calls this at its slot barrier so a held frame never
+        dangles past the step that produced it. Returns the number of
+        frames released."""
+        flushed = 0
+        for conn in list(self.peers.values()):
+            if not conn.alive:
+                continue
+            with conn.held_lock:
+                ready = [(k, b) for _, k, b in conn.held]
+                conn.held = []
+            try:
+                for k, b in ready:
+                    _send_frame(conn.sock, conn.lock, k, b)
+                    flushed += 1
+            except OSError:
+                self._drop(conn)
+        return flushed
 
     # ------------------------------------------------------------ mesh
 
@@ -540,6 +642,8 @@ class SocketNet:
     def _heartbeat_loop(self):
         while not self._stopping:
             time.sleep(HEARTBEAT_INTERVAL)
+            if not self.mesh_enabled:
+                continue
             try:
                 self._maintain_mesh()
             except Exception as e:
@@ -620,6 +724,11 @@ class SocketNet:
         conn = self.peers.get(peer_id)
         if conn is None or not conn.alive:
             raise RpcError(2, f"peer {peer_id} not connected")
+        if self.conditioner is not None:
+            # partition masks read as unreachability (the wire timeout
+            # shape, immediately — no real waiting); seeded per-pair
+            # stalls ride the same check
+            self.conditioner.check_rpc(self.node_id, peer_id, method)
         with self._req_lock:
             self._req_id += 1
             req_id = self._req_id
@@ -727,6 +836,15 @@ class SocketNet:
             with self._mesh_lock:
                 for mesh in self._mesh.values():
                     mesh.discard(conn.node_id)
+            if self.on_peer_disconnected is not None:
+                try:
+                    self.on_peer_disconnected(conn.node_id)
+                except Exception as e:
+                    # the disconnect hook must not break the read loop
+                    _LOG.warning(
+                        "on_peer_disconnected(%s) failed: %s",
+                        conn.node_id, e,
+                    )
 
     # ---------------------------------------------------------- discovery
 

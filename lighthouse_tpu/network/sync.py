@@ -60,6 +60,7 @@ EPOCHS_PER_BATCH = 2
 STATUS_TTL_SECONDS = 6.0
 MAX_ATTEMPTS_PER_REQUEST = 4  # distinct peers tried per request
 MAX_REQUEUES_PER_RANGE = 3  # failed-batch re-queues before giving up
+MAX_PARENT_CHAIN_DEPTH = 32  # ancestor-walk bound for parent lookups
 MAX_RATE_LIMIT_STRIKES = 3  # consecutive rate-limit answers -> quarantine
 BACKOFF_BASE_SECONDS = 0.02
 BACKOFF_CAP_SECONDS = 1.0
@@ -805,13 +806,34 @@ class SyncManager:
 
     # ------------------------------------------------------ parent lookup
 
-    def lookup_parent(self, parent_root: bytes) -> bool:
-        """Single-block lookup for an unknown parent (block_lookups/),
-        fetching the parent's blob sidecars too when its body commits to
-        blobs — a blob-committing parent can import through the DA gate
-        from req/resp alone. A peer whose returned block fails import is
-        downscored, not silently tolerated."""
+    def lookup_parent(
+        self, parent_root: bytes, _depth: int = 0, _failed=None
+    ) -> bool:
+        """Parent-chain lookup for an unknown parent (block_lookups/):
+        fetch the parent by root, and when the parent ITSELF has an
+        unknown parent, recurse down the ancestor chain (bounded at
+        MAX_PARENT_CHAIN_DEPTH — the reference's parent-lookup chains
+        do the same walk) before importing back up. This is how a node
+        rejoining after a partition/eclipse adopts the other side's
+        branch from one gossip block: the whole fork segment imports
+        oldest-first through this walk. Each level fetches that block's
+        blob sidecars too when its body commits to blobs — a
+        blob-committing ancestor imports through the DA gate from
+        req/resp alone. A peer whose returned block fails import is
+        downscored, not silently tolerated.
+
+        `_failed` memoizes roots that already failed WITHIN one
+        top-level walk: without it, every peer at every depth serving
+        the (hash-verified, so identical) block would re-trigger the
+        full deeper recursion that just failed — O(peers^depth) RPCs
+        from a single old orphan."""
+        if _depth >= MAX_PARENT_CHAIN_DEPTH:
+            return False
         parent_root = bytes(parent_root)
+        if _failed is None:
+            _failed = set()
+        if parent_root in _failed:
+            return False
         da = self.chain.da_checker
         # quarantined peers stay excluded here too — a lookup that
         # cannot be served by any trusted peer fails and retries on the
@@ -853,19 +875,35 @@ class SyncManager:
                 msg = str(e)
                 if "already" in msg:
                     return True
+                if "unknown parent" in msg:
+                    # walk one level deeper down the ancestor chain,
+                    # then retry THIS block on top of it
+                    if self.lookup_parent(
+                        bytes(block.message.parent_root),
+                        _depth=_depth + 1,
+                        _failed=_failed,
+                    ):
+                        try:
+                            self.chain.process_block(block)
+                            return True
+                        except Exception as e2:
+                            _LOG.debug(
+                                "parent retry after chain walk "
+                                "failed: %s", e2,
+                            )
+                    continue
                 if (
-                    "unknown parent" in msg
-                    or "data unavailable" in msg
+                    "data unavailable" in msg
                     or "parent state" in msg
                 ):
-                    # grandparent missing, sidecars unfetchable, or OUR
-                    # pruned state — not provably this peer's fault;
-                    # try another
+                    # sidecars unfetchable, or OUR pruned state — not
+                    # provably this peer's fault; try another
                     continue
                 self._downscore(
                     pid, SCORE_INVALID_MESSAGE, "invalid_parent_block"
                 )
                 continue
+        _failed.add(parent_root)
         return False
 
     def _fetch_lookup_sidecars(self, pid, rpc, root: bytes, block):

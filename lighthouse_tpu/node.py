@@ -149,10 +149,19 @@ class BeaconNode:
             ),
         )
 
-    def attach_socket_net(self, host: str = "127.0.0.1"):
+    def attach_socket_net(
+        self,
+        host: str = "127.0.0.1",
+        conditioner=None,
+        mesh_enabled: bool = True,
+    ):
         """Replace the in-process hub with a real TCP/UDP transport
         (lighthouse_network's role): gossip + RPC cross OS sockets, and
-        every connected peer is registered with the sync manager."""
+        every connected peer is registered with the sync manager — and
+        REMOVED from it when its connection drops (read EOF, send
+        failure, ban), so the sync view never holds a dead proxy.
+        `conditioner`/`mesh_enabled` thread through to SocketNet for
+        the deterministic network simulator (sim/)."""
         from lighthouse_tpu.network.socket_net import SocketNet
 
         net = SocketNet(
@@ -164,6 +173,10 @@ class BeaconNode:
             on_peer_connected=lambda pid: self.sync.add_peer(
                 pid, net.rpc_client(pid)
             ),
+            on_peer_disconnected=lambda pid: self.sync.remove_peer(pid),
+            conditioner=conditioner,
+            mesh_enabled=mesh_enabled,
+            forward_gate=self._gossip_forward_gate,
         )
         self.hub = net.join(self.node_id, self._deliver)
         # req/resp peer scoring follows the transport swap
@@ -189,6 +202,30 @@ class BeaconNode:
 
     def _topic_name(self, topic_str: str) -> str:
         return topic_str.split("/")[3]
+
+    def _gossip_forward_gate(self, topic_str: str, data: bytes) -> bool:
+        """Cheap STATELESS structural validation gating gossip
+        propagation (gossipsub validate-before-forward): a blob sidecar
+        with an out-of-range index or a slot beyond the clock horizon is
+        provably junk — it is still delivered locally (so the sender
+        pays the score), but an honest node must not carry it deeper
+        into the mesh. Everything else forwards; the full (stateful,
+        pairing-backed) validation stays on the processor path. The
+        sidecar decodes once more here than on the deliver path — the
+        seen-cache bounds that to once per message per node, the price
+        of keeping the deliver contract untouched."""
+        name = self._topic_name(topic_str)
+        if not name.startswith("blob_sidecar"):
+            return True
+        try:
+            sidecar = self.chain.t.BlobSidecar.decode(decode_gossip(data))
+        # lint: allow(except-swallow): the False verdict IS the handling
+        except Exception:  # — undecodable spam must not propagate
+            return False
+        if int(sidecar.index) >= self.spec.MAX_BLOBS_PER_BLOCK:
+            return False
+        horizon = self.chain.current_slot() + self.spec.SLOTS_PER_EPOCH
+        return int(sidecar.signed_block_header.message.slot) <= horizon
 
     def _deliver(self, topic_str: str, data: bytes, from_peer: str):
         name = self._topic_name(topic_str)

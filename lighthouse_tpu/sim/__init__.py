@@ -1,0 +1,45 @@
+"""Deterministic multi-node network simulator.
+
+The reference ships a whole deterministic-simulation plane
+(testing/antithesis/, testing/simulator/) because consensus correctness
+only shows up at network scale: aggregate-signature protocols must hold
+under adversarial delivery ("One For All", PAPERS.md). This package is
+that plane for lighthouse_tpu:
+
+  * `conditioner`  — seeded per-directed-peer-pair drop/delay/reorder/
+    duplicate plus schedulable partition masks, layered into
+    `network/socket_net.py`'s outbound edge;
+  * `scenario`     — the declarative scenario spec (nodes, validator
+    split, fault timeline) with a committed JSON library under
+    `scenarios/`;
+  * `orchestrator` — boots 5-10 in-process BeaconNodes over real TCP
+    sockets on a deterministic slot clock and executes the timeline;
+  * `invariants`   — honest-head convergence, exactly-once imports, DA
+    completeness, bounded/ordered peer scores, no-quarantine-of-honest
+    — asserted ONLY through `GET /lighthouse/events`,
+    `GET /lighthouse/health`, and registry snapshot diffs;
+  * `verdict`      — canonical (replay-comparable) journal export and
+    the JSONL verdict artifact `scripts/sim.py` writes.
+
+Every run replays from one seed: re-running a scenario produces a
+byte-identical canonical journal (the seed-determinism gate in
+tests/test_sim.py).
+"""
+
+from lighthouse_tpu.sim.conditioner import NetworkConditioner
+from lighthouse_tpu.sim.scenario import (
+    Scenario,
+    ScenarioError,
+    load_scenario,
+    scenario_library,
+)
+from lighthouse_tpu.sim.orchestrator import Simulation
+
+__all__ = [
+    "NetworkConditioner",
+    "Scenario",
+    "ScenarioError",
+    "Simulation",
+    "load_scenario",
+    "scenario_library",
+]

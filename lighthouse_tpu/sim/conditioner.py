@@ -1,0 +1,196 @@
+"""Seeded network conditioner: the adversarial-delivery edge of the sim.
+
+Sits on `SocketNet`'s outbound path (gossip frames and RPC calls) and
+decides, per directed peer pair, whether a message is delivered, dropped,
+duplicated, delayed, or reordered — plus hard partition masks the
+scenario timeline schedules (split-brain partitions, eclipse of one
+node, offline windows).
+
+Determinism contract: every gossip decision is a pure function of
+``(seed, src, dst, message_id)`` and every RPC decision a pure function
+of ``(seed, src, dst, method, per-pair call index)``. Gossip keys on the
+MESSAGE ID rather than a call counter on purpose — forwarding order
+between threads can differ run to run (whichever reader thread delivers
+first forwards first), but the same message on the same pair always
+draws the same fate, so the DELIVERY OUTCOME of the whole flood is
+replayable from the seed alone. RPC calls are issued sequentially from
+the orchestrator-driven sync path, so a per-pair counter is already
+deterministic there.
+
+Delay/reorder carry no wall clock: a held frame is released after N
+later frames pass on the pair (``hold`` in the plan), and the simulator
+force-flushes holds at every slot barrier.
+"""
+
+import random
+import threading
+from dataclasses import dataclass, field
+
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.network.rpc import RpcError
+
+_ACTIONS = REGISTRY.counter_vec(
+    "lighthouse_tpu_sim_conditioner_actions_total",
+    "network-conditioner decisions on outbound gossip frames "
+    "(deliver|drop|duplicate|delay|reorder|partition_block)",
+    ("action",),
+)
+_RPC_FAULTS = REGISTRY.counter_vec(
+    "lighthouse_tpu_sim_rpc_faults_total",
+    "network-conditioner decisions on outbound RPC calls "
+    "(partition_block|stall)",
+    ("kind",),
+)
+
+# `status` is exempt from seeded stalls (partition masks still apply):
+# the sync manager's status cache refreshes on a wall-clock TTL, so the
+# NUMBER of status calls varies run to run — letting them consume seeded
+# fault draws would leak wall-clock timing into the replay.
+RPC_STALL_EXEMPT = frozenset({"status"})
+
+
+@dataclass
+class GossipPlan:
+    """What to do with one outbound gossip frame: send `copies` of it
+    (0 = drop, 2 = duplicate), each after `hold` later frames have
+    passed on the pair (0 = immediately)."""
+
+    copies: int = 1
+    hold: int = 0
+
+
+@dataclass
+class PairPolicy:
+    """Per-directed-pair fault rates (probabilities per message/call)."""
+
+    drop_rate: float = 0.0
+    duplicate_rate: float = 0.0
+    delay_rate: float = 0.0
+    reorder_rate: float = 0.0
+    rpc_stall_rate: float = 0.0
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "PairPolicy":
+        return cls(**{
+            k: float(doc[k])
+            for k in (
+                "drop_rate", "duplicate_rate", "delay_rate",
+                "reorder_rate", "rpc_stall_rate",
+            )
+            if k in doc
+        })
+
+
+@dataclass
+class NetworkConditioner:
+    seed: int = 0
+    default: PairPolicy = field(default_factory=PairPolicy)
+    # (src, dst) -> PairPolicy overrides
+    pairs: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        # partition state: list of frozensets; nodes absent from every
+        # group form one implicit extra group
+        self._groups: list = []
+        self._isolated: set = set()
+        self._offline: set = set()
+        self._rpc_counts: dict = {}
+
+    # -------------------------------------------------------- masks
+
+    def set_partition(self, groups):
+        """Schedule a partition: traffic crosses only WITHIN a group."""
+        with self._lock:
+            self._groups = [frozenset(g) for g in groups]
+
+    def clear_partition(self):
+        with self._lock:
+            self._groups = []
+
+    def isolate(self, node_id: str):
+        """Eclipse `node_id`: block every pair that touches it."""
+        with self._lock:
+            self._isolated.add(node_id)
+
+    def release(self, node_id: str):
+        with self._lock:
+            self._isolated.discard(node_id)
+
+    def set_offline(self, node_id: str, offline: bool):
+        """An offline node is unreachable in BOTH directions (its
+        sockets are also closed by the orchestrator; the mask keeps
+        stragglers deterministic)."""
+        with self._lock:
+            if offline:
+                self._offline.add(node_id)
+            else:
+                self._offline.discard(node_id)
+
+    def blocked(self, src: str, dst: str) -> bool:
+        with self._lock:
+            if src in self._offline or dst in self._offline:
+                return True
+            if src in self._isolated or dst in self._isolated:
+                return True
+            if self._groups:
+                g_src = next(
+                    (g for g in self._groups if src in g), None
+                )
+                g_dst = next(
+                    (g for g in self._groups if dst in g), None
+                )
+                # absent nodes share the implicit remainder group (None)
+                if g_src is not g_dst:
+                    return True
+        return False
+
+    # ------------------------------------------------------ decisions
+
+    def _policy(self, src: str, dst: str) -> PairPolicy:
+        return self.pairs.get((src, dst), self.default)
+
+    def plan_gossip(self, src: str, dst: str, mid: bytes) -> GossipPlan:
+        if self.blocked(src, dst):
+            _ACTIONS.labels("partition_block").inc()
+            return GossipPlan(copies=0)
+        pol = self._policy(src, dst)
+        rng = random.Random(f"{self.seed}:g:{src}>{dst}:{mid.hex()}")
+        r = rng.random()
+        edge = pol.drop_rate
+        if r < edge:
+            _ACTIONS.labels("drop").inc()
+            return GossipPlan(copies=0)
+        edge += pol.duplicate_rate
+        if r < edge:
+            _ACTIONS.labels("duplicate").inc()
+            return GossipPlan(copies=2)
+        edge += pol.delay_rate
+        if r < edge:
+            _ACTIONS.labels("delay").inc()
+            return GossipPlan(copies=1, hold=rng.randrange(2, 4))
+        edge += pol.reorder_rate
+        if r < edge:
+            _ACTIONS.labels("reorder").inc()
+            return GossipPlan(copies=1, hold=1)
+        _ACTIONS.labels("deliver").inc()
+        return GossipPlan()
+
+    def check_rpc(self, src: str, dst: str, method: str):
+        """Raise the fault (if any) for this outbound RPC call. Raises
+        RpcError(2, ...) — the wire timeout shape — for partition
+        blocks and seeded stalls; returns None to let the call through."""
+        if self.blocked(src, dst):
+            _RPC_FAULTS.labels("partition_block").inc()
+            raise RpcError(2, f"sim: {src}->{dst} partitioned")
+        pol = self._policy(src, dst)
+        if pol.rpc_stall_rate <= 0 or method in RPC_STALL_EXEMPT:
+            return
+        with self._lock:
+            key = (src, dst, method)
+            n = self._rpc_counts.get(key, 0)
+            self._rpc_counts[key] = n + 1
+        rng = random.Random(f"{self.seed}:r:{src}>{dst}:{method}:{n}")
+        if rng.random() < pol.rpc_stall_rate:
+            _RPC_FAULTS.labels("stall").inc()
+            raise RpcError(2, f"sim: injected stall on {method}")

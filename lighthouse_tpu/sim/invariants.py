@@ -1,0 +1,346 @@
+"""Invariant checker library: chaos claims proven from the
+observability plane ONLY.
+
+Every check in this module reads exactly three surfaces:
+
+  * ``GET /lighthouse/events``  — per-object forensic journal queries,
+  * ``GET /lighthouse/health``  — per-node head/finality/peers/DA view,
+  * ``Registry.snapshot()`` diffs — process-wide counter deltas,
+
+never node internals (the `test_chaos_forensics_via_observability_plane`
+pattern, PR 6). A violation is a human-readable string; a clean run
+returns []. The orchestrator records node-life metadata (anchors,
+restart slots, eclipse windows) as DRIVING context — checks use it only
+to decide what a node should be held accountable for, while the
+evidence itself always comes from the three surfaces above.
+"""
+
+import json
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+
+from lighthouse_tpu.network.gossip import BAN_THRESHOLD
+
+
+@dataclass
+class SimContext:
+    scenario: object
+    nodes: dict                      # name -> SimNode
+    snapshot_before: dict
+    snapshot_after: dict
+    blob_blocks: dict                # "0x…" root -> n blobs
+    eclipse_windows: dict            # name -> (at_slot, until_slot)
+    _health_cache: dict = field(default_factory=dict)
+
+    # --------------------------------------------- plane accessors
+
+    def _get(self, name: str, path: str) -> dict:
+        url = self.nodes[name].base_url() + path
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.loads(r.read())
+
+    def health(self, name: str) -> dict:
+        if name not in self._health_cache:
+            self._health_cache[name] = self._get(
+                name, "/lighthouse/health"
+            )["data"]
+        return self._health_cache[name]
+
+    def events(self, name: str, **query) -> list:
+        qs = urllib.parse.urlencode(
+            {k: v for k, v in query.items() if v is not None}
+        )
+        return self._get(name, f"/lighthouse/events?{qs}")["data"]
+
+    def diff(self, series: str) -> float:
+        return self.snapshot_after.get(series, 0) - (
+            self.snapshot_before.get(series, 0)
+        )
+
+    def diff_family(self, prefix: str) -> float:
+        total = 0.0
+        for key, after in self.snapshot_after.items():
+            if key.startswith(prefix):
+                total += after - self.snapshot_before.get(key, 0)
+        return total
+
+    # --------------------------------------------- node classification
+
+    def honest_online(self) -> list:
+        return [
+            name
+            for name, sn in sorted(self.nodes.items())
+            if sn.index is not None and sn.online
+        ]
+
+    def adversaries(self) -> list:
+        return [
+            name
+            for name, sn in sorted(self.nodes.items())
+            if sn.index is None
+        ]
+
+
+# ------------------------------------------------------------ invariants
+
+
+def honest_convergence(ctx: SimContext) -> list:
+    """Every honest online node ends on the same head, close to the
+    final slot."""
+    out = []
+    heads = {}
+    for name in ctx.honest_online():
+        h = ctx.health(name)["head"]
+        heads[name] = (h["root"], h["slot"])
+        if h["slot"] < ctx.scenario.slots - 2:
+            out.append(
+                f"{name}: head slot {h['slot']} lags the run end "
+                f"({ctx.scenario.slots})"
+            )
+    roots = {r for r, _ in heads.values()}
+    if len(roots) > 1:
+        out.append(f"honest heads diverge: {heads}")
+    return out
+
+
+def exactly_once_imports(ctx: SimContext) -> list:
+    """No node-life imports the same block twice (gossip, sync, and
+    DA-release paths share one journaled terminal)."""
+    out = []
+    for name in ctx.honest_online():
+        seen = {}
+        for ev in ctx.events(
+            name, kind="block_import", outcome="imported"
+        ):
+            root = ev.get("root")
+            seen[root] = seen.get(root, 0) + 1
+        dups = {r: n for r, n in seen.items() if n > 1}
+        if dups:
+            out.append(f"{name}: blocks imported more than once: {dups}")
+    return out
+
+
+def da_completeness(ctx: SimContext) -> list:
+    """Every blob-carrying block a node imported through the DA gate
+    shows each of its sidecars individually verified. Blocks below a
+    checkpoint anchor were backfilled (blocks-only, the blob-retention
+    contract) and are exempt for that node — the block's slot is read
+    from whichever node's journal records its import, so a restarted
+    node with NO record of a pre-anchor block is exempt too."""
+    out = []
+    # root -> slot, learned from any honest node's import record
+    block_slots = {}
+    for name in ctx.honest_online():
+        for root_hex in ctx.blob_blocks:
+            if root_hex in block_slots:
+                continue
+            for ev in ctx.events(
+                name, root=root_hex, kind="block_import",
+                outcome="imported",
+            ):
+                if ev.get("slot") is not None:
+                    block_slots[root_hex] = ev["slot"]
+                    break
+    for name in ctx.honest_online():
+        sn = ctx.nodes[name]
+        for root_hex, n in sorted(ctx.blob_blocks.items()):
+            blk_slot = block_slots.get(root_hex)
+            if blk_slot is not None and blk_slot <= sn.anchor_slot:
+                continue  # backfilled history: no DA required
+            imports = ctx.events(
+                name, root=root_hex, kind="block_import",
+                outcome="imported",
+            )
+            if not imports:
+                out.append(f"{name}: blob block {root_hex} not imported")
+                continue
+            verified = ctx.events(
+                name, root=root_hex, kind="sidecar", outcome="verified"
+            )
+            indices = {e["attrs"]["index"] for e in verified}
+            if len(indices) < n:
+                out.append(
+                    f"{name}: blob block {root_hex} has "
+                    f"{len(indices)}/{n} sidecars verified"
+                )
+        da = ctx.health(name)["da"]
+        if da["held_blocks"]:
+            out.append(
+                f"{name}: {da['held_blocks']} blocks still DA-held at "
+                "run end"
+            )
+    return out
+
+
+def bounded_scores(ctx: SimContext) -> list:
+    """Peer scores stay bounded and ORDERED: honest peers never fall to
+    the ban threshold; no node ranks an adversary above its honest
+    peers; and every adversary was actually PRICED by at least one node
+    it abused — scored strictly below that node's honest floor, or
+    banned outright (absent from the peer table while honest peers
+    remain). A node the adversary never abused (e.g. one that
+    reconnected after the flood window) may legitimately hold it at a
+    fresh zero."""
+    out = []
+    adversaries = set(ctx.adversaries())
+    honest = set(ctx.honest_online())
+    priced = {a: False for a in adversaries}
+    for name in ctx.honest_online():
+        peers = ctx.health(name)["peers"]
+        scores = (peers.get("scores") or {}).get("by_peer") or {}
+        honest_scores = {
+            p: s for p, s in scores.items() if p in honest
+        }
+        for p, s in honest_scores.items():
+            if s <= BAN_THRESHOLD:
+                out.append(
+                    f"{name}: honest peer {p} at ban threshold ({s})"
+                )
+        if not honest_scores:
+            continue
+        floor = min(honest_scores.values())
+        for adv in adversaries:
+            if adv not in scores:
+                # adversary banned/disconnected while honest peers
+                # remain: the strongest form of pricing
+                priced[adv] = True
+                continue
+            if scores[adv] > floor:
+                out.append(
+                    f"{name}: adversary {adv} score {scores[adv]} "
+                    f"above honest floor {floor}"
+                )
+            if scores[adv] < floor:
+                priced[adv] = True
+    for adv, ok in sorted(priced.items()):
+        if not ok:
+            out.append(
+                f"adversary {adv} was never priced below any node's "
+                "honest floor"
+            )
+    return out
+
+
+def no_honest_quarantine(ctx: SimContext) -> list:
+    out = []
+    honest = set(ctx.honest_online())
+    for name in ctx.honest_online():
+        quarantined = set(
+            ctx.health(name)["peers"].get("quarantined", [])
+        )
+        bad = quarantined & honest
+        if bad:
+            out.append(f"{name}: quarantined honest peers {sorted(bad)}")
+    return out
+
+
+def eclipse_rejoin(ctx: SimContext) -> list:
+    """An eclipsed node must show, in its OWN journal, imports covering
+    the eclipse window that happened only after the lift event."""
+    out = []
+    for name, (at, until) in sorted(ctx.eclipse_windows.items()):
+        lifts = ctx.events(
+            name, kind="sim_fault", outcome="eclipse_lifted"
+        )
+        if not lifts:
+            out.append(f"{name}: no eclipse_lifted event journaled")
+            continue
+        lift_seq = lifts[0]["seq"]
+        caught_up = [
+            ev
+            for ev in ctx.events(
+                name, kind="block_import", outcome="imported"
+            )
+            if ev["seq"] > lift_seq and at <= ev.get("slot", -1) < until
+        ]
+        if not caught_up:
+            out.append(
+                f"{name}: no post-lift imports covering the eclipse "
+                f"window [{at}, {until})"
+            )
+        head = ctx.health(name)["head"]
+        honest_heads = {
+            ctx.health(n)["head"]["root"]
+            for n in ctx.honest_online()
+            if n != name
+        }
+        if honest_heads and head["root"] not in honest_heads:
+            out.append(f"{name}: head did not rejoin the honest chain")
+    return out
+
+
+def spam_priced(ctx: SimContext) -> list:
+    """The spam flood was absorbed by the pricing surfaces: the DA
+    candidate cache stayed within its caps and the RPC token buckets
+    actually rate-limited the flood."""
+    out = []
+    spam = ctx.diff_family("lighthouse_tpu_sim_spam_messages_total")
+    if spam <= 0:
+        out.append("spam flood scheduled but no spam was emitted")
+    for name in ctx.honest_online():
+        da = ctx.health(name)["da"]
+        if da["pending_entries"] > 512:
+            out.append(
+                f"{name}: DA pending entries {da['pending_entries']} "
+                "exceed the cache cap"
+            )
+    if any(f.kind == "rpc_flood" for f in ctx.scenario.faults):
+        limited = ctx.diff_family(
+            'lighthouse_tpu_rpc_requests_total{method="status",'
+            'outcome="rate_limited"}'
+        )
+        if limited <= 0:
+            out.append(
+                "rpc flood ran but no request was rate-limited "
+                "(token buckets never priced it)"
+            )
+    return out
+
+
+def faults_fired(ctx: SimContext) -> list:
+    """A chaos run that injected nothing tests nothing: at least one
+    non-deliver conditioner action (or partition block) must have
+    fired."""
+    injected = 0.0
+    for action in (
+        "drop", "duplicate", "delay", "reorder", "partition_block"
+    ):
+        injected += ctx.diff(
+            "lighthouse_tpu_sim_conditioner_actions_total"
+            f'{{action="{action}"}}'
+        )
+    injected += ctx.diff_family("lighthouse_tpu_sim_rpc_faults_total")
+    if injected <= 0:
+        return ["no conditioner fault fired during the run"]
+    return []
+
+
+def finalized(ctx: SimContext) -> list:
+    out = []
+    for name in ctx.honest_online():
+        fin = ctx.health(name)["head"]["finalized_epoch"]
+        if fin < 1:
+            out.append(f"{name}: finalized epoch {fin} < 1")
+    return out
+
+
+CHECKS = {
+    "honest_convergence": honest_convergence,
+    "exactly_once_imports": exactly_once_imports,
+    "da_completeness": da_completeness,
+    "bounded_scores": bounded_scores,
+    "no_honest_quarantine": no_honest_quarantine,
+    "eclipse_rejoin": eclipse_rejoin,
+    "spam_priced": spam_priced,
+    "faults_fired": faults_fired,
+    "finalized": finalized,
+}
+
+
+def check_all(ctx: SimContext, names) -> list:
+    violations = []
+    for name in names:
+        for msg in CHECKS[name](ctx):
+            violations.append(f"[{name}] {msg}")
+    return violations

@@ -1,0 +1,793 @@
+"""Simulation orchestrator: N in-process beacon nodes over real TCP
+sockets on a deterministic slot clock, executing a scenario's fault
+timeline.
+
+Role of the reference's `testing/simulator` (n beacon nodes + validator
+clients in one process over real libp2p) crossed with its Antithesis
+deterministic-simulation campaigns: every node is a full `BeaconNode`
+(chain, DA checker, sync manager, beacon processor, HTTP API) attached
+to a `SocketNet` whose outbound edge runs through one shared seeded
+`NetworkConditioner`. The orchestrator drives the slot clock in
+LOCKSTEP — publish, settle (socket quiescence + conditioner hold
+flush), drain — so the only nondeterminism left is thread interleaving
+WITHIN a step, which the canonical journal projection (verdict.py)
+normalizes away.
+
+Validator split: validator v belongs to node ``v % nodes``. Each node
+proposes on ITS OWN head when it owns the proposer (so partitions
+genuinely fork the chain), attests with its own validators on its own
+head, and self-aggregates its naive-pool aggregates into its op pool
+(the in-process stand-in for the aggregate gossip plane).
+
+Driving uses chain/node methods freely — it is the test rig. ASSERTIONS
+never do: invariants.py reads only /lighthouse/events,
+/lighthouse/health, and registry snapshot diffs.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+from lighthouse_tpu import bls, kzg, ssz
+from lighthouse_tpu.common.logging import get_logger
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.node import BeaconNode
+from lighthouse_tpu.sim.conditioner import (
+    NetworkConditioner,
+    PairPolicy,
+)
+from lighthouse_tpu.state_processing.genesis import interop_genesis_state
+from lighthouse_tpu.types.helpers import (
+    compute_domain,
+    compute_signing_root,
+)
+from lighthouse_tpu.types.spec import minimal_spec
+
+_LOG = get_logger("sim")
+
+_SPAM_TOTAL = REGISTRY.counter_vec(
+    "lighthouse_tpu_sim_spam_messages_total",
+    "adversarial messages emitted by simulator fault actors "
+    "(gossip_sidecar|gossip_sidecar_invalid|rpc_burst)",
+    ("kind",),
+)
+_SLOTS_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_sim_slots_total",
+    "simulated slots driven across all scenario runs",
+)
+_RUNS_TOTAL = REGISTRY.counter_vec(
+    "lighthouse_tpu_sim_runs_total",
+    "scenario runs, by outcome (ok|violations)",
+    ("outcome",),
+)
+
+SETTLE_POLL_S = 0.015
+SETTLE_STABLE_POLLS = 4
+SETTLE_TIMEOUT_S = 8.0
+CONNECT_TIMEOUT_S = 5.0
+
+
+def _deterministic_blob(spec, seed: int) -> bytes:
+    """A canonical blob (every field element < the BLS modulus)."""
+    return b"".join(
+        ((seed * 31 + i + 1) % 1009).to_bytes(32, "big")
+        for i in range(spec.FIELD_ELEMENTS_PER_BLOB)
+    )
+
+
+class SimNode:
+    """One simulated participant: a full BeaconNode + its transport."""
+
+    def __init__(self, name: str, index: int | None):
+        self.name = name
+        self.index = index  # None for validator-less adversaries
+        self.node = None
+        self.net = None
+        self.api = None
+        self.online = True
+        self.anchor_slot = 0        # > 0 after a checkpoint restart
+        self.restart_slots: list = []
+        self.produced_slots: list = []
+        self.kv_path = None
+        # journals of previous node lives (archived at crash/offline)
+        self.journal_archives: list = []
+
+    @property
+    def chain(self):
+        return self.node.chain
+
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.api.port}"
+
+    def archive_journal(self):
+        if self.node is not None:
+            self.journal_archives.append(
+                self.node.chain.journal.query()
+            )
+
+
+class Simulation:
+    def __init__(self, scenario, workdir: str | None = None):
+        self.scenario = scenario
+        self.workdir = workdir
+        self.spec = minimal_spec(**scenario.spec_overrides)
+        self.keypairs = bls.interop_keypairs(scenario.validators)
+        self.genesis = interop_genesis_state(
+            [kp.pk.to_bytes() for kp in self.keypairs], 0, self.spec
+        )
+        self.gvr = bytes(self.genesis.genesis_validators_root)
+        self.conditioner = NetworkConditioner(
+            seed=scenario.seed,
+            default=PairPolicy.from_dict(scenario.conditioner),
+        )
+        self.nodes: list[SimNode] = []
+        self.blob_blocks: dict = {}   # root hex -> n_blobs
+        self.eclipse_windows: dict = {}  # name -> (at, until)
+        self._slot = 0
+
+    # ------------------------------------------------------------- build
+
+    def _boot_node(self, sn: SimNode, genesis_state, anchor_block=None,
+                   kv=None):
+        sn.node = BeaconNode(
+            sn.name,
+            genesis_state,
+            self.spec,
+            backend=self.scenario.backend,
+            kv=kv,
+            anchor_block=anchor_block,
+        )
+        sn.node.chain.journal.configure(
+            capacity=self.scenario.journal_capacity
+        )
+        # deterministic sync: no real backoff sleeps, scenario-seeded
+        # jitter, and the scenario seed keying every retry schedule
+        sn.node.sync._sleep = lambda s: None
+        sn.node.sync._rng_seed = self.scenario.seed
+        sn.net = sn.node.attach_socket_net(
+            conditioner=self.conditioner, mesh_enabled=False
+        )
+        self._subscribe_all_subnets(sn)
+        sn.api = sn.node.start_http_api()
+        sn.online = True
+
+    def _subscribe_all_subnets(self, sn: SimNode):
+        """Full-custody attestation subnets: the sim floods singles on
+        their committee subnets and every node follows all of them (the
+        deterministic stand-in for duty-driven subscriptions)."""
+        from lighthouse_tpu.network.gossip import topic
+        from lighthouse_tpu.network.subnet_service import (
+            subnet_topic_name,
+        )
+
+        for i in range(self.spec.ATTESTATION_SUBNET_COUNT):
+            sn.net.subscribe(
+                sn.name, topic(sn.node.fork_digest, subnet_topic_name(i))
+            )
+
+    def _kv_for(self, index: int):
+        """A durable store for nodes a kv_crash fault targets (native
+        WAL kv when buildable, sqlite otherwise)."""
+        if self.workdir is None:
+            return None, None
+        path = os.path.join(self.workdir, f"node{index}.kv")
+        from lighthouse_tpu.native import kvstore
+
+        if kvstore.available():
+            return kvstore.NativeKVStore(path), path
+        from lighthouse_tpu.store import SqliteStore
+
+        return SqliteStore(path), path
+
+    def _build(self):
+        sc = self.scenario
+        crash_targets = {
+            sc.node_name(f.node)
+            for f in sc.faults
+            if f.kind == "kv_crash"
+        }
+        for i in range(sc.nodes):
+            sn = SimNode(f"node{i}", i)
+            kv = None
+            if sn.name in crash_targets:
+                kv, sn.kv_path = self._kv_for(i)
+            self._boot_node(sn, self.genesis.copy(), kv=kv)
+            self.nodes.append(sn)
+        for name in sc.adversaries:
+            sn = SimNode(name, None)
+            self._boot_node(sn, self.genesis.copy())
+            self.nodes.append(sn)
+        # full mesh, dialed in fixed order; every dial is confirmed
+        # (both sync views updated) before the next one so the peer
+        # tables — and everything iterating them — are replay-stable
+        for i, a in enumerate(self.nodes):
+            for b in self.nodes[i + 1:]:
+                a.net.connect(self.net_host(b), b.net.tcp_port)
+                self._await_peers(a, b)
+
+    @staticmethod
+    def net_host(sn: SimNode) -> str:
+        return sn.net.host
+
+    def _await_peers(self, a: SimNode, b: SimNode):
+        deadline = time.monotonic() + CONNECT_TIMEOUT_S
+        while time.monotonic() < deadline:
+            if (
+                b.name in a.node.sync.peers
+                and a.name in b.node.sync.peers
+            ):
+                return
+            time.sleep(0.005)
+        raise RuntimeError(
+            f"sim: {a.name}<->{b.name} connection not confirmed"
+        )
+
+    def _connect_to_online(self, sn: SimNode):
+        for other in self.nodes:
+            if other is sn or not other.online:
+                continue
+            sn.net.connect(self.net_host(other), other.net.tcp_port)
+            self._await_peers(sn, other)
+
+    # ----------------------------------------------------------- helpers
+
+    def _online(self):
+        return [sn for sn in self.nodes if sn.online]
+
+    def _honest_online(self):
+        return [
+            sn for sn in self._online() if sn.index is not None
+        ]
+
+    def _owner(self, validator_index: int) -> str:
+        return f"node{validator_index % self.scenario.nodes}"
+
+    def _sign(self, kp, domain_type: bytes, epoch: int, root: bytes):
+        domain = compute_domain(
+            domain_type, self.spec.fork_version_at_epoch(epoch), self.gvr
+        )
+        return kp.sk.sign(compute_signing_root(root, domain)).to_bytes()
+
+    def _emit_all(self, slot: int, outcome: str, **attrs):
+        """Land one sim_fault event in every ONLINE node's journal, so
+        each forensic record is self-describing about the fault
+        timeline it lived through."""
+        for sn in self._online():
+            sn.chain.journal.emit(
+                "sim_fault", slot=slot, outcome=outcome, **attrs
+            )
+
+    # ----------------------------------------------------- settle / drain
+
+    def _settle(self):
+        """Barrier: flush conditioner holds and wait until every online
+        node's work queues have been stable for a few polls — i.e. the
+        sockets have gone quiet for this step."""
+        stable = 0
+        last = None
+        deadline = time.monotonic() + SETTLE_TIMEOUT_S
+        while stable < SETTLE_STABLE_POLLS:
+            flushed = 0
+            for sn in self._online():
+                flushed += sn.net.flush_conditioned() or 0
+            cur = tuple(
+                (
+                    sn.name,
+                    tuple(sorted(
+                        sn.node.processor.queue_depths().items()
+                    )),
+                    sn.node.processor.metrics["processed"],
+                    sn.node.processor.metrics["dropped"],
+                )
+                for sn in self._online()
+            )
+            if flushed == 0 and cur == last:
+                stable += 1
+            else:
+                stable = 0
+            last = cur
+            if time.monotonic() > deadline:
+                _LOG.warning("sim settle barrier timed out")
+                return
+            time.sleep(SETTLE_POLL_S)
+
+    def _drain(self, sn: SimNode):
+        """Drain a node's processor, tolerating handler errors (they are
+        journaled as handler_error; the queue keeps moving)."""
+        guard = 0
+        while guard < 10_000:
+            guard += 1
+            try:
+                if sn.node.processor.process_pending() == 0:
+                    return
+            except Exception as e:
+                _LOG.debug("%s drain handler error: %s", sn.name, e)
+
+    def _drain_all(self):
+        for sn in self._online():
+            self._drain(sn)
+
+    # -------------------------------------------------------- block plane
+
+    def _proposer_at(self, sn: SimNode, slot: int):
+        epoch = self.spec.slot_to_epoch(slot)
+        proposers = sn.chain.proposers_for_epoch(epoch)
+        return proposers[slot - self.spec.epoch_start_slot(epoch)]
+
+    def _propose(self, sn: SimNode, slot: int):
+        sc = self.scenario
+        epoch = self.spec.slot_to_epoch(slot)
+        proposer = self._proposer_at(sn, slot)
+        kp = self.keypairs[proposer]
+        reveal = self._sign(
+            kp,
+            self.spec.DOMAIN_RANDAO,
+            epoch,
+            ssz.uint64.hash_tree_root(epoch),
+        )
+        blobs = []
+        comms = []
+        if slot in sc.blob_slots:
+            blobs = [
+                _deterministic_blob(self.spec, slot * 16 + i)
+                for i in range(2)
+            ]
+            comms = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+        try:
+            block = sn.chain.produce_block_unsigned(
+                slot, reveal, blob_kzg_commitments=comms
+            )
+        except Exception as e:
+            _LOG.warning("%s production at %d failed: %s", sn.name, slot, e)
+            return
+        fork = self.spec.fork_name_at_epoch(epoch)
+        block_cls = type(block)
+        sig = self._sign(
+            kp,
+            self.spec.DOMAIN_BEACON_PROPOSER,
+            epoch,
+            block_cls.hash_tree_root(block),
+        )
+        signed = sn.chain.t.signed_block_classes[fork](
+            message=block, signature=sig
+        )
+        sidecars = []
+        if blobs:
+            from lighthouse_tpu.harness import Harness
+
+            sidecars = Harness.make_blob_sidecars(
+                _TypesShim(sn.chain.t), signed, blobs
+            )
+            # own sidecars first so the producer's own import settles
+            for scd in sidecars:
+                try:
+                    sn.chain.process_blob_sidecar(scd)
+                except Exception as e:
+                    _LOG.debug("own sidecar skipped: %s", e)
+        try:
+            sn.chain.process_block(signed)
+        except Exception as e:
+            _LOG.warning("%s own block at %d failed: %s", sn.name, slot, e)
+            return
+        sn.produced_slots.append(slot)
+        if blobs:
+            # tracked only once the block actually entered the network
+            # — a failed own-import must not leave a phantom entry the
+            # da_completeness invariant would hold every node to
+            root = type(block).hash_tree_root(block)
+            self.blob_blocks["0x" + root.hex()] = len(blobs)
+        sn.node.publish_block(signed)
+        for scd in sidecars:
+            sn.node.publish_blob_sidecar(scd)
+
+    # -------------------------------------------------- attestation plane
+
+    def _attest(self, sn: SimNode, slot: int):
+        """Every validator this node owns signs a single-bit attestation
+        on the node's OWN head and gossips it on its committee subnet."""
+        chain = sn.chain
+        epoch = self.spec.slot_to_epoch(slot)
+        t = chain.t
+        try:
+            cps = chain.committees_per_slot_at(epoch)
+        except Exception as e:
+            _LOG.debug("%s committees at %d unavailable: %s",
+                       sn.name, slot, e)
+            return
+        for index in range(cps):
+            try:
+                data = chain.produce_attestation_data(slot, index)
+                committee = chain.committee_for(data)
+            except Exception as e:
+                _LOG.debug("%s attest (%d,%d) skipped: %s",
+                           sn.name, slot, index, e)
+                continue
+            root = t.AttestationData.hash_tree_root(data)
+            for pos, v in enumerate(committee):
+                if self._owner(int(v)) != sn.name:
+                    continue
+                sig = self._sign(
+                    self.keypairs[int(v)],
+                    self.spec.DOMAIN_BEACON_ATTESTER,
+                    int(data.target.epoch),
+                    root,
+                )
+                att = t.Attestation(
+                    aggregation_bits=[
+                        i == pos for i in range(len(committee))
+                    ],
+                    data=data,
+                    signature=sig,
+                )
+                sn.node.publish_attestation(att)
+                # the attester's own node hears its own vote
+                sn.node.processor.submit(
+                    "gossip_attestation", (att, sn.name)
+                )
+
+    def _self_aggregate(self, sn: SimNode, slot: int):
+        """Aggregate-plane stand-in: what the node's naive pool built
+        for `slot` becomes op-pool material for ITS next proposal."""
+        for agg in sn.chain.naive_pool.aggregates_at_slot(slot):
+            sn.chain.op_pool.insert_attestation(agg)
+
+    # ----------------------------------------------------------- timeline
+
+    def _apply_timeline(self, slot: int):
+        for f in self.scenario.faults:
+            if f.at_slot == slot:
+                self._start_fault(f, slot)
+            if f.until_slot == slot:
+                self._end_fault(f, slot)
+
+    def _start_fault(self, f, slot: int):
+        sc = self.scenario
+        if f.kind == "partition":
+            groups = [
+                frozenset(f"node{i}" for i in g) for g in f.groups
+            ]
+            self.conditioner.set_partition(groups)
+            self._emit_all(
+                slot, "partition_applied",
+                groups="|".join(
+                    ",".join(sorted(g)) for g in groups
+                ),
+            )
+        elif f.kind == "eclipse":
+            name = sc.node_name(f.node)
+            self.eclipse_windows[name] = (f.at_slot, f.until_slot)
+            self.conditioner.isolate(name)
+            self._emit_all(slot, "eclipse_applied", node=name)
+        elif f.kind == "offline":
+            self._take_offline(sc.node_name(f.node), slot)
+        elif f.kind == "kv_crash":
+            self._kv_crash(sc.node_name(f.node), slot)
+        # spam_flood / rpc_flood are windowed actions, driven per slot
+
+    def _end_fault(self, f, slot: int):
+        sc = self.scenario
+        if f.kind == "partition":
+            self.conditioner.clear_partition()
+            self._emit_all(slot, "partition_lifted")
+        elif f.kind == "eclipse":
+            name = sc.node_name(f.node)
+            self.conditioner.release(name)
+            self._emit_all(slot, "eclipse_lifted", node=name)
+            sn = self._by_name(name)
+            window = self.eclipse_windows[name]
+            produced = [
+                s for s in sn.produced_slots if window[0] <= s < window[1]
+            ]
+            if not produced:
+                # a pure stall recovers over req/resp; a victim that
+                # built its own fork re-converges through gossip parent
+                # chains + attestation weight instead
+                sn.node.sync.run_range_sync()
+        elif f.kind == "offline":
+            self._restart(sc.node_name(f.node), slot)
+
+    def _by_name(self, name: str) -> SimNode:
+        return next(sn for sn in self.nodes if sn.name == name)
+
+    def _take_offline(self, name: str, slot: int):
+        sn = self._by_name(name)
+        self._emit_all(slot, "node_offline", node=name)
+        sn.archive_journal()
+        self.conditioner.set_offline(name, True)
+        sn.online = False
+        sn.api.stop()
+        sn.net.close()
+
+    def _restart(self, name: str, slot: int):
+        """Bring a node back: checkpoint-sync from a live peer when the
+        network has finalized (anchor + forward range sync + history
+        backfill), plain re-sync from genesis otherwise. The finality
+        read comes from the provider's HEALTH endpoint — even driving
+        decisions ride the observability plane where they can."""
+        sn = self._by_name(name)
+        provider = next(
+            (p for p in self._honest_online() if p is not sn), None
+        )
+        self.conditioner.set_offline(name, False)
+        anchor_block = None
+        genesis_state = self.genesis.copy()
+        if provider is None:
+            # nobody to checkpoint from (overlapping fault windows took
+            # every other honest node down too): reboot from genesis and
+            # let gossip/sync catch the node up once peers return
+            _LOG.warning(
+                "%s restart at slot %d with no online provider — "
+                "genesis reboot", name, slot,
+            )
+            health = {"head": {"finalized_epoch": 0}}
+        else:
+            health = self._get_json(
+                provider.base_url() + "/lighthouse/health"
+            )["data"]
+        if health["head"]["finalized_epoch"] >= 1:
+            from lighthouse_tpu.http_api.client import fetch_checkpoint
+
+            state, block = fetch_checkpoint(
+                provider.base_url(), self.spec
+            )
+            genesis_state, anchor_block = state, block
+            sn.anchor_slot = int(state.slot)
+        self._boot_node(sn, genesis_state, anchor_block=anchor_block)
+        sn.restart_slots.append(slot)
+        self._connect_to_online(sn)
+        sn.node.on_slot(slot)
+        imported = sn.node.sync.run_range_sync()
+        stored = sn.node.sync.run_backfill()
+        sn.chain.journal.emit(
+            "sim_fault",
+            slot=slot,
+            outcome="node_restarted",
+            node=name,
+            anchor_slot=sn.anchor_slot,
+            range_synced=imported,
+            backfilled=stored,
+        )
+
+    def _kv_crash(self, name: str, slot: int):
+        """Hard-crash a node mid-write: tear the tail of its WAL (the
+        torn-record shape the native kv's replay drops whole), reboot it
+        over the SURVIVING kv prefix, and re-sync the difference."""
+        sn = self._by_name(name)
+        self._emit_all(slot, "kv_crash", node=name)
+        sn.archive_journal()
+        self.conditioner.set_offline(name, True)
+        sn.online = False
+        sn.api.stop()
+        sn.net.close()
+        kv = sn.chain.store.kv
+        try:
+            kv.close()
+        except Exception as e:
+            _LOG.debug("kv close during crash: %s", e)
+        from lighthouse_tpu.native import kvstore
+
+        native = kvstore.available()
+        if native and sn.kv_path and os.path.exists(sn.kv_path):
+            # tear the WAL tail: the torn group record must be dropped
+            # WHOLE on replay (the kv's batch-atomicity contract)
+            size = os.path.getsize(sn.kv_path)
+            if size > 16:
+                with open(sn.kv_path, "r+b") as fh:
+                    fh.truncate(size - 7)
+        if native and sn.kv_path:
+            new_kv = kvstore.NativeKVStore(sn.kv_path)
+        elif sn.kv_path:
+            # sqlite fallback: no WAL to tear (its own journal handles
+            # torn writes); the crash still exercises reboot + re-sync
+            from lighthouse_tpu.store import SqliteStore
+
+            new_kv = SqliteStore(sn.kv_path)
+        else:
+            new_kv = None
+        self.conditioner.set_offline(name, False)
+        self._boot_node(sn, self.genesis.copy(), kv=new_kv)
+        sn.restart_slots.append(slot)
+        self._connect_to_online(sn)
+        sn.node.on_slot(slot)
+        imported = sn.node.sync.run_range_sync()
+        sn.chain.journal.emit(
+            "sim_fault",
+            slot=slot,
+            outcome="kv_replayed",
+            node=name,
+            range_synced=imported,
+        )
+
+    # ---------------------------------------------------------- adversary
+
+    def _junk_sidecar(self, t, slot: int, i: int, bad_index: bool):
+        import hashlib
+
+        tag = hashlib.sha256(
+            f"{self.scenario.seed}:spam:{slot}:{i}".encode()
+        ).digest()
+        header = t.SignedBeaconBlockHeader(
+            message=t.BeaconBlockHeader(
+                slot=slot,
+                proposer_index=0,
+                parent_root=tag,
+                state_root=tag,
+                body_root=tag,
+            ),
+            signature=tag * 3,
+        )
+        index = (
+            self.spec.MAX_BLOBS_PER_BLOCK
+            if bad_index
+            else i % self.spec.MAX_BLOBS_PER_BLOCK
+        )
+        return t.BlobSidecar(
+            index=index,
+            blob=_deterministic_blob(self.spec, slot * 131 + i),
+            kzg_commitment=tag + tag[:16],
+            kzg_proof=tag + tag[:16],
+            signed_block_header=header,
+        )
+
+    def _run_spam(self, slot: int):
+        for f in self.scenario.faults:
+            if f.kind not in ("spam_flood", "rpc_flood"):
+                continue
+            if not f.active(slot):
+                continue
+            sn = self._by_name(self.scenario.node_name(f.node))
+            if not sn.online:
+                continue
+            if f.kind == "spam_flood":
+                t = sn.chain.t
+                for i in range(f.rate):
+                    # one structurally-invalid sidecar per slot prices
+                    # the spammer's score; the rest are candidate-cache
+                    # junk (priced by the cache caps, not pairings)
+                    bad = i == 0
+                    scd = self._junk_sidecar(t, slot, i, bad_index=bad)
+                    sn.node.publish_blob_sidecar(scd)
+                    _SPAM_TOTAL.labels(
+                        "gossip_sidecar_invalid"
+                        if bad
+                        else "gossip_sidecar"
+                    ).inc()
+            elif f.kind == "rpc_flood":
+                from lighthouse_tpu.network.rpc import (
+                    RateLimitExceeded,
+                    RpcError,
+                )
+
+                for victim in self._honest_online():
+                    client = sn.node.sync.peers.get(victim.name)
+                    if client is None:
+                        continue
+                    for _ in range(f.rate):
+                        try:
+                            client.status(sn.name)
+                        except (RateLimitExceeded, RpcError) as e:
+                            _LOG.debug("rpc flood bounced: %s", e)
+                        _SPAM_TOTAL.labels("rpc_burst").inc()
+
+    # ---------------------------------------------------------------- run
+
+    def run(self) -> dict:
+        from lighthouse_tpu.sim import invariants as inv
+        from lighthouse_tpu.sim import verdict as vd
+
+        if self.scenario.kind == "vc_http":
+            return self._run_vc_http()
+        snapshot_before = REGISTRY.snapshot()
+        self._build()
+        for slot in range(1, self.scenario.slots + 1):
+            self._slot = slot
+            _SLOTS_TOTAL.inc()
+            self._apply_timeline(slot)
+            for sn in self._online():
+                sn.node.on_slot(slot)
+            self._run_spam(slot)
+            for sn in self._online():
+                if sn.index is None:
+                    continue
+                proposer = self._proposer_at(sn, slot)
+                if self._owner(int(proposer)) == sn.name:
+                    self._propose(sn, slot)
+            self._settle()
+            self._drain_all()
+            for sn in self._online():
+                if sn.index is not None:
+                    self._attest(sn, slot)
+            self._settle()
+            self._drain_all()
+            for sn in self._online():
+                self._self_aggregate(sn, slot)
+        snapshot_after = REGISTRY.snapshot()
+        ctx = inv.SimContext(
+            scenario=self.scenario,
+            nodes={
+                sn.name: sn for sn in self.nodes
+            },
+            snapshot_before=snapshot_before,
+            snapshot_after=snapshot_after,
+            blob_blocks=dict(self.blob_blocks),
+            eclipse_windows=dict(self.eclipse_windows),
+        )
+        violations = inv.check_all(ctx, self.scenario.invariants)
+        report = vd.build_report(self, ctx, violations)
+        _RUNS_TOTAL.labels("violations" if violations else "ok").inc()
+        return report
+
+    # -------------------------------------------------------- vc_http kind
+
+    def _run_vc_http(self) -> dict:
+        """Satellite scenario: a BN booted exactly like `bn` serves a
+        VC that talks ONLY over HTTP (cmd_vc --beacon-node-url wiring,
+        with a dead fallback URL exercised first), and the chain
+        finalizes from the VC's duties alone."""
+        from lighthouse_tpu.cli import build_http_vc
+        from lighthouse_tpu.sim import invariants as inv
+        from lighthouse_tpu.sim import verdict as vd
+
+        snapshot_before = REGISTRY.snapshot()
+        sn = SimNode("node0", 0)
+        sn.node = BeaconNode(
+            sn.name, self.genesis.copy(), self.spec,
+            backend=self.scenario.backend,
+        )
+        sn.node.chain.journal.configure(
+            capacity=self.scenario.journal_capacity
+        )
+        sn.api = sn.node.start_http_api()
+        self.nodes.append(sn)
+        # a dead candidate FIRST: the fallback ranking must route every
+        # request past it to the live BN
+        vc = build_http_vc(
+            ["http://127.0.0.1:9", sn.base_url()],
+            self.keypairs,
+            self.spec,
+        )
+        for slot in range(1, self.scenario.slots + 1):
+            _SLOTS_TOTAL.inc()
+            sn.node.on_slot(slot)
+            vc.run_slot(slot)
+            self._drain(sn)
+        snapshot_after = REGISTRY.snapshot()
+        ctx = inv.SimContext(
+            scenario=self.scenario,
+            nodes={sn.name: sn},
+            snapshot_before=snapshot_before,
+            snapshot_after=snapshot_after,
+            blob_blocks={},
+            eclipse_windows={},
+        )
+        violations = inv.check_all(ctx, self.scenario.invariants)
+        report = vd.build_report(self, ctx, violations)
+        report["vc_metrics"] = dict(vc.metrics)
+        _RUNS_TOTAL.labels("violations" if violations else "ok").inc()
+        return report
+
+    # ------------------------------------------------------------- teardown
+
+    def close(self):
+        for sn in self.nodes:
+            if sn.api is not None and sn.online:
+                try:
+                    sn.api.stop()
+                except Exception as e:
+                    _LOG.debug("api stop: %s", e)
+            if sn.net is not None:
+                sn.net.close()
+
+    @staticmethod
+    def _get_json(url: str) -> dict:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.loads(r.read())
+
+
+class _TypesShim:
+    """Duck-typed `self` for Harness.make_blob_sidecars (which only
+    reads `self.t`) so the sidecar-building logic stays in ONE place."""
+
+    def __init__(self, t):
+        self.t = t

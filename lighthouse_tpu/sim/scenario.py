@@ -1,0 +1,304 @@
+"""Declarative scenario spec for the network simulator.
+
+A scenario is one JSON document: topology (nodes, validator split),
+spec overrides, conditioner fault rates, blob schedule, and a fault
+TIMELINE — slot-indexed windows of partitions, eclipses, offline nodes,
+spam floods, RPC floods, and kv crashes — plus the invariant list the
+run must satisfy. `scripts/sim.py --list` validates every committed
+file in `lighthouse_tpu/sim/scenarios/` against this spec (wired into
+tier-1), so the library cannot rot.
+
+The schema is deliberately closed: unknown keys, unknown fault kinds,
+and out-of-range windows are validation ERRORS, not warnings — a typo'd
+fault that silently never fires would make a chaos run test nothing.
+"""
+
+import json
+import os
+from dataclasses import dataclass, field
+
+FAULT_KINDS = (
+    "partition",   # groups: [[node-index, ...], ...]
+    "eclipse",     # node: index — all pairs touching it blocked
+    "offline",     # node: index — down at at_slot, restarts at until_slot
+    "spam_flood",  # node: name/index — junk blob-sidecar gossip, rate/slot
+    "rpc_flood",   # node: name/index — req/resp burst per slot at rate
+    "kv_crash",    # node: index — torn-WAL crash at at_slot, reboot+resync
+)
+
+SCENARIO_KINDS = ("multi_node", "vc_http")
+
+INVARIANT_NAMES = (
+    "honest_convergence",
+    "exactly_once_imports",
+    "da_completeness",
+    "bounded_scores",
+    "no_honest_quarantine",
+    "eclipse_rejoin",
+    "spam_priced",
+    "faults_fired",
+    "finalized",
+)
+
+_CONDITIONER_KEYS = {
+    "drop_rate", "duplicate_rate", "delay_rate", "reorder_rate",
+    "rpc_stall_rate",
+}
+
+_TOP_KEYS = {
+    "name", "kind", "seed", "nodes", "validators", "slots", "backend",
+    "spec", "blob_slots", "conditioner", "faults", "invariants",
+    "journal_capacity", "adversaries", "description",
+}
+
+_FAULT_KEYS = {
+    "kind", "at_slot", "until_slot", "node", "groups", "rate",
+}
+
+
+class ScenarioError(Exception):
+    pass
+
+
+@dataclass
+class FaultSpec:
+    kind: str
+    at_slot: int
+    until_slot: int | None = None
+    node: object = None       # node index (int) or adversary name (str)
+    groups: list | None = None
+    rate: int = 4
+
+    def active(self, slot: int) -> bool:
+        if slot < self.at_slot:
+            return False
+        return self.until_slot is None or slot < self.until_slot
+
+
+@dataclass
+class Scenario:
+    name: str
+    seed: int = 0
+    kind: str = "multi_node"
+    nodes: int = 5
+    validators: int = 40
+    slots: int = 16
+    backend: str = "fake"
+    spec_overrides: dict = field(default_factory=dict)
+    blob_slots: list = field(default_factory=list)
+    conditioner: dict = field(default_factory=dict)
+    faults: list = field(default_factory=list)
+    invariants: list = field(default_factory=list)
+    journal_capacity: int = 16384
+    # extra validator-less nodes available as fault actors (spammers)
+    adversaries: list = field(default_factory=list)
+    description: str = ""
+
+    @property
+    def honest_names(self) -> list:
+        return [f"node{i}" for i in range(self.nodes)]
+
+    def node_name(self, ref) -> str:
+        """Resolve a fault's `node` reference: an int indexes the honest
+        nodes, a string names an adversary."""
+        if isinstance(ref, int):
+            return f"node{ref}"
+        return str(ref)
+
+
+def _err(name, msg):
+    raise ScenarioError(f"scenario {name!r}: {msg}")
+
+
+def validate(doc: dict) -> Scenario:
+    """Parse + validate one scenario document; raises ScenarioError
+    with a precise message on any schema violation."""
+    if not isinstance(doc, dict):
+        raise ScenarioError("scenario document must be a JSON object")
+    name = doc.get("name")
+    if not isinstance(name, str) or not name:
+        raise ScenarioError("scenario needs a non-empty 'name'")
+    unknown = set(doc) - _TOP_KEYS
+    if unknown:
+        _err(name, f"unknown keys {sorted(unknown)}")
+    kind = doc.get("kind", "multi_node")
+    if kind not in SCENARIO_KINDS:
+        _err(name, f"unknown kind {kind!r} (one of {SCENARIO_KINDS})")
+    for key, typ in (
+        ("seed", int), ("nodes", int), ("validators", int),
+        ("slots", int), ("journal_capacity", int),
+    ):
+        if key in doc and not isinstance(doc[key], int):
+            _err(name, f"{key!r} must be an integer")
+    slots = doc.get("slots", 16)
+    nodes = doc.get("nodes", 5)
+    if slots < 1:
+        _err(name, "'slots' must be >= 1")
+    if kind == "multi_node" and not 2 <= nodes <= 16:
+        _err(name, "'nodes' must be in [2, 16]")
+    cond = doc.get("conditioner", {})
+    if not isinstance(cond, dict):
+        _err(name, "'conditioner' must be an object")
+    bad = set(cond) - _CONDITIONER_KEYS
+    if bad:
+        _err(name, f"unknown conditioner keys {sorted(bad)}")
+    for k, v in cond.items():
+        if not isinstance(v, (int, float)) or not 0 <= v <= 1:
+            _err(name, f"conditioner {k!r} must be a rate in [0, 1]")
+    blob_slots = doc.get("blob_slots", [])
+    if not all(
+        isinstance(s, int) and 1 <= s <= slots for s in blob_slots
+    ):
+        _err(name, "'blob_slots' must be slot numbers within the run")
+    adversaries = doc.get("adversaries", [])
+    if not all(isinstance(a, str) and a for a in adversaries):
+        _err(name, "'adversaries' must be a list of names")
+
+    faults = []
+    for i, f in enumerate(doc.get("faults", [])):
+        if not isinstance(f, dict):
+            _err(name, f"fault #{i} must be an object")
+        bad = set(f) - _FAULT_KEYS
+        if bad:
+            _err(name, f"fault #{i}: unknown keys {sorted(bad)}")
+        fkind = f.get("kind")
+        if fkind not in FAULT_KINDS:
+            _err(
+                name,
+                f"fault #{i}: unknown kind {fkind!r} "
+                f"(one of {FAULT_KINDS})",
+            )
+        at = f.get("at_slot")
+        if not isinstance(at, int) or not 1 <= at <= slots:
+            _err(name, f"fault #{i}: 'at_slot' must be in [1, {slots}]")
+        until = f.get("until_slot")
+        if until is not None and (
+            not isinstance(until, int) or until <= at or until > slots + 1
+        ):
+            _err(
+                name,
+                f"fault #{i}: 'until_slot' must be in "
+                f"({at}, {slots + 1}]",
+            )
+        node_ref = f.get("node")
+        if fkind == "partition":
+            groups = f.get("groups")
+            if (
+                not isinstance(groups, list)
+                or len(groups) < 2
+                or not all(
+                    isinstance(g, list)
+                    and g
+                    and all(
+                        isinstance(n, int) and 0 <= n < nodes for n in g
+                    )
+                    for g in groups
+                )
+            ):
+                _err(
+                    name,
+                    f"fault #{i}: partition needs >= 2 'groups' of "
+                    "node indices",
+                )
+            if until is None:
+                _err(name, f"fault #{i}: partition needs 'until_slot'")
+        else:
+            if node_ref is None:
+                _err(name, f"fault #{i}: {fkind} needs 'node'")
+            if isinstance(node_ref, int):
+                if not 0 <= node_ref < nodes:
+                    _err(
+                        name,
+                        f"fault #{i}: node index {node_ref} out of "
+                        f"range [0, {nodes})",
+                    )
+            elif node_ref not in adversaries:
+                _err(
+                    name,
+                    f"fault #{i}: node {node_ref!r} is not a declared "
+                    "adversary",
+                )
+            if fkind in ("eclipse", "offline") and until is None:
+                _err(name, f"fault #{i}: {fkind} needs 'until_slot'")
+        rate = f.get("rate", 4)
+        if not isinstance(rate, int) or rate < 1:
+            _err(name, f"fault #{i}: 'rate' must be a positive integer")
+        faults.append(
+            FaultSpec(
+                kind=fkind, at_slot=at, until_slot=until,
+                node=node_ref, groups=f.get("groups"), rate=rate,
+            )
+        )
+
+    invariants = doc.get("invariants", [])
+    for inv in invariants:
+        if inv not in INVARIANT_NAMES:
+            _err(
+                name,
+                f"unknown invariant {inv!r} (one of {INVARIANT_NAMES})",
+            )
+
+    spec_overrides = doc.get("spec", {})
+    if not isinstance(spec_overrides, dict) or not all(
+        isinstance(k, str) for k in spec_overrides
+    ):
+        _err(name, "'spec' must map override names to values")
+
+    return Scenario(
+        name=name,
+        kind=kind,
+        seed=doc.get("seed", 0),
+        nodes=nodes,
+        validators=doc.get("validators", 40),
+        slots=slots,
+        backend=doc.get("backend", "fake"),
+        spec_overrides=spec_overrides,
+        blob_slots=sorted(blob_slots),
+        conditioner=dict(cond),
+        faults=faults,
+        invariants=list(invariants),
+        journal_capacity=doc.get("journal_capacity", 16384),
+        adversaries=list(adversaries),
+        description=doc.get("description", ""),
+    )
+
+
+def load_scenario(path: str) -> Scenario:
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except ValueError as e:
+            raise ScenarioError(f"{path}: invalid JSON: {e}") from e
+    return validate(doc)
+
+
+def scenario_library() -> str:
+    """The committed scenario directory."""
+    return os.path.join(os.path.dirname(__file__), "scenarios")
+
+
+def list_scenarios(directory: str | None = None) -> list:
+    """[(path, Scenario)] for every *.json in the library, validated.
+    Raises ScenarioError on the first file that fails to parse."""
+    directory = directory or scenario_library()
+    out = []
+    for fname in sorted(os.listdir(directory)):
+        if not fname.endswith(".json"):
+            continue
+        path = os.path.join(directory, fname)
+        out.append((path, load_scenario(path)))
+    return out
+
+
+def find_scenario(name_or_path: str) -> Scenario:
+    """Resolve a CLI argument: a path to a JSON file, or the name of a
+    committed library scenario."""
+    if os.path.exists(name_or_path):
+        return load_scenario(name_or_path)
+    path = os.path.join(scenario_library(), name_or_path + ".json")
+    if os.path.exists(path):
+        return load_scenario(path)
+    known = [s.name for _, s in list_scenarios()]
+    raise ScenarioError(
+        f"no scenario {name_or_path!r} (library: {known})"
+    )

@@ -1,0 +1,152 @@
+"""Canonical journal export + the JSONL verdict artifact.
+
+Replay contract: re-running a scenario with the same seed must produce
+the IDENTICAL forensic record. Raw journal exports carry wall-clock
+timestamps, durations, and ring sequence numbers — artifacts of thread
+scheduling, not of protocol behavior — so the comparison surface is a
+CANONICAL PROJECTION:
+
+  * only the forensic event kinds (imports, DA lifecycle, sync
+    outcomes, scoring, sim faults) — queue-plane events like
+    `processor_enqueue` carry depth/batch-size attrs that legitimately
+    vary with thread interleaving inside one lockstep step;
+  * volatile fields stripped (`t`, `seq`, `duration_s`);
+  * events sorted by their full canonical JSON encoding, per node-life.
+
+Two runs of the same seed produce byte-identical canonical JSONL (the
+tier-1 seed-determinism gate); a diff in this projection is a REAL
+behavioral divergence, never scheduler noise.
+"""
+
+import json
+
+# the forensic projection: kinds whose occurrence/content is a protocol
+# claim (an import happened, a sidecar verified, a peer paid) rather
+# than a scheduling observation (queue depth at enqueue time)
+CANONICAL_KINDS = (
+    "block_import",
+    "block_release",
+    "sidecar",
+    "da_settle",
+    "sync_batch",
+    "sync_request",
+    "peer_downscore",
+    "peer_quarantine",
+    "sim_fault",
+)
+
+VOLATILE_FIELDS = ("t", "seq", "duration_s")
+
+
+def canonical_events(docs: list) -> list:
+    """Project raw journal docs (Journal.query() shape) onto the
+    canonical forensic record: filtered, stripped, sorted."""
+    out = []
+    for doc in docs:
+        if doc.get("kind") not in CANONICAL_KINDS:
+            continue
+        slim = {
+            k: v for k, v in doc.items() if k not in VOLATILE_FIELDS
+        }
+        out.append(slim)
+    return sorted(
+        out, key=lambda d: json.dumps(d, sort_keys=True)
+    )
+
+
+def canonical_jsonl(docs: list) -> str:
+    lines = [
+        json.dumps(d, sort_keys=True) for d in canonical_events(docs)
+    ]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def node_journals(sim) -> dict:
+    """name -> canonical JSONL covering every LIFE of the node (crash /
+    offline archives first, then the live journal)."""
+    out = {}
+    for sn in sim.nodes:
+        docs = []
+        for archive in sn.journal_archives:
+            docs.extend(archive)
+        if sn.node is not None:
+            docs.extend(sn.node.chain.journal.query())
+        out[sn.name] = canonical_jsonl(docs)
+    return out
+
+
+def build_report(sim, ctx, violations: list) -> dict:
+    """The run's verdict document (`scripts/sim.py` writes it as JSONL
+    alongside the per-node canonical journals)."""
+    from lighthouse_tpu.common.metrics import snapshot_diff
+
+    sc = sim.scenario
+    heads = {}
+    for sn in sim.nodes:
+        if not sn.online:
+            heads[sn.name] = None
+            continue
+        h = ctx.health(sn.name)["head"]
+        heads[sn.name] = {
+            "slot": h["slot"],
+            "root": h["root"],
+            "finalized_epoch": h["finalized_epoch"],
+        }
+    diff = snapshot_diff(ctx.snapshot_before, ctx.snapshot_after)
+    sim_series = {
+        k: v
+        for k, v in sorted(diff.items())
+        if k.startswith("lighthouse_tpu_sim_")
+        or k.startswith("lighthouse_tpu_sync_")
+        or k.startswith("lighthouse_tpu_rpc_")
+    }
+    return {
+        "scenario": sc.name,
+        "kind": sc.kind,
+        "seed": sc.seed,
+        "slots": sc.slots,
+        "nodes": [sn.name for sn in sim.nodes],
+        "ok": not violations,
+        "violations": list(violations),
+        "invariants": list(sc.invariants),
+        "heads": heads,
+        "blob_blocks": dict(ctx.blob_blocks),
+        "registry_diff": sim_series,
+        "journals": node_journals(sim),
+    }
+
+
+def write_report(report: dict, out_dir: str) -> list:
+    """Write verdict.jsonl (one line per invariant verdict + a summary
+    line) and per-node canonical journals; returns written paths."""
+    import os
+
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    verdict_path = os.path.join(out_dir, "verdict.jsonl")
+    with open(verdict_path, "w") as f:
+        for inv in report["invariants"]:
+            f.write(json.dumps({
+                "scenario": report["scenario"],
+                "seed": report["seed"],
+                "invariant": inv,
+                "ok": not any(
+                    v.startswith(f"[{inv}]")
+                    for v in report["violations"]
+                ),
+                "violations": [
+                    v for v in report["violations"]
+                    if v.startswith(f"[{inv}]")
+                ],
+            }, sort_keys=True) + "\n")
+        summary = {
+            k: v for k, v in report.items() if k != "journals"
+        }
+        f.write(json.dumps(summary, sort_keys=True) + "\n")
+    paths.append(verdict_path)
+    for name, jsonl in sorted(report["journals"].items()):
+        p = os.path.join(out_dir, f"journal_{name}.jsonl")
+        with open(p, "w") as f:
+            f.write(jsonl)
+        paths.append(p)
+    return paths

@@ -34,6 +34,17 @@ class AllNodesFailed(Exception):
         self.errors = errors
 
 
+class FinalRequestError(Exception):
+    """Wraps a response that is AUTHORITATIVE (a healthy node answered
+    4xx — e.g. a per-item duplicate rejection): failing over to another
+    node would re-publish or mask the real verdict. `first_success`
+    re-raises it immediately instead of walking the ranking."""
+
+    def __init__(self, inner):
+        super().__init__(str(inner))
+        self.inner = inner
+
+
 @dataclass
 class BeaconNodeFallback:
     candidates: list = field(default_factory=list)
@@ -48,10 +59,15 @@ class BeaconNodeFallback:
 
     def update_health(self):
         """Probe every candidate (beacon_node_fallback.rs update_all_
-        candidates): classify by reachability + sync distance."""
+        candidates): classify by reachability + sync distance. Accepts
+        both the stub surface (`syncing()`) and the real
+        BeaconNodeHttpClient surface (`get_syncing()`)."""
         for cand in self.candidates:
             try:
-                syncing = cand.client.syncing()
+                probe = getattr(cand.client, "syncing", None)
+                if probe is None:
+                    probe = cand.client.get_syncing
+                syncing = probe()
                 distance = int(syncing.get("sync_distance", 0))
                 is_syncing = bool(syncing.get("is_syncing", False))
                 if is_syncing and distance > self.sync_tolerance_slots:
@@ -79,6 +95,8 @@ class BeaconNodeFallback:
                 result = op(cand.client)
                 cand.failures = 0
                 return result
+            except FinalRequestError as e:
+                raise e.inner
             except Exception as e:  # noqa: BLE001 — any API failure
                 cand.failures += 1
                 errors.append(e)
@@ -92,7 +110,48 @@ class BeaconNodeFallback:
                 cand.failures = 0
                 cand.health = CandidateHealth.HEALTHY
                 return result
+            except FinalRequestError as e:
+                raise e.inner
             except Exception as e:  # noqa: BLE001
                 cand.failures += 1
                 errors.append(e)
         raise AllNodesFailed(errors)
+
+
+class FallbackBeaconNodeClient:
+    """BeaconNodeHttpClient-shaped facade over a BeaconNodeFallback:
+    every method call routes through `first_success` down the health
+    ranking, so `HttpValidatorClient` (which calls concrete client
+    methods) gets multi-BN redundancy without knowing about it — the
+    `cmd_vc --beacon-node-url url1 --beacon-node-url url2` wiring."""
+
+    def __init__(self, fallback: BeaconNodeFallback):
+        self._fallback = fallback
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            def op(client):
+                from lighthouse_tpu.http_api.client import (
+                    ApiClientError,
+                )
+
+                try:
+                    return getattr(client, name)(*args, **kwargs)
+                except ApiClientError as e:
+                    if e.status == 400:
+                        # a healthy node REJECTED the request (bad
+                        # input, per-item duplicate): that verdict is
+                        # authoritative — replaying it at another node
+                        # would re-publish. A 404 is different: "I
+                        # don't have it" is node-LOCAL (another node's
+                        # pool may hold the aggregate), so not-found
+                        # and everything else still walk the ranking.
+                        raise FinalRequestError(e) from e
+                    raise
+
+            return self._fallback.first_success(op)
+
+        return call

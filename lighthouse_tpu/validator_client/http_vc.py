@@ -423,9 +423,17 @@ class HttpValidatorClient:
     # ------------------------------------------------------------ duty loop
 
     def run_slot(self, slot: int):
-        """One slot of the full duty loop (the per-slot timer body)."""
+        """One slot of the full duty loop (the per-slot timer body).
+        Sync-committee duties exist only from altair on — polling them
+        against a phase0 chain is a guaranteed 400 (the reference VC is
+        fork-aware the same way)."""
         self.propose(slot)
         self.attest(slot)
-        self.sync_messages(slot)
+        in_altair = (
+            self.spec.slot_to_epoch(slot) >= self.spec.ALTAIR_FORK_EPOCH
+        )
+        if in_altair:
+            self.sync_messages(slot)
         self.aggregate(slot)
-        self.sync_contributions(slot)
+        if in_altair:
+            self.sync_contributions(slot)

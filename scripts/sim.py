@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Network-simulator driver: run / list / replay scenarios.
+
+    python scripts/sim.py list [--dir DIR]
+    python scripts/sim.py run  <name-or-path> [--seed N] [--out DIR]
+    python scripts/sim.py replay <name-or-path> --journals DIR [--seed N]
+
+`list` validates EVERY committed scenario file against the spec (the
+tier-1 CI gate — a scenario that stops parsing fails the build).
+`run` executes one scenario and writes the verdict JSONL plus one
+canonical per-node journal per node; exit code 1 on invariant
+violations. `replay` re-runs a scenario with the same seed and
+byte-compares the canonical journals against a previous run's output
+directory — the one-seed-replayable-artifact contract.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _load_sim_modules():
+    from lighthouse_tpu.sim import (
+        Simulation,
+        scenario as scenario_mod,
+    )
+
+    return Simulation, scenario_mod
+
+
+def cmd_list(args) -> int:
+    _, sc = _load_sim_modules()
+    try:
+        entries = sc.list_scenarios(args.dir)
+    except sc.ScenarioError as e:
+        print(f"scenario validation FAILED: {e}", file=sys.stderr)
+        return 1
+    for path, scenario in entries:
+        print(
+            f"{scenario.name:20s} kind={scenario.kind:10s} "
+            f"nodes={scenario.nodes} slots={scenario.slots} "
+            f"seed={scenario.seed} faults={len(scenario.faults)} "
+            f"({os.path.relpath(path, _REPO)})"
+        )
+    print(f"{len(entries)} scenario(s) OK")
+    return 0
+
+
+def _run(scenario, out_dir):
+    Simulation, _ = _load_sim_modules()
+    from lighthouse_tpu.sim import verdict as vd
+
+    with tempfile.TemporaryDirectory(prefix="sim_kv_") as workdir:
+        sim = Simulation(scenario, workdir=workdir)
+        try:
+            report = sim.run()
+        finally:
+            sim.close()
+    if out_dir:
+        for p in vd.write_report(report, out_dir):
+            print(f"wrote {p}")
+    return report
+
+
+def _resolve(args):
+    _, sc = _load_sim_modules()
+    scenario = sc.find_scenario(args.scenario)
+    if args.seed is not None:
+        scenario = dataclasses.replace(scenario, seed=args.seed)
+    return scenario
+
+
+def cmd_run(args) -> int:
+    _, sc = _load_sim_modules()
+    try:
+        scenario = _resolve(args)
+    except sc.ScenarioError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    report = _run(scenario, args.out)
+    summary = {k: v for k, v in report.items() if k != "journals"}
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    if not report["ok"]:
+        print(
+            f"{len(report['violations'])} invariant violation(s)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def cmd_replay(args) -> int:
+    _, sc = _load_sim_modules()
+    try:
+        scenario = _resolve(args)
+    except sc.ScenarioError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    report = _run(scenario, None)
+    mismatches = []
+    for name, jsonl in sorted(report["journals"].items()):
+        ref_path = os.path.join(args.journals, f"journal_{name}.jsonl")
+        if not os.path.exists(ref_path):
+            mismatches.append(f"{name}: no reference journal at {ref_path}")
+            continue
+        with open(ref_path) as f:
+            ref = f.read()
+        if ref != jsonl:
+            mismatches.append(
+                f"{name}: canonical journal diverged from {ref_path}"
+            )
+        else:
+            print(f"{name}: journal replayed byte-identical")
+    if mismatches:
+        for m in mismatches:
+            print(m, file=sys.stderr)
+        return 1
+    if not report["ok"]:
+        for v in report["violations"]:
+            print(v, file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="sim.py", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ls = sub.add_parser("list", help="validate + list scenarios")
+    ls.add_argument("--dir", default=None)
+    ls.set_defaults(fn=cmd_list)
+
+    run = sub.add_parser("run", help="run one scenario")
+    run.add_argument("scenario")
+    run.add_argument("--seed", type=int, default=None)
+    run.add_argument("--out", default=None, help="verdict/journal dir")
+    run.set_defaults(fn=cmd_run)
+
+    rp = sub.add_parser(
+        "replay", help="re-run and byte-compare canonical journals"
+    )
+    rp.add_argument("scenario")
+    rp.add_argument("--journals", required=True)
+    rp.add_argument("--seed", type=int, default=None)
+    rp.set_defaults(fn=cmd_replay)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
