@@ -34,6 +34,9 @@ Env knobs:
   BENCH_CONFIG=kzg|kzgfold  KZG producer MSM / verify fold-factor configs
   BENCH_CONFIG=ladder       unified window-kernel vs legacy-ladder A/B
                             at 64-bit and 255-bit scalar widths
+  BENCH_CONFIG=serve        mixed REST+gossip+RPC load against a live
+                            node: per-class p50/p99, hot-read cache,
+                            shed counts (BENCH_SERVE_SHED=0 = A/B off)
 """
 
 import json
@@ -146,6 +149,7 @@ def _active_metric():
         "kzg": "kzg_commit_msm_throughput",
         "kzgfold": "kzg_batch_fold_factor",
         "ladder": "ladder_unified_speedup",
+        "serve": "serve_mixed_traffic_throughput",
     }.get(cfg, "verify_signature_sets_throughput")
 
 
@@ -292,6 +296,12 @@ def _measure(jax, platform):
         return _measure_kzg_fold(jax, platform)
     if config == "ladder":
         return _measure_ladder(jax, platform)
+    if config == "serve":
+        # the serving-plane load harness never needs the accelerator:
+        # it measures the HTTP/gossip/RPC edges on the fake backend
+        from lighthouse_tpu import bench_serve
+
+        return bench_serve.measure(jax, platform)
     return _measure_sigsets(jax, platform)
 
 

@@ -2,7 +2,7 @@
 and must not run blocking device work inline.
 
 Scope: the HTTP API surface (``http_api/server.py`` — every function is
-on a ThreadingHTTPServer request path except construction/lifecycle)
+on a pooled-HTTP-server request path except construction/lifecycle)
 and the gossip hub (``network/gossip.py`` — deliver/publish callbacks
 run on whatever thread publishes).
 

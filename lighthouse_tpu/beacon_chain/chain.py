@@ -166,6 +166,13 @@ class BeaconChain:
         # unknown parent) is handed here; the node wires in its
         # parent-lookup recovery so the block is not silently lost
         self.da_release_failure_handler = None
+        # callables(block_root) run after every successful import
+        # (gossip AND sync paths) AND on every head CHANGE in
+        # recompute_head (reorgs without an import — invalid-payload
+        # verdicts, fork-boundary reverts): the HTTP API registers its
+        # hot-read cache invalidation here so a cached head/finalized
+        # response can never be served after the head moved
+        self.import_hooks: list = []
         # (header root, signature) pairs whose proposer signature already
         # verified — gossip redeliveries of a block's sidecars cost one
         # pairing total, not one per sidecar (FIFO-bounded)
@@ -386,6 +393,7 @@ class BeaconChain:
         the forensic record cannot diverge between them)."""
         slot = int(signed_block.message.slot)
         t0 = time.perf_counter()
+        head_before = self.head_root
         try:
             result = inner()
         except BlockError as e:
@@ -408,6 +416,17 @@ class BeaconChain:
             duration_s=time.perf_counter() - t0,
             **extra,
         )
+        # fire exactly ONCE per import: if this import moved the head,
+        # recompute_head's head-change branch already ran the hooks —
+        # this covers the remaining case (side-branch import: new store
+        # data, unchanged head)
+        if self.head_root == head_before:
+            for hook in list(self.import_hooks):
+                try:
+                    hook(block_root)
+                except Exception as e:
+                    # a broken consumer hook must not fail the import
+                    _LOG.warning("import hook failed: %s", e)
         return result
 
     def process_block(self, signed_block):
@@ -1481,6 +1500,15 @@ class BeaconChain:
             self._attestation_parts_from_state(
                 self.spec.slot_to_epoch(self.head_state.slot)
             )
+            # the head can move WITHOUT an import (invalid-payload
+            # verdicts, fork-boundary reverts): consumers caching
+            # head-derived responses must hear about every move, so
+            # the hooks fire on head CHANGE as well as on import
+            for hook in list(self.import_hooks):
+                try:
+                    hook(head_root)
+                except Exception as e:
+                    _LOG.warning("head-change hook failed: %s", e)
         # finalization advance drives the store lifecycle: hot→cold
         # migration + finality-keyed cache pruning, off the critical
         # path when the migrator is threaded (migrate.rs:29-35)

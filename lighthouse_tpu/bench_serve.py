@@ -1,0 +1,258 @@
+"""BENCH_CONFIG=serve: mixed-traffic load harness against a live node.
+
+The ROADMAP's "high-traffic serving plane" measurement: boot ONE full
+`BeaconNode` (chain + processor + socket transport + HTTP API), drive a
+seeded mix of REST reads (cheap + expensive classes), gossip floods
+(junk attestations through the beacon processor's ingest path), and
+req/resp RPC calls against it, then report p50/p99 PER ENDPOINT CLASS
+from the existing `lighthouse_tpu_http_class_seconds` /
+`lighthouse_tpu_http_request_seconds` histograms via
+`scripts/obs_report.py` — no Prometheus server in the loop.
+
+Three claims the JSON line carries evidence for:
+
+  * per-class latency under the mix (p50/p99 for cheap_read /
+    expensive_read / write),
+  * the hot-read TTL cache converting a repeated finalized-state read
+    flood into <= 1 store hit per TTL window (`cache_misses` vs
+    `cache_windows`),
+  * the backpressure shedding policy pricing a gossip flood
+    (`flood_shed` > 0 with `BENCH_SERVE_SHED=1`, the default;
+    `BENCH_SERVE_SHED=0` disables shedding for the A/B and reports the
+    full-queue drain the policy avoids).
+
+Crypto runs on the fake backend throughout: this config measures the
+SERVING edge, so its line is never `valid_for_headline`.
+"""
+
+import json
+import os
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+N_VALIDATORS = 16
+CHAIN_SLOTS = 8
+
+# the seeded REST mix: (weight, method, path, body)
+_MIX = (
+    (4, "GET", "/lighthouse/health", None),
+    (4, "GET", "/eth/v1/node/version", None),
+    (3, "GET", "/eth/v1/node/syncing", None),
+    (3, "GET", "/eth/v1/beacon/headers/head", None),
+    (2, "GET", "/eth/v1/beacon/states/finalized/finality_checkpoints",
+     None),
+    (2, "GET", "/eth/v1/beacon/states/head/validators", None),
+    (1, "GET", "/eth/v1/beacon/states/head/committees", None),
+    # duties POST rides the expensive_read class (committee walk)
+    (1, "POST", "/eth/v1/validator/duties/attester/0", b"[0, 1, 2]"),
+    # a true write-class sample: an (empty) pool submission
+    (1, "POST", "/eth/v1/beacon/pool/sync_committees", b"[]"),
+)
+
+
+def _build_node():
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.node import BeaconNode
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec(name="bench-serve")
+    h = Harness(spec, N_VALIDATORS, backend="fake")
+    node = BeaconNode("bench0", h.state, spec, backend="fake")
+    for slot in range(1, CHAIN_SLOTS + 1):
+        block = h.advance_slot_with_block(slot)
+        node.on_slot(slot)
+        node.chain.process_block(block)
+    return h, node
+
+
+def _junk_attestation(t, spec, i: int):
+    import hashlib
+
+    from lighthouse_tpu.testing import make_junk_attestation
+
+    tag = hashlib.sha256(f"serve-flood:{i}".encode()).digest()
+    return make_junk_attestation(t, spec, CHAIN_SLOTS, tag)
+
+
+def _request(base: str, method: str, path: str, body):
+    req = urllib.request.Request(
+        base + path, data=body, method=method
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            r.read()
+        return 200
+    except urllib.error.HTTPError as e:
+        return e.code
+    except OSError:
+        return -1
+
+
+def _class_quantiles():
+    """(class -> {count, p50, p99}) from the live registry via the
+    obs_report parsing path — the same numbers a scrape would show."""
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from scripts.obs_report import bucket_quantile, parse_histograms
+
+    out = {}
+    text = REGISTRY.render()
+    for (family, labels), h in parse_histograms(text).items():
+        if family != "lighthouse_tpu_http_class_seconds":
+            continue
+        cls_ = dict(labels).get("cls", "?")
+        out[cls_] = {
+            "count": h["count"],
+            "p50_s": round(
+                bucket_quantile(h["buckets"], h["count"], 0.50) or 0, 5
+            ),
+            "p99_s": round(
+                bucket_quantile(h["buckets"], h["count"], 0.99) or 0, 5
+            ),
+        }
+    return out
+
+
+def measure(jax, platform):
+    shed_enabled = os.environ.get("BENCH_SERVE_SHED", "1") != "0"
+    if platform == "cpu":
+        n_threads, reqs_per_thread = 4, 40
+        cache_reads, flood_n, rpc_n = 200, 400, 50
+    else:
+        n_threads, reqs_per_thread = 8, 80
+        cache_reads, flood_n, rpc_n = 400, 800, 100
+
+    h, node = _build_node()
+    api = node.start_http_api()
+    base = f"http://127.0.0.1:{api.port}"
+    t = node.chain.t
+    spec = node.spec
+
+    # req/resp plane: a client transport dialing the node's socket edge
+    from lighthouse_tpu.network.socket_net import SocketNet
+
+    net = node.attach_socket_net()
+    client = SocketNet("bench_client", t, spec)
+    client.connect(net.host, net.tcp_port)
+    proxy = client.rpc_client("bench0")
+
+    # ---- phase 1: seeded mixed REST traffic over the worker pool ----
+    weighted = [
+        entry[1:] for entry in _MIX for _ in range(entry[0])
+    ]
+    statuses = []
+    t_wall0 = time.perf_counter()
+
+    def run_mix(seed: int):
+        rng = random.Random(seed)
+        for _ in range(reqs_per_thread):
+            method, path, body = rng.choice(weighted)
+            statuses.append(_request(base, method, path, body))
+
+    threads = [
+        threading.Thread(target=run_mix, args=(i,), daemon=True)
+        for i in range(n_threads)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    mix_wall_s = time.perf_counter() - t_wall0
+
+    # ---- phase 2: hot-read cache flood (one store hit per TTL window)
+    cache = api._hot_caches["state_reads"]
+    cache.invalidate()
+    misses_before = cache.misses
+    hot = "/eth/v1/beacon/states/finalized/finality_checkpoints"
+    t0 = time.perf_counter()
+    for _ in range(cache_reads):
+        _request(base, "GET", hot, None)
+    cache_wall_s = time.perf_counter() - t0
+    cache_misses = cache.misses - misses_before
+    cache_windows = int(cache_wall_s / cache.ttl_s) + 1
+
+    # ---- phase 3: gossip flood through the processor's ingest path ---
+    # the shedder holds the same bounds dict; the A/B flips its
+    # explicit enable knob, never the bounds
+    node.processor.bounds["gossip_attestation"] = 64
+    node.processor.shedder.enabled = shed_enabled
+    shed_before = node.processor.metrics["shed"]
+    drop_before = node.processor.metrics["dropped"]
+    t0 = time.perf_counter()
+    for i in range(flood_n):
+        node.processor.submit(
+            "gossip_attestation", (_junk_attestation(t, spec, i), "peer")
+        )
+    ingest_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    node.processor.process_pending()
+    drain_s = time.perf_counter() - t0
+    flood_shed = node.processor.metrics["shed"] - shed_before
+    flood_dropped = node.processor.metrics["dropped"] - drop_before
+
+    # ---- phase 4: req/resp RPC mix (token buckets price the burst) --
+    from lighthouse_tpu.network.rpc import RateLimitExceeded, RpcError
+
+    rpc_ok = rpc_limited = 0
+    t0 = time.perf_counter()
+    for i in range(rpc_n):
+        try:
+            if i % 2:
+                proxy.ping("bench_client", i)
+            else:
+                proxy.status("bench_client")
+            rpc_ok += 1
+        except RateLimitExceeded:
+            rpc_limited += 1
+        except RpcError:
+            pass
+    rpc_wall_s = time.perf_counter() - t0
+
+    classes = _class_quantiles()
+    total_requests = len(statuses) + cache_reads
+    api.stop()
+    client.close()
+    net.close()
+
+    ok = sum(1 for s in statuses if s == 200)
+    shed_503 = sum(1 for s in statuses if s in (429, 503))
+    return {
+        "metric": "serve_mixed_traffic_throughput",
+        "value": round(total_requests / (mix_wall_s + cache_wall_s), 2),
+        "unit": "requests/sec",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "impl": "pooled_http" + ("" if shed_enabled else "_noshed"),
+        "n_sets": total_requests,
+        "mix_ok": ok,
+        "mix_shed": shed_503,
+        "classes": classes,
+        "cache_reads": cache_reads,
+        "cache_misses": cache_misses,
+        "cache_windows": cache_windows,
+        "cache_ok": bool(cache_misses <= cache_windows),
+        "flood_n": flood_n,
+        "flood_shed": flood_shed,
+        "flood_dropped": flood_dropped,
+        "flood_ingest_s": round(ingest_s, 4),
+        "flood_drain_s": round(drain_s, 4),
+        "rpc_calls": rpc_n,
+        "rpc_ok": rpc_ok,
+        "rpc_rate_limited": rpc_limited,
+        "rpc_per_sec": round(rpc_n / rpc_wall_s, 2),
+        "shed_enabled": shed_enabled,
+        # a node-local serving measurement, never a hardware headline
+        "valid_for_headline": False,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(measure(None, "cpu"), indent=2))

@@ -58,6 +58,12 @@ KINDS = frozenset(
         "processor_enqueue",
         "processor_drop",
         "processor_batch",
+        # overload plane (network/shedding): one event when a work
+        # kind's shed window opens (queue depth crossed the high-water
+        # hysteresis threshold) and one when it closes — the bounded
+        # forensic record of an overload episode (per-item sheds ride
+        # the processor_shed_total counter, never the ring)
+        "shed_window",
         # block lifecycle (chain)
         "block_import",
         "block_release",

@@ -1,0 +1,296 @@
+"""HTTP admission control: request classes, concurrency limits,
+deadlines, and hot-read TTL caches.
+
+Role of the reference's warp filter stack + the task-executor's bounded
+concurrency (beacon_node/http_api serves through a tokio runtime whose
+worker pool is the admission boundary): the stdlib server here used to
+spawn one unbounded thread per request, so a read flood WAS a memory
+flood. This module gives the serving edge the oppool32k-pipeline shape:
+a bounded worker pool fed by a bounded accept queue, and per-CLASS
+admission in front of the handlers.
+
+Request classes (classify()):
+
+  * ``cheap_read``     — O(1) lookups and in-memory documents (health,
+    metrics, headers, node/config namespaces). High concurrency, tight
+    deadline.
+  * ``expensive_read`` — state replay / whole-registry walks
+    (states/{id}/validators, committees, duties, debug states). Low
+    concurrency, larger deadline: ONE flood of these must not occupy
+    every worker.
+  * ``write``          — POSTs that mutate or enqueue (block publish,
+    pool ingest). Mid concurrency; never cached.
+
+Admission is two gates:
+
+  1. `AdmissionController.acquire(cls_)` — a per-class concurrency
+     limit. Over the limit the request is shed IMMEDIATELY with
+     ``503 + Retry-After`` ("refuse loud"): queueing expensive reads
+     behind each other only converts overload into latency for
+     everyone. The acquire also arms the request's `Deadline`.
+  2. The deadline propagates (thread-local) into store/state lookups
+     via `check_deadline()` — a handler that outlives its class budget
+     aborts mid-walk with 503 instead of holding a worker hostage.
+
+`TTLCache` backs the hot immutable reads (finalized/head state
+queries, blob sidecars by root): a read flood against a hot key costs
+one store hit per TTL window. Entries are invalidated explicitly on
+block import (the chain's import hook) and expire by TTL as a
+backstop, so a cached ``head`` response can never outlive the head.
+"""
+
+import threading
+import time
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+_INFLIGHT = REGISTRY.gauge_vec(
+    "lighthouse_tpu_http_inflight",
+    "in-flight HTTP requests per admission class",
+    ("cls",),
+)
+_SHED_TOTAL = REGISTRY.counter_vec(
+    "lighthouse_tpu_http_shed_total",
+    "HTTP requests refused by admission control, by endpoint and "
+    "reason (concurrency|deadline|accept_queue|processor_saturated)",
+    ("endpoint", "reason"),
+)
+_CACHE_EVENTS = REGISTRY.counter_vec(
+    "lighthouse_tpu_http_cache_events_total",
+    "hot-read TTL cache events (hit|miss|invalidate|expire) per cache",
+    ("cache", "event"),
+)
+
+# per-class policy: (max concurrent requests, deadline seconds)
+DEFAULT_LIMITS = {
+    "cheap_read": (32, 2.0),
+    "expensive_read": (4, 5.0),
+    "write": (8, 5.0),
+}
+
+# path segments whose GET is an expensive read: state replay, whole
+# validator-set walks, committee shuffles
+_EXPENSIVE_SEGMENTS = frozenset(
+    {
+        "validators",
+        "validator_balances",
+        "committees",
+        "sync_committees",
+        "duties",
+        "debug",
+    }
+)
+
+
+def count_shed(endpoint: str, reason: str):
+    """Record one shed decision made outside the controller (accept-
+    queue overflow, processor-saturation 429s)."""
+    _SHED_TOTAL.labels(endpoint, reason).inc()
+
+
+def classify(method: str, path: str) -> str:
+    """(method, raw path) -> admission class. Duty endpoints classify
+    by their WORK, not their verb: the attester/sync duties POSTs are
+    read-shaped committee walks — routing them through the write class
+    would let an epoch-boundary duty stampede saturate the class a
+    block publish needs (and publishes must degrade LAST)."""
+    parts = [p for p in path.split("?")[0].split("/") if p]
+    if "duties" in parts:
+        return "expensive_read"
+    if method != "GET":
+        return "write"
+    if any(p in _EXPENSIVE_SEGMENTS for p in parts):
+        return "expensive_read"
+    return "cheap_read"
+
+
+class AdmissionError(Exception):
+    """Shed decision: maps to 503 (overload) or 429 (saturation) with
+    a Retry-After header."""
+
+    def __init__(self, code: int, message: str, retry_after: float):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.retry_after = retry_after
+
+
+class Deadline:
+    __slots__ = ("expires_at",)
+
+    def __init__(self, budget_s: float):
+        self.expires_at = time.monotonic() + budget_s
+
+    def remaining(self) -> float:
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.expires_at
+
+
+_DEADLINE = threading.local()
+
+
+def current_deadline() -> Deadline | None:
+    return getattr(_DEADLINE, "value", None)
+
+
+def check_deadline(what: str = "request"):
+    """Cooperative deadline check — called from store/state lookup
+    boundaries so a slow handler aborts with 503 instead of holding a
+    pool worker past its class budget. No-op outside a request."""
+    dl = current_deadline()
+    if dl is not None and dl.expired():
+        raise AdmissionError(
+            503, f"deadline exceeded during {what}", retry_after=1.0
+        )
+
+
+class _Slot:
+    """RAII token for one admitted request: releases the class slot and
+    clears the thread's deadline."""
+
+    def __init__(self, controller, cls_: str, deadline: Deadline):
+        self.controller = controller
+        self.cls = cls_
+        self.deadline = deadline
+
+    def __enter__(self):
+        _DEADLINE.value = self.deadline
+        return self
+
+    def __exit__(self, *exc):
+        _DEADLINE.value = None
+        self.controller._release(self.cls)
+        return False
+
+
+class AdmissionController:
+    def __init__(self, limits=None):
+        self.limits = dict(DEFAULT_LIMITS)
+        if limits:
+            self.limits.update(limits)
+        self._lock = threading.Lock()
+        self._inflight = {cls_: 0 for cls_ in self.limits}
+
+    def acquire(self, cls_: str, endpoint: str) -> _Slot:
+        """Admit one request of `cls_` or shed it loudly. Returns a
+        context manager guarding the slot + deadline."""
+        max_inflight, budget_s = self.limits[cls_]
+        with self._lock:
+            if self._inflight[cls_] >= max_inflight:
+                count_shed(endpoint, "concurrency")
+                raise AdmissionError(
+                    503,
+                    f"{cls_} concurrency limit ({max_inflight}) "
+                    "reached",
+                    retry_after=max(budget_s / 2, 0.5),
+                )
+            self._inflight[cls_] += 1
+            _INFLIGHT.labels(cls_).set(self._inflight[cls_])
+        return _Slot(self, cls_, Deadline(budget_s))
+
+    def _release(self, cls_: str):
+        with self._lock:
+            self._inflight[cls_] -= 1
+            _INFLIGHT.labels(cls_).set(self._inflight[cls_])
+
+    def inflight(self) -> dict:
+        with self._lock:
+            return dict(self._inflight)
+
+    def state(self) -> dict:
+        """Health-plane view: per-class inflight vs limit."""
+        with self._lock:
+            return {
+                cls_: {
+                    "inflight": self._inflight[cls_],
+                    "limit": self.limits[cls_][0],
+                    "deadline_s": self.limits[cls_][1],
+                }
+                for cls_ in self.limits
+            }
+
+
+class TTLCache:
+    """Bounded TTL cache for hot immutable read responses, with
+    explicit invalidation on import. Values are whatever the server
+    stores (rendered response tuples); keys are request-identity
+    strings (path + content negotiation)."""
+
+    def __init__(self, name: str, ttl_s: float = 1.0, max_entries: int = 256):
+        self.name = name
+        self.ttl_s = ttl_s
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: dict[str, tuple[float, object]] = {}
+        self._generation = 0
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    @property
+    def generation(self) -> int:
+        """Bumped by every invalidate(); a resolver captures it at
+        get-miss time and hands it back to put() so a response computed
+        BEFORE an invalidation can never be cached AFTER it (the
+        read-resolve-put race against the import thread)."""
+        with self._lock:
+            return self._generation
+
+    def get(self, key: str):
+        """(hit, value) — `hit` distinguishes a cached None-shaped
+        value from a miss."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and now - entry[0] < self.ttl_s:
+                self.hits += 1
+                _CACHE_EVENTS.labels(self.name, "hit").inc()
+                return True, entry[1]
+            if entry is not None:
+                del self._entries[key]
+                _CACHE_EVENTS.labels(self.name, "expire").inc()
+            self.misses += 1
+            _CACHE_EVENTS.labels(self.name, "miss").inc()
+            return False, None
+
+    def put(self, key: str, value, generation: int | None = None):
+        """Store `value`; when `generation` (captured at get-miss) no
+        longer matches, an invalidation happened while the value was
+        being computed — discard it, it describes the OLD head."""
+        with self._lock:
+            if generation is not None and generation != self._generation:
+                return
+            if (
+                len(self._entries) >= self.max_entries
+                and key not in self._entries
+            ):
+                # evict oldest-inserted: hot keys re-enter immediately
+                oldest = min(
+                    self._entries, key=lambda k: self._entries[k][0]
+                )
+                del self._entries[oldest]
+            self._entries[key] = (time.monotonic(), value)
+
+    def invalidate(self):
+        """Drop everything — called from the chain's import/head-change
+        hook, so a response derived from the pre-import head cannot be
+        served after the head moved."""
+        with self._lock:
+            self._generation += 1
+            n = len(self._entries)
+            self._entries.clear()
+            if n:
+                self.invalidations += 1
+                _CACHE_EVENTS.labels(self.name, "invalidate").inc()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "invalidations": self.invalidations,
+                "ttl_s": self.ttl_s,
+            }

@@ -45,20 +45,46 @@ Endpoints (the operative subset):
 """
 
 import json
+import queue
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler, HTTPServer
 
+from lighthouse_tpu.common.logging import get_logger
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.common.tracing import TRACER
+from lighthouse_tpu.http_api.admission import (
+    AdmissionController,
+    AdmissionError,
+    TTLCache,
+    check_deadline,
+    classify,
+    count_shed,
+)
 from lighthouse_tpu.http_api.json_codec import from_json, to_json
 
+_LOG = get_logger("http_api")
+
 VERSION = "lighthouse-tpu/0.1.0"
+
+# serving-plane shape (ROADMAP "high-traffic serving plane"): a bounded
+# worker pool fed by a bounded accept queue replaces the unbounded
+# thread-per-request model — overload sheds at the edge (503 +
+# Retry-After) instead of growing a thread per attacker
+DEFAULT_POOL_WORKERS = 8
+DEFAULT_ACCEPT_QUEUE = 64
+MAX_STREAM_DETACH = 8  # concurrent SSE streams allowed off-pool
 
 _HTTP_SECONDS = REGISTRY.histogram_vec(
     "lighthouse_tpu_http_request_seconds",
     "REST API request latency by method and endpoint template",
     ("method", "endpoint"),
+)
+_HTTP_CLASS_SECONDS = REGISTRY.histogram_vec(
+    "lighthouse_tpu_http_class_seconds",
+    "REST API request latency by admission class "
+    "(cheap_read|expensive_read|write)",
+    ("cls",),
 )
 _CACHE_STATS = REGISTRY.gauge_vec(
     "lighthouse_tpu_attestation_cache_stat",
@@ -130,6 +156,144 @@ def _validator_status(v, balance: int, epoch: int) -> str:
     return "withdrawal_done" if balance == 0 else "withdrawal_possible"
 
 
+class PooledHTTPServer(HTTPServer):
+    """Bounded worker pool + bounded accept queue over the stdlib
+    server. `process_request` enqueues the accepted socket; N pool
+    workers drain it. A full accept queue is the outermost shed point:
+    the client gets a raw 503 + Retry-After and the socket closes —
+    overload costs one queue probe, never a thread.
+
+    SSE streams (`/eth/v1/events`) hold a connection for minutes; a
+    handler entering a stream calls `detach_current_worker()`, which
+    spawns a replacement pool worker (bounded by MAX_STREAM_DETACH) so
+    streaming never starves request serving.
+    """
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    _RAW_503 = (
+        b"HTTP/1.1 503 Service Unavailable\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Retry-After: 1\r\n"
+        b"Content-Length: 45\r\n\r\n"
+        b'{"code": 503, "message": "accept queue full"}'
+    )
+
+    def __init__(
+        self,
+        addr,
+        handler_cls,
+        workers: int = DEFAULT_POOL_WORKERS,
+        accept_queue: int = DEFAULT_ACCEPT_QUEUE,
+    ):
+        super().__init__(addr, handler_cls)
+        self._accept_q: queue.Queue = queue.Queue(maxsize=accept_queue)
+        self._pool_lock = threading.Lock()
+        self._detached_streams = 0
+        self._retire_pending = 0
+        self._workers: list[threading.Thread] = []
+        self._pool_size = workers
+        self.accept_shed = 0
+
+    def start_pool(self):
+        """Spawn the workers — called from BeaconApiServer.start(), so
+        CONSTRUCTION stays side-effect-free beyond the socket bind
+        (tests that only call handle_get directly never pay 8 threads).
+        No request can arrive earlier: serve_forever starts alongside."""
+        for _ in range(self._pool_size):
+            self._spawn_worker()
+
+    def _spawn_worker(self):
+        th = threading.Thread(target=self._worker_loop, daemon=True)
+        th.start()
+        # prune retired workers so the list tracks LIVE threads only
+        # (every SSE detach spawns one; a long-lived node must not
+        # accumulate dead Thread objects). Under the pool lock: two
+        # concurrent SSE detaches must not lose each other's append.
+        with self._pool_lock:
+            self._workers = [
+                t for t in self._workers if t.is_alive()
+            ] + [th]
+
+    def process_request(self, request, client_address):
+        try:
+            self._accept_q.put_nowait((request, client_address))
+        except queue.Full:
+            self.accept_shed += 1
+            count_shed("(accept)", "accept_queue")
+            try:
+                request.sendall(self._RAW_503)
+            except OSError as e:
+                _LOG.debug("accept-shed response failed: %s", e)
+            self.shutdown_request(request)
+
+    def _worker_loop(self):
+        while True:
+            item = self._accept_q.get()
+            if item is None:
+                return
+            request, client_address = item
+            try:
+                self.finish_request(request, client_address)
+            except Exception as e:
+                # one broken connection must not kill a pool worker
+                _LOG.debug("request handling failed: %s", e)
+            finally:
+                self.shutdown_request(request)
+            if self._maybe_retire():
+                return
+
+    def _maybe_retire(self) -> bool:
+        """Shrink the pool back after a detached SSE stream ended."""
+        with self._pool_lock:
+            if self._retire_pending > 0:
+                self._retire_pending -= 1
+                return True
+        return False
+
+    def detach_current_worker(self) -> bool:
+        """Called by a handler about to block on a long-lived stream:
+        spawns a replacement worker so the pool's serving capacity is
+        unchanged. Returns False (stream must be refused) once
+        MAX_STREAM_DETACH streams are already detached."""
+        with self._pool_lock:
+            if self._detached_streams >= MAX_STREAM_DETACH:
+                return False
+            self._detached_streams += 1
+        self._spawn_worker()
+        return True
+
+    def reattach_worker(self):
+        """Stream ended: the streaming worker resumes its pool loop, so
+        one worker (whichever finishes a request next) retires and the
+        pool shrinks back to its configured size."""
+        with self._pool_lock:
+            self._detached_streams -= 1
+            self._retire_pending += 1
+
+    def stop_pool(self):
+        # drain pending requests first (closing them) so one exit
+        # sentinel per LIVE worker always fits in the queue
+        try:
+            while True:
+                item = self._accept_q.get_nowait()
+                if item is not None:
+                    self.shutdown_request(item[0])
+        except queue.Empty:
+            pass
+        with self._pool_lock:
+            self._workers = [
+                t for t in self._workers if t.is_alive()
+            ]
+            live = len(self._workers)
+        for _ in range(live):
+            try:
+                self._accept_q.put_nowait(None)
+            except queue.Full:
+                break
+
+
 class BeaconApiServer:
     def __init__(self, chain, host: str = "127.0.0.1", port: int = 0,
                  net=None, sync=None, node=None):
@@ -137,13 +301,28 @@ class BeaconApiServer:
         self.net = net  # optional SocketNet for node/identity + peers
         self.sync = sync  # optional SyncManager for node/syncing
         self.node = node  # optional BeaconNode for subnet subscriptions
+        # admission control: per-class concurrency limits + deadlines;
+        # hot immutable reads answered from TTL caches invalidated on
+        # every block import (a read flood against a hot key costs one
+        # store hit per TTL window)
+        self.admission = AdmissionController()
+        self._hot_caches = {
+            "state_reads": TTLCache("state_reads", ttl_s=1.0),
+            "blob_sidecars": TTLCache("blob_sidecars", ttl_s=2.0),
+        }
         api = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
                 pass
 
-            def _send(self, code, payload, content_type="application/json"):
+            def _send(
+                self,
+                code,
+                payload,
+                content_type="application/json",
+                headers=None,
+            ):
                 body = (
                     payload
                     if isinstance(payload, bytes)
@@ -152,23 +331,61 @@ class BeaconApiServer:
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_shed(self, e: AdmissionError):
+                """503/429 + Retry-After: the refuse-loud contract."""
+                self._send(
+                    e.code,
+                    {"code": e.code, "message": e.message},
+                    headers={
+                        "Retry-After": str(
+                            max(1, int(e.retry_after + 0.999))
+                        )
+                    },
+                )
+
             def do_GET(self):
                 if self.path.split("?")[0] == "/eth/v1/events":
-                    # SSE streams stay open for minutes — excluded from
-                    # the request-latency histogram by design
-                    return self._serve_events()
+                    # SSE streams stay open for minutes — detach from
+                    # the worker pool (bounded) so streaming cannot
+                    # starve request serving; excluded from the
+                    # request-latency histogram by design
+                    if not api._httpd.detach_current_worker():
+                        return self._send(
+                            503,
+                            {
+                                "code": 503,
+                                "message": "stream limit reached",
+                            },
+                            headers={"Retry-After": "30"},
+                        )
+                    try:
+                        return self._serve_events()
+                    finally:
+                        api._httpd.reattach_worker()
+                cls_ = classify("GET", self.path)
+                endpoint = _endpoint_label(self.path)
+                try:
+                    slot = api.admission.acquire(cls_, endpoint)
+                except AdmissionError as e:
+                    return self._send_shed(e)
                 t0 = time.perf_counter()
                 try:
-                    # self.headers is an HTTPMessage: case-insensitive
-                    # get(), as header lookup must be
-                    out = api.handle_get(self.path, self.headers)
+                    with slot:
+                        # self.headers is an HTTPMessage: case-
+                        # insensitive get(), as header lookup must be
+                        out = api._cached_get(self.path, self.headers)
                     if isinstance(out, tuple):
                         self._send(200, out[0], content_type=out[1])
                     else:
                         self._send(200, out)
+                except AdmissionError as e:
+                    # deadline exceeded mid-handler: abort loudly
+                    self._send_shed(e)
                 except ApiError as e:
                     self._send(
                         e.code, {"code": e.code, "message": e.message}
@@ -176,9 +393,9 @@ class BeaconApiServer:
                 except Exception as e:  # pragma: no cover
                     self._send(500, {"code": 500, "message": str(e)})
                 finally:
-                    _HTTP_SECONDS.labels(
-                        "GET", _endpoint_label(self.path)
-                    ).observe(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    _HTTP_SECONDS.labels("GET", endpoint).observe(dt)
+                    _HTTP_CLASS_SECONDS.labels(cls_).observe(dt)
 
             def _serve_events(self):
                 """Server-sent events stream (/eth/v1/events?topics=…,
@@ -243,12 +460,26 @@ class BeaconApiServer:
                     api.chain.events.unsubscribe(sub)
 
             def do_POST(self):
+                # classify() routes read-shaped POSTs (duties) to the
+                # expensive_read class — block publishes must never
+                # queue behind a committee-walk stampede
+                cls_ = classify("POST", self.path)
+                endpoint = _endpoint_label(self.path)
+                try:
+                    slot = api.admission.acquire(cls_, endpoint)
+                except AdmissionError as e:
+                    return self._send_shed(e)
                 t0 = time.perf_counter()
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    body = self.rfile.read(length)
-                    out = api.handle_post(self.path, body)
+                    with slot:
+                        length = int(
+                            self.headers.get("Content-Length", 0)
+                        )
+                        body = self.rfile.read(length)
+                        out = api.handle_post(self.path, body)
                     self._send(200, out)
+                except AdmissionError as e:
+                    self._send_shed(e)
                 except ApiError as e:
                     self._send(
                         e.code, {"code": e.code, "message": e.message}
@@ -256,13 +487,107 @@ class BeaconApiServer:
                 except Exception as e:
                     self._send(400, {"code": 400, "message": str(e)})
                 finally:
-                    _HTTP_SECONDS.labels(
-                        "POST", _endpoint_label(self.path)
-                    ).observe(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    _HTTP_SECONDS.labels("POST", endpoint).observe(dt)
+                    _HTTP_CLASS_SECONDS.labels(cls_).observe(dt)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = PooledHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port
         self._thread = None
+
+    # --------------------------------------------------- admission plane
+
+    # paths whose responses are immutable within a TTL window AND
+    # invalidated on import: finalized/head/justified state reads and
+    # blob sidecars by block id
+    _CACHEABLE_STATE_IDS = frozenset({"head", "finalized", "justified"})
+
+    def _cache_for(self, path: str):
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if parts[:4] == ["eth", "v1", "beacon", "blob_sidecars"]:
+            return self._hot_caches["blob_sidecars"]
+        if (
+            parts[:4] == ["eth", "v1", "beacon", "states"]
+            and len(parts) >= 5
+            and parts[4] in self._CACHEABLE_STATE_IDS
+        ):
+            return self._hot_caches["state_reads"]
+        return None
+
+    def _cached_get(self, path: str, headers=None):
+        """handle_get through the hot-read TTL caches: a repeated read
+        of a hot immutable key costs ONE store/state hit per TTL
+        window. Only 200s are cached; errors always re-resolve."""
+        cache = self._cache_for(path)
+        if cache is None:
+            return self.handle_get(path, headers)
+        hit, value = cache.get(path)
+        if hit:
+            return value
+        # capture the generation BEFORE resolving: if an import
+        # invalidates while we compute, put() discards our (old-head)
+        # response instead of caching it past the invalidation
+        gen = cache.generation
+        out = self.handle_get(path, headers)
+        cache.put(path, out, generation=gen)
+        return out
+
+    def _invalidate_hot_caches(self, block_root=None):
+        """Chain import hook: a new block moves the head and lands new
+        sidecars, so every cached hot read is stale NOW, not at TTL."""
+        for cache in self._hot_caches.values():
+            cache.invalidate()
+
+    # REST endpoints whose POST enqueues beacon-processor work, mapped
+    # to the queue kind whose shed window gates them with a 429
+    _SATURATION_GATED = {
+        "/eth/v1/beacon/pool/attestations": "gossip_attestation",
+        "/eth/v1/validator/aggregate_and_proofs": "gossip_aggregate",
+        "/eth/v1/beacon/pool/sync_committees": "sync_message",
+        "/eth/v1/validator/contribution_and_proofs": "sync_message",
+    }
+
+    def _check_processor_saturation(self, path: str):
+        """429 + Retry-After on enqueue endpoints while the matching
+        work kind's shed window is open — the REST edge refuses the
+        same work the gossip edge is already shedding. Block publishes
+        are forensic work and are never gated."""
+        kind = self._SATURATION_GATED.get(path.split("?")[0])
+        if kind is None:
+            return
+        processor = getattr(
+            getattr(self, "node", None), "processor", None
+        )
+        if processor is None:
+            return
+        if processor.shedder.is_shedding(kind):
+            count_shed(
+                _endpoint_label(path), "processor_saturated"
+            )
+            raise AdmissionError(
+                429,
+                f"processor saturated ({kind} shed window open)",
+                retry_after=2.0,
+            )
+
+    def overload_state(self) -> dict:
+        """The health-plane overload document: HTTP admission state,
+        hot-cache occupancy, accept-queue sheds, and the beacon
+        processor's shed windows."""
+        doc = {
+            "http": self.admission.state(),
+            "caches": {
+                name: c.stats()
+                for name, c in self._hot_caches.items()
+            },
+            "accept_shed": getattr(self._httpd, "accept_shed", 0),
+        }
+        processor = getattr(
+            getattr(self, "node", None), "processor", None
+        )
+        if processor is not None:
+            doc["processor"] = processor.shed_state()
+        return doc
 
     # ------------------------------------------------------------ routing
 
@@ -529,6 +854,8 @@ class BeaconApiServer:
                     epoch = chain.spec.slot_to_epoch(state.slot)
                     out = []
                     for i, v in enumerate(state.validators):
+                        if i % 512 == 0:
+                            check_deadline("validator walk")
                         if wanted is not None and i not in wanted:
                             continue
                         out.append(
@@ -777,6 +1104,9 @@ class BeaconApiServer:
 
     def handle_post(self, path: str, body: bytes):
         chain = self.chain
+        # backpressure surfaces on the REST edge too: enqueue endpoints
+        # answer 429 while the matching processor kind is shedding
+        self._check_processor_saturation(path)
         parts = [p for p in path.split("?")[0].split("/") if p]
         if (
             parts[:4] == ["eth", "v1", "validator", "liveness"]
@@ -930,6 +1260,7 @@ class BeaconApiServer:
             chain.spec.epoch_start_slot(epoch),
             chain.spec.epoch_start_slot(epoch + 1),
         ):
+            check_deadline("attester duties")
             for index in range(cache.committees_per_slot):
                 committee = cache.get_beacon_committee(slot, index)
                 for pos, v in enumerate(committee):
@@ -1024,6 +1355,8 @@ class BeaconApiServer:
             },
             "da": chain.da_checker.stats(),
             "journal": chain.journal.stats(),
+            # overload plane: admission state, hot caches, shed windows
+            "overload": self.overload_state(),
             "validator_monitor": (
                 chain.validator_monitor.health_summary()
             ),
@@ -1107,6 +1440,10 @@ class BeaconApiServer:
         served (the live head would hand checkpoint clients a
         reorgable anchor); checkpoint-sync clients detect the slot-0
         state and report that the provider has not finalized."""
+        # deadline propagation into store/state lookups: a state
+        # resolve can replay slots — abort before starting work the
+        # request's class budget cannot fund
+        check_deadline("state lookup")
         chain = self.chain
         if state_id == "head":
             return chain.head_state
@@ -1135,6 +1472,7 @@ class BeaconApiServer:
         return state
 
     def _resolve_block(self, block_id: str):
+        check_deadline("block lookup")
         chain = self.chain
         if block_id == "head":
             root = chain.head_root
@@ -1225,6 +1563,7 @@ class BeaconApiServer:
         for slot in range(
             spec.epoch_start_slot(epoch), spec.epoch_start_slot(epoch + 1)
         ):
+            check_deadline("committee walk")
             if want_slot is not None and slot != want_slot:
                 continue
             for index in range(cache.committees_per_slot):
@@ -1312,6 +1651,13 @@ class BeaconApiServer:
     # ----------------------------------------------------------- lifecycle
 
     def start(self):
+        # serving side effects live HERE, not in construction: the
+        # worker pool and the chain's cache-invalidation hook only
+        # exist while the server actually serves
+        hooks = getattr(self.chain, "import_hooks", None)
+        if hooks is not None and self._invalidate_hot_caches not in hooks:
+            hooks.append(self._invalidate_hot_caches)
+        self._httpd.start_pool()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -1319,6 +1665,15 @@ class BeaconApiServer:
         return self
 
     def stop(self):
+        # shutdown() FIRST: once the accept loop is dead no new
+        # connection can be enqueued after the workers have taken
+        # their exit sentinels (it would hang unserved forever)
         self._httpd.shutdown()
+        self._httpd.stop_pool()
         if self._thread:
             self._thread.join(timeout=5)
+        # a stopped server must not keep invalidation hooks alive on
+        # the chain (tests build many servers per chain)
+        hooks = getattr(self.chain, "import_hooks", None)
+        if hooks is not None and self._invalidate_hot_caches in hooks:
+            hooks.remove(self._invalidate_hot_caches)

@@ -19,6 +19,7 @@ import threading
 from lighthouse_tpu.common.events_journal import JOURNAL
 from lighthouse_tpu.common.locks import TimedLock
 from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.network.shedding import SheddingPolicy
 import time
 from dataclasses import dataclass, field
 
@@ -32,7 +33,7 @@ _QUEUE_DEPTH = REGISTRY.gauge_vec(
 )
 _QUEUE_EVENTS = REGISTRY.counter_vec(
     "lighthouse_tpu_beacon_processor_events_total",
-    "beacon processor queue events (submitted/dropped/reprocess_"
+    "beacon processor queue events (submitted/dropped/shed/reprocess_"
     "scheduled/processed/handler_error) per kind",
     ("kind", "event"),
 )
@@ -118,6 +119,10 @@ class BeaconProcessor:
         self.bounds = dict(DEFAULT_BOUNDS)
         if bounds:
             self.bounds.update(bounds)
+        # backpressure shedding: queue depths become an admission
+        # signal — cheap gossip kinds are rejected at submit while a
+        # hysteresis window is open, forensic kinds never are
+        self.shedder = SheddingPolicy(self.bounds, journal=self.journal)
         self._queues: dict[str, list] = {k: [] for k in PRIORITIES}
         self._dropped: dict[str, int] = {k: 0 for k in PRIORITIES}
         self._lock = TimedLock("beacon_processor.queues")
@@ -127,19 +132,32 @@ class BeaconProcessor:
         self._max_workers = max_workers
         self._stop = False
         self._reprocess: list = []  # (ready_time, kind, payload)
-        self.metrics = {"processed": 0, "reprocessed": 0, "dropped": 0}
+        self.metrics = {
+            "processed": 0, "reprocessed": 0, "dropped": 0, "shed": 0,
+        }
 
     def queue_depths(self) -> dict:
         """Current depth per work kind (the health-plane read)."""
         with self._lock:
             return {k: len(q) for k, q in self._queues.items()}
 
+    def shed_state(self) -> dict:
+        """The overload view for /lighthouse/health: open shed windows,
+        exact shed counts, window transitions."""
+        return self.shedder.state()
+
     # -------------------------------------------------------------- submit
 
     def submit(self, kind: str, payload) -> bool:
-        """Enqueue work; returns False when the bounded queue dropped it."""
+        """Enqueue work; returns False when the bounded queue dropped it
+        or the backpressure shedding policy rejected it (cheapest-first
+        overload degradation; forensic kinds are never shed)."""
         with self._lock:
             q = self._queues[kind]
+            if self.shedder.should_shed(kind, len(q)):
+                self.metrics["shed"] += 1
+                _QUEUE_EVENTS.labels(kind, "shed").inc()
+                return False
             if len(q) >= self.bounds[kind]:
                 self._dropped[kind] += 1
                 self.metrics["dropped"] += 1
@@ -212,6 +230,9 @@ class BeaconProcessor:
             else:
                 items = q[:1]
             del q[: len(items)]
+            # the drain is allowed to close a shed window: after a
+            # flood lifts, submit may never run for this kind again
+            self.shedder.observe_depth(kind, len(q))
             wait_hist = _QUEUE_WAIT_SECONDS.labels(kind)
             for w in items:
                 if w.t_submit:

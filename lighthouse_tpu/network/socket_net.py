@@ -237,7 +237,9 @@ class SocketNet:
         # delivered locally (for scoring) but NEVER forwarded — invalid
         # spam must not ride honest nodes deeper into the mesh, and the
         # penalty must land on the ORIGINAL sender, not on whichever
-        # honest forwarder's frame won a thread race
+        # honest forwarder's frame won a thread race. The gate returns
+        # (forward, decoded); `decoded` rides into the local delivery
+        # so gate + deliver share ONE decode per message.
         self.forward_gate = forward_gate
         self.deliver = None  # set by join()
         self.local_topics: set[str] = set()
@@ -503,16 +505,26 @@ class SocketNet:
             mid = message_id(topic_str.encode() + payload)
             if self._seen_check_and_add(mid):
                 return
+            # the gate runs FIRST and returns (forward, decoded): an
+            # invalid message is not propagated (gossipsub's validate-
+            # before-forward contract), and whatever the gate decoded
+            # is threaded into this message's local delivery — each
+            # message is decoded exactly once per node
+            forward, decoded = True, None
+            if self.forward_gate is not None:
+                forward, decoded = self.forward_gate(topic_str, payload)
             if topic_str in self.local_topics and self.deliver is not None:
-                self.deliver(topic_str, payload, conn.node_id)
-            # flood onward to other interested peers — unless the
-            # node's cheap structural validation rejects the payload
-            # (invalid messages are not propagated; gossipsub's
-            # validate-before-forward contract)
-            if (
-                self.forward_gate is None
-                or self.forward_gate(topic_str, payload)
-            ):
+                if decoded is None:
+                    # legacy 3-arg deliver callbacks (tests, external
+                    # consumers) keep working when the gate decoded
+                    # nothing — the common case for every non-sidecar
+                    # topic
+                    self.deliver(topic_str, payload, conn.node_id)
+                else:
+                    self.deliver(
+                        topic_str, payload, conn.node_id, decoded
+                    )
+            if forward:
                 self._fanout(
                     topic_str, payload, exclude=conn.node_id, mid=mid
                 )
@@ -587,7 +599,9 @@ class SocketNet:
         if cnd is None or mid is None:
             _send_frame(conn.sock, conn.lock, kind, body)
             return True
-        plan = cnd.plan_gossip(self.node_id, conn.node_id, mid)
+        plan = cnd.plan_gossip(
+            self.node_id, conn.node_id, mid, size=len(body)
+        )
         sent = False
         ready = []
         with conn.held_lock:

@@ -27,6 +27,10 @@ from lighthouse_tpu.network.snappy_codec import SnappyError
 from lighthouse_tpu.network.sync import SyncManager
 from lighthouse_tpu.types.helpers import compute_fork_digest
 
+# sentinel for a payload the forward gate tried and FAILED to decode:
+# delivery must still score the sender, but never re-decode the junk
+GATE_UNDECODABLE = object()
+
 
 class BeaconNode:
     def __init__(
@@ -203,32 +207,53 @@ class BeaconNode:
     def _topic_name(self, topic_str: str) -> str:
         return topic_str.split("/")[3]
 
-    def _gossip_forward_gate(self, topic_str: str, data: bytes) -> bool:
+    def _gossip_forward_gate(self, topic_str: str, data: bytes):
         """Cheap STATELESS structural validation gating gossip
         propagation (gossipsub validate-before-forward): a blob sidecar
         with an out-of-range index or a slot beyond the clock horizon is
         provably junk — it is still delivered locally (so the sender
         pays the score), but an honest node must not carry it deeper
         into the mesh. Everything else forwards; the full (stateful,
-        pairing-backed) validation stays on the processor path. The
-        sidecar decodes once more here than on the deliver path — the
-        seen-cache bounds that to once per message per node, the price
-        of keeping the deliver contract untouched."""
+        pairing-backed) validation stays on the processor path.
+
+        Returns ``(forward, decoded)``: `decoded` is the sidecar object
+        when the gate decoded one — the transport threads it through to
+        the SAME message's local delivery, so each gossip message is
+        decoded exactly once per node — `GATE_UNDECODABLE` when the
+        decode failed (delivery scores the sender without paying a
+        second decode), and None for topics the gate never decodes."""
         name = self._topic_name(topic_str)
         if not name.startswith("blob_sidecar"):
-            return True
+            return True, None
         try:
             sidecar = self.chain.t.BlobSidecar.decode(decode_gossip(data))
-        # lint: allow(except-swallow): the False verdict IS the handling
+        # lint: allow(except-swallow): the verdict IS the handling
         except Exception:  # — undecodable spam must not propagate
-            return False
+            return False, GATE_UNDECODABLE
         if int(sidecar.index) >= self.spec.MAX_BLOBS_PER_BLOCK:
-            return False
+            return False, sidecar
         horizon = self.chain.current_slot() + self.spec.SLOTS_PER_EPOCH
-        return int(sidecar.signed_block_header.message.slot) <= horizon
+        forward = (
+            int(sidecar.signed_block_header.message.slot) <= horizon
+        )
+        return forward, sidecar
 
-    def _deliver(self, topic_str: str, data: bytes, from_peer: str):
+    def _deliver(
+        self, topic_str: str, data: bytes, from_peer: str, decoded=None
+    ):
         name = self._topic_name(topic_str)
+        if decoded is GATE_UNDECODABLE:
+            # the forward gate already paid the (failed) decode for
+            # this message — score the sender, decode nothing twice
+            self.hub.report(from_peer, SCORE_INVALID_MESSAGE)
+            return
+        if name.startswith("blob_sidecar") and decoded is not None:
+            # gate-decoded sidecar threaded through: this message's one
+            # decode already happened
+            self.processor.submit(
+                "gossip_blob_sidecar", (decoded, from_peer)
+            )
+            return
         try:
             data = decode_gossip(data)
         except SnappyError:

@@ -20,6 +20,14 @@ deterministic there.
 Delay/reorder carry no wall clock: a held frame is released after N
 later frames pass on the pair (``hold`` in the plan), and the simulator
 force-flushes holds at every slot barrier.
+
+Link-shape DISTRIBUTIONS ride on top of the fate draw (PR 10): every
+delivered frame on a pair pays the policy's base ``latency_holds``, a
+seeded uniform jitter draw in ``[0, latency_jitter_holds]`` (same rng,
+same purity contract), and — under ``bandwidth_bytes_per_hold`` — one
+extra hold per that many payload bytes, so serialization delay is a
+pure function of message size. All in hold units: wall-clock-free,
+byte-identically replayable.
 """
 
 import random
@@ -32,7 +40,7 @@ from lighthouse_tpu.network.rpc import RpcError
 _ACTIONS = REGISTRY.counter_vec(
     "lighthouse_tpu_sim_conditioner_actions_total",
     "network-conditioner decisions on outbound gossip frames "
-    "(deliver|drop|duplicate|delay|reorder|partition_block)",
+    "(deliver|drop|duplicate|delay|reorder|dist_hold|partition_block)",
     ("action",),
 )
 _RPC_FAULTS = REGISTRY.counter_vec(
@@ -61,24 +69,42 @@ class GossipPlan:
 
 @dataclass
 class PairPolicy:
-    """Per-directed-pair fault rates (probabilities per message/call)."""
+    """Per-directed-pair fault rates (probabilities per message/call)
+    plus LINK-SHAPE distributions: every delivered frame on the pair
+    pays `latency_holds` base holds, a seeded uniform jitter draw in
+    [0, latency_jitter_holds], and — when `bandwidth_bytes_per_hold`
+    is set — one extra hold per that many payload bytes (serialization
+    delay as a pure function of message size). Holds are frame-count
+    based like the delay/reorder plans, so the distributions stay
+    wall-clock-free and replay byte-identically from the seed."""
 
     drop_rate: float = 0.0
     duplicate_rate: float = 0.0
     delay_rate: float = 0.0
     reorder_rate: float = 0.0
     rpc_stall_rate: float = 0.0
+    latency_holds: int = 0
+    latency_jitter_holds: int = 0
+    bandwidth_bytes_per_hold: int = 0
+
+    _RATE_KEYS = (
+        "drop_rate", "duplicate_rate", "delay_rate",
+        "reorder_rate", "rpc_stall_rate",
+    )
+    _INT_KEYS = (
+        "latency_holds", "latency_jitter_holds",
+        "bandwidth_bytes_per_hold",
+    )
 
     @classmethod
     def from_dict(cls, doc: dict) -> "PairPolicy":
-        return cls(**{
-            k: float(doc[k])
-            for k in (
-                "drop_rate", "duplicate_rate", "delay_rate",
-                "reorder_rate", "rpc_stall_rate",
-            )
-            if k in doc
-        })
+        kwargs = {
+            k: float(doc[k]) for k in cls._RATE_KEYS if k in doc
+        }
+        kwargs.update(
+            {k: int(doc[k]) for k in cls._INT_KEYS if k in doc}
+        )
+        return cls(**kwargs)
 
 
 @dataclass
@@ -150,31 +176,52 @@ class NetworkConditioner:
     def _policy(self, src: str, dst: str) -> PairPolicy:
         return self.pairs.get((src, dst), self.default)
 
-    def plan_gossip(self, src: str, dst: str, mid: bytes) -> GossipPlan:
+    def plan_gossip(
+        self, src: str, dst: str, mid: bytes, size: int = 0
+    ) -> GossipPlan:
+        """The fate of one outbound frame. `size` (payload bytes) feeds
+        the pair's bandwidth model; every decision — fate draw, delay
+        length, latency jitter — comes from ONE rng seeded on
+        (seed, pair, message-id), so the whole plan is a pure function
+        of those plus the message size."""
         if self.blocked(src, dst):
             _ACTIONS.labels("partition_block").inc()
             return GossipPlan(copies=0)
         pol = self._policy(src, dst)
         rng = random.Random(f"{self.seed}:g:{src}>{dst}:{mid.hex()}")
         r = rng.random()
+        plan = None
         edge = pol.drop_rate
         if r < edge:
             _ACTIONS.labels("drop").inc()
             return GossipPlan(copies=0)
         edge += pol.duplicate_rate
-        if r < edge:
+        if plan is None and r < edge:
             _ACTIONS.labels("duplicate").inc()
-            return GossipPlan(copies=2)
+            plan = GossipPlan(copies=2)
         edge += pol.delay_rate
-        if r < edge:
+        if plan is None and r < edge:
             _ACTIONS.labels("delay").inc()
-            return GossipPlan(copies=1, hold=rng.randrange(2, 4))
+            plan = GossipPlan(copies=1, hold=rng.randrange(2, 4))
         edge += pol.reorder_rate
-        if r < edge:
+        if plan is None and r < edge:
             _ACTIONS.labels("reorder").inc()
-            return GossipPlan(copies=1, hold=1)
-        _ACTIONS.labels("deliver").inc()
-        return GossipPlan()
+            plan = GossipPlan(copies=1, hold=1)
+        if plan is None:
+            _ACTIONS.labels("deliver").inc()
+            plan = GossipPlan()
+        # link-shape distributions ride on top of the fate: base
+        # latency, seeded per-message jitter, and a size-proportional
+        # serialization delay — all in hold units (wall-clock-free)
+        extra = pol.latency_holds
+        if pol.latency_jitter_holds > 0:
+            extra += rng.randrange(0, pol.latency_jitter_holds + 1)
+        if pol.bandwidth_bytes_per_hold > 0 and size > 0:
+            extra += size // pol.bandwidth_bytes_per_hold
+        if extra > 0:
+            _ACTIONS.labels("dist_hold").inc()
+            plan.hold += extra
+        return plan
 
     def check_rpc(self, src: str, dst: str, method: str):
         """Raise the fault (if any) for this outbound RPC call. Raises

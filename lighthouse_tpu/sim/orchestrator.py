@@ -49,7 +49,8 @@ _LOG = get_logger("sim")
 _SPAM_TOTAL = REGISTRY.counter_vec(
     "lighthouse_tpu_sim_spam_messages_total",
     "adversarial messages emitted by simulator fault actors "
-    "(gossip_sidecar|gossip_sidecar_invalid|rpc_burst)",
+    "(gossip_sidecar|gossip_sidecar_invalid|rpc_burst|"
+    "gossip_attestation_flood|rest_read)",
     ("kind",),
 )
 _SLOTS_TOTAL = REGISTRY.counter(
@@ -124,6 +125,7 @@ class Simulation:
         self.nodes: list[SimNode] = []
         self.blob_blocks: dict = {}   # root hex -> n_blobs
         self.eclipse_windows: dict = {}  # name -> (at, until)
+        self.probe_budget: dict = {}  # name -> pre-flood probe median
         self._slot = 0
 
     # ------------------------------------------------------------- build
@@ -141,6 +143,13 @@ class Simulation:
         sn.node.chain.journal.configure(
             capacity=self.scenario.journal_capacity
         )
+        if self.scenario.processor_bounds:
+            # overload scenarios shrink queue bounds so a seeded flood
+            # crosses the shed thresholds within one slot (the shedder
+            # holds the SAME dict, so its hysteresis follows)
+            sn.node.processor.bounds.update(
+                self.scenario.processor_bounds
+            )
         # deterministic sync: no real backoff sleeps, scenario-seeded
         # jitter, and the scenario seed keying every retry schedule
         sn.node.sync._sleep = lambda s: None
@@ -235,6 +244,17 @@ class Simulation:
     def _online(self):
         return [sn for sn in self.nodes if sn.online]
 
+    def _probe_latency(self, sn: SimNode, count: int = 8) -> float:
+        """Median wall latency of a health read against `sn`."""
+        times = []
+        url = sn.base_url() + "/lighthouse/health"
+        for _ in range(count):
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(url, timeout=10):
+                pass
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2]
+
     def _honest_online(self):
         return [
             sn for sn in self._online() if sn.index is not None
@@ -279,6 +299,11 @@ class Simulation:
                     )),
                     sn.node.processor.metrics["processed"],
                     sn.node.processor.metrics["dropped"],
+                    # shed counts are part of quiescence: a flood whose
+                    # frames are still in flight keeps shedding without
+                    # moving queue depths, and the barrier must wait
+                    # for the LAST frame to land before the drain
+                    sn.node.processor.metrics.get("shed", 0),
                 )
                 for sn in self._online()
             )
@@ -630,16 +655,89 @@ class Simulation:
             signed_block_header=header,
         )
 
+    def _junk_attestation(self, t, slot: int, i: int):
+        """Seeded flood attestation (the shared cheap-reject fixture,
+        lighthouse_tpu.testing.make_junk_attestation)."""
+        import hashlib
+
+        from lighthouse_tpu.testing import make_junk_attestation
+
+        tag = hashlib.sha256(
+            f"{self.scenario.seed}:attflood:{slot}:{i}".encode()
+        ).digest()
+        return make_junk_attestation(t, self.spec, slot, tag)
+
+    def _rest_burst(self, sn: SimNode, slot: int, rate: int):
+        """`rate` concurrent REST reads against `sn`'s API, barrier-
+        released so they genuinely overlap: a mix of expensive reads
+        (admission-limited -> some shed 503) and hot cacheable reads
+        (served from the TTL cache after the first store hit). Sheds
+        and cache hits land in the registry; nothing touches the
+        journal, so the canonical replay surface is unaffected."""
+        import threading
+        import urllib.error
+
+        base = sn.base_url()
+        paths = []
+        for i in range(rate):
+            if i % 3 == 0:
+                # expensive class: whole-validator-set walk
+                paths.append("/eth/v1/beacon/states/head/validators")
+            else:
+                # hot cacheable read: finalized checkpoint document
+                paths.append(
+                    "/eth/v1/beacon/states/finalized/"
+                    "finality_checkpoints"
+                )
+        barrier = threading.Barrier(len(paths) + 1)
+
+        def fire(path):
+            barrier.wait(timeout=10)
+            try:
+                with urllib.request.urlopen(base + path, timeout=10):
+                    pass
+            except (urllib.error.HTTPError, OSError) as e:
+                # 503 sheds are the POINT; they are counted by the
+                # admission plane on the server side
+                _LOG.debug("rest flood request refused: %s", e)
+            _SPAM_TOTAL.labels("rest_read").inc()
+
+        threads = [
+            threading.Thread(target=fire, args=(p,), daemon=True)
+            for p in paths
+        ]
+        for th in threads:
+            th.start()
+        barrier.wait(timeout=10)
+        for th in threads:
+            th.join(timeout=15)
+
     def _run_spam(self, slot: int):
         for f in self.scenario.faults:
-            if f.kind not in ("spam_flood", "rpc_flood"):
+            if f.kind not in (
+                "spam_flood", "rpc_flood", "att_flood", "rest_flood"
+            ):
                 continue
             if not f.active(slot):
                 continue
             sn = self._by_name(self.scenario.node_name(f.node))
             if not sn.online:
                 continue
-            if f.kind == "spam_flood":
+            if f.kind == "att_flood":
+                # junk attestation gossip from the actor: every honest
+                # node's attestation queue fills until the shedding
+                # policy's window opens (the overload scenario's
+                # processor_bounds make that happen within one slot)
+                t = sn.chain.t
+                for i in range(f.rate):
+                    att = self._junk_attestation(t, slot, i)
+                    sn.node.publish_attestation(att)
+                    _SPAM_TOTAL.labels("gossip_attestation_flood").inc()
+            elif f.kind == "rest_flood":
+                # `node` names the TARGET here: concurrent REST reads
+                # against its HTTP edge
+                self._rest_burst(sn, slot, f.rate)
+            elif f.kind == "spam_flood":
                 t = sn.chain.t
                 for i in range(f.rate):
                     # one structurally-invalid sidecar per slot prices
@@ -680,6 +778,14 @@ class Simulation:
             return self._run_vc_http()
         snapshot_before = REGISTRY.snapshot()
         self._build()
+        if any(
+            f.kind in ("att_flood", "rest_flood")
+            for f in self.scenario.faults
+        ):
+            # pre-flood serving budget: the overload_recovery invariant
+            # holds every node's POST-flood probes to a multiple of this
+            for sn in self._honest_online():
+                self.probe_budget[sn.name] = self._probe_latency(sn)
         for slot in range(1, self.scenario.slots + 1):
             self._slot = slot
             _SLOTS_TOTAL.inc()
@@ -712,6 +818,7 @@ class Simulation:
             snapshot_after=snapshot_after,
             blob_blocks=dict(self.blob_blocks),
             eclipse_windows=dict(self.eclipse_windows),
+            probe_budget=dict(self.probe_budget),
         )
         violations = inv.check_all(ctx, self.scenario.invariants)
         report = vd.build_report(self, ctx, violations)

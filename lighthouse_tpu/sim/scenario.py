@@ -24,6 +24,10 @@ FAULT_KINDS = (
     "spam_flood",  # node: name/index — junk blob-sidecar gossip, rate/slot
     "rpc_flood",   # node: name/index — req/resp burst per slot at rate
     "kv_crash",    # node: index — torn-WAL crash at at_slot, reboot+resync
+    "att_flood",   # node: ACTOR name/index — junk attestation gossip,
+                   # rate/slot (drives the processor shed plane)
+    "rest_flood",  # node: TARGET name/index — concurrent REST read
+                   # bursts against that node's HTTP API, rate threads
 )
 
 SCENARIO_KINDS = ("multi_node", "vc_http")
@@ -38,17 +42,27 @@ INVARIANT_NAMES = (
     "spam_priced",
     "faults_fired",
     "finalized",
+    "sheds_bounded",
+    "overload_reported",
+    "overload_recovery",
 )
 
-_CONDITIONER_KEYS = {
+_CONDITIONER_RATE_KEYS = {
     "drop_rate", "duplicate_rate", "delay_rate", "reorder_rate",
     "rpc_stall_rate",
 }
+# link-shape distribution knobs: non-negative integers in hold units
+# (see sim/conditioner.PairPolicy)
+_CONDITIONER_INT_KEYS = {
+    "latency_holds", "latency_jitter_holds", "bandwidth_bytes_per_hold",
+}
+_CONDITIONER_KEYS = _CONDITIONER_RATE_KEYS | _CONDITIONER_INT_KEYS
 
 _TOP_KEYS = {
     "name", "kind", "seed", "nodes", "validators", "slots", "backend",
     "spec", "blob_slots", "conditioner", "faults", "invariants",
     "journal_capacity", "adversaries", "description",
+    "processor_bounds",
 }
 
 _FAULT_KEYS = {
@@ -90,6 +104,10 @@ class Scenario:
     faults: list = field(default_factory=list)
     invariants: list = field(default_factory=list)
     journal_capacity: int = 16384
+    # per-run beacon-processor queue-bound overrides (kind -> bound):
+    # overload scenarios shrink a queue so a seeded flood crosses the
+    # shedding policy's high-water mark within one slot
+    processor_bounds: dict = field(default_factory=dict)
     # extra validator-less nodes available as fault actors (spammers)
     adversaries: list = field(default_factory=list)
     description: str = ""
@@ -143,7 +161,13 @@ def validate(doc: dict) -> Scenario:
     if bad:
         _err(name, f"unknown conditioner keys {sorted(bad)}")
     for k, v in cond.items():
-        if not isinstance(v, (int, float)) or not 0 <= v <= 1:
+        if k in _CONDITIONER_INT_KEYS:
+            if not isinstance(v, int) or v < 0:
+                _err(
+                    name,
+                    f"conditioner {k!r} must be a non-negative integer",
+                )
+        elif not isinstance(v, (int, float)) or not 0 <= v <= 1:
             _err(name, f"conditioner {k!r} must be a rate in [0, 1]")
     blob_slots = doc.get("blob_slots", [])
     if not all(
@@ -237,12 +261,53 @@ def validate(doc: dict) -> Scenario:
                 name,
                 f"unknown invariant {inv!r} (one of {INVARIANT_NAMES})",
             )
+    if "sheds_bounded" in invariants:
+        # the invariant cross-checks per-node-LIFE shed counters (reset
+        # on reboot, skipped while offline) against the process-global
+        # registry delta, and its flood bound assumes at-most-once
+        # delivery per node — scenarios breaking either assumption
+        # would report false violations, so the schema refuses them
+        incompatible = sorted(
+            {f.kind for f in faults if f.kind in ("kv_crash", "offline")}
+        )
+        if incompatible:
+            _err(
+                name,
+                f"'sheds_bounded' cannot hold across node reboots/"
+                f"offline windows (faults: {incompatible})",
+            )
+        if cond.get("duplicate_rate", 0) > 0:
+            _err(
+                name,
+                "'sheds_bounded' assumes at-most-once delivery per "
+                "node; set duplicate_rate to 0",
+            )
 
     spec_overrides = doc.get("spec", {})
     if not isinstance(spec_overrides, dict) or not all(
         isinstance(k, str) for k in spec_overrides
     ):
         _err(name, "'spec' must map override names to values")
+
+    processor_bounds = doc.get("processor_bounds", {})
+    if not isinstance(processor_bounds, dict):
+        _err(name, "'processor_bounds' must map work kinds to bounds")
+    if processor_bounds:
+        from lighthouse_tpu.network.beacon_processor import PRIORITIES
+
+        for k, v in processor_bounds.items():
+            if k not in PRIORITIES:
+                _err(
+                    name,
+                    f"processor_bounds: unknown work kind {k!r} "
+                    f"(one of {sorted(PRIORITIES)})",
+                )
+            if not isinstance(v, int) or v < 1:
+                _err(
+                    name,
+                    f"processor_bounds[{k!r}] must be a positive "
+                    "integer",
+                )
 
     return Scenario(
         name=name,
@@ -260,6 +325,7 @@ def validate(doc: dict) -> Scenario:
         journal_capacity=doc.get("journal_capacity", 16384),
         adversaries=list(adversaries),
         description=doc.get("description", ""),
+        processor_bounds=dict(processor_bounds),
     )
 
 

@@ -33,6 +33,10 @@ CANONICAL_KINDS = (
     "peer_downscore",
     "peer_quarantine",
     "sim_fault",
+    # shed-window transitions are protocol claims (the overload run's
+    # whole point): the lockstep barriers make open/close counts a pure
+    # function of the seeded flood volume, so they replay byte-identically
+    "shed_window",
 )
 
 VOLATILE_FIELDS = ("t", "seq", "duration_s")

@@ -307,3 +307,26 @@ def make_grouped_signature_set_batch(
         set_mask,
     )
     return grouped, flat
+
+
+def make_junk_attestation(t, spec, slot: int, tag: bytes):
+    """A structurally-valid attestation that fails CHEAP stateful
+    checks deterministically (committee index 63 is far out of range
+    for the minimal preset) — flood fixtures for the overload plane:
+    the processor queue pays for it, the crypto plane never does.
+    `tag` is the caller's seeded correlation bytes (32), so two flood
+    producers with different seed schemes stay byte-distinct. Shared
+    by sim/orchestrator's att_flood actor and bench_serve's gossip
+    flood so the reject path they exercise cannot drift apart."""
+    epoch = spec.slot_to_epoch(slot)
+    return t.Attestation(
+        aggregation_bits=[True] * 4,
+        data=t.AttestationData(
+            slot=slot,
+            index=63,
+            beacon_block_root=tag,
+            source=t.Checkpoint(epoch=max(0, epoch - 1), root=tag),
+            target=t.Checkpoint(epoch=epoch, root=tag),
+        ),
+        signature=tag * 3,
+    )

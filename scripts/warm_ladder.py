@@ -66,6 +66,25 @@ def main():
             lambda: np.asarray(fn(*args)),
         )
 
+    # the slow-tier shape-variant graphs (PR 10 budget satellite: the
+    # aggregate/ragged-block/grouped tests moved behind @slow because
+    # their distinct-shape compiles ate >590 s of the tier-1 window on
+    # cold boxes — warming them here makes the slow tier and dev loops
+    # cheap again)
+    agg = td.make_aggregate_set_batch(2, 5, seed=3)
+    fn = jax.jit(batch_verify.verify_signature_sets)
+    _t("aggregate 2x5", lambda: np.asarray(fn(*agg)))
+    blk = td.make_block_sets_batch(
+        seed=5, n_attestations=2, committee_size=3
+    )
+    _t("block ragged sets", lambda: np.asarray(fn(*blk)))
+    grouped, flat = td.make_grouped_signature_set_batch(
+        3, 4, max_keys=2, seed=11
+    )
+    _t("flat 3x4 keys=2", lambda: np.asarray(fn(*flat)))
+    gfn = jax.jit(batch_verify.verify_signature_sets_grouped)
+    _t("grouped 3x4", lambda: np.asarray(gfn(*grouped)))
+
     # the re-pointed KZG verify graph at the smallest bucket (tier-1
     # verdict-agreement shape: 3*2 lanes + aux)
     from lighthouse_tpu import kzg

@@ -183,10 +183,12 @@ def test_beacon_processor_journal_events():
     )
     assert proc.submit("gossip_block", "b1")
     assert proc.submit("gossip_block", "b2")
+    # forensic kinds are NEVER shed: a full queue drops (journaled)
     assert not proc.submit("gossip_block", "b3")  # bounded: dropped
     proc.submit("gossip_attestation", "a1")
-    # attestation drop-storm: journaled SAMPLED (first of each
-    # DROP_SAMPLE window), so a flood cannot flush the forensic ring
+    # attestation flood at the bound: the backpressure policy SHEDS at
+    # submit (cheapest-first) — one bounded shed_window event pair,
+    # exact counts on the counter, never a per-item journal entry
     for _ in range(3):
         assert not proc.submit("gossip_attestation", "aX")
     proc.process_pending()
@@ -196,10 +198,14 @@ def test_beacon_processor_journal_events():
         "gossip_block", "gossip_block",
     ]
     drop = j.query(kind="processor_drop")
-    assert [e["attrs"]["work"] for e in drop] == [
-        "gossip_block", "gossip_attestation",
+    assert [e["attrs"]["work"] for e in drop] == ["gossip_block"]
+    shed = j.query(kind="shed_window")
+    assert [(e["outcome"], e["attrs"]["work"]) for e in shed] == [
+        ("opened", "gossip_attestation"),
+        ("closed", "gossip_attestation"),  # closed by the drain
     ]
-    assert drop[1]["attrs"]["dropped_total"] == 1
+    assert proc.shed_state()["shed_total"]["gossip_attestation"] == 3
+    assert proc.shed_state()["active"] == []
     batches = j.query(kind="processor_batch")
     works = [e["attrs"]["work"] for e in batches]
     assert works == ["gossip_block", "gossip_block", "gossip_attestation"]

@@ -206,13 +206,17 @@ def test_beacon_processor_priorities_and_bounds():
     )
     for i in range(5):
         ok = bp.submit("gossip_attestation", i)
-        assert ok == (i < 3), "bounded queue must drop overflow"
+        assert ok == (i < 3), "bounded queue must refuse overflow"
     bp.submit("gossip_block", "b1")
     bp.process_pending()
     # block processed before the attestation batch; batch coalesced
     assert seen[0] == ("block", "b1")
     assert seen[1] == ("atts", [0, 1, 2])
-    assert bp.metrics["dropped"] == 2
+    # overflow of a sheddable kind is SHED by the backpressure policy
+    # (before the queue-full drop could ever fire)
+    assert bp.metrics["shed"] == 2
+    assert bp.metrics["dropped"] == 0
+    assert bp.shed_state()["shed_total"] == {"gossip_attestation": 2}
 
 
 def test_checkpoint_boot_serves_duties_and_backfills(spec):

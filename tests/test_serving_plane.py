@@ -1,0 +1,511 @@
+"""Overload-robust serving plane: admission control, backpressure
+shedding, deadlines, hot-read TTL caches, and the one-decode gossip
+forward gate.
+
+Every wire-level claim here is exercised against the REAL pooled HTTP
+server over OS sockets: 503/429 + Retry-After headers, deadline aborts
+mid-handler, cache invalidation driven by actual block imports, and
+decode-count parity across a real three-node socket mesh.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.harness import Harness
+from lighthouse_tpu.http_api.admission import (
+    AdmissionController,
+    AdmissionError,
+    Deadline,
+    TTLCache,
+    check_deadline,
+    classify,
+)
+from lighthouse_tpu.http_api.server import BeaconApiServer
+from lighthouse_tpu.network.beacon_processor import BeaconProcessor
+from lighthouse_tpu.network.shedding import (
+    FORENSIC_KINDS,
+    SheddingPolicy,
+)
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+# ------------------------------------------------------ shedding policy
+
+
+def test_shedding_hysteresis_up_and_down():
+    pol = SheddingPolicy({"gossip_attestation": 100})
+    # below high water: admit
+    assert not pol.should_shed("gossip_attestation", 74)
+    # at/above high water (0.75): window opens, item shed
+    assert pol.should_shed("gossip_attestation", 75)
+    assert pol.is_shedding("gossip_attestation")
+    # still above LOW water: window stays open even as depth falls
+    assert pol.should_shed("gossip_attestation", 50)
+    assert pol.should_shed("gossip_attestation", 26)
+    # at/below low water (0.25): window closes, item admitted
+    assert not pol.should_shed("gossip_attestation", 25)
+    assert not pol.is_shedding("gossip_attestation")
+    st = pol.state()
+    assert st["shed_total"]["gossip_attestation"] == 3
+    assert st["windows_opened"]["gossip_attestation"] == 1
+    assert st["active"] == []
+
+
+def test_shedding_forensic_kinds_exempt():
+    pol = SheddingPolicy({k: 10 for k in FORENSIC_KINDS})
+    for kind in FORENSIC_KINDS:
+        # even at 10x the bound, forensic work is never shed
+        assert not pol.should_shed(kind, 100)
+    assert pol.state()["shed_total"] == {}
+
+
+def test_shedding_drain_closes_window():
+    j_events = []
+
+    class _J:
+        def emit(self, kind, **kw):
+            j_events.append((kind, kw.get("outcome"), kw.get("work")))
+
+    pol = SheddingPolicy({"sync_message": 8}, journal=_J())
+    assert pol.should_shed("sync_message", 6)
+    # the drain path closes the window with no further submit
+    pol.observe_depth("sync_message", 1)
+    assert not pol.is_shedding("sync_message")
+    assert j_events == [
+        ("shed_window", "opened", "sync_message"),
+        ("shed_window", "closed", "sync_message"),
+    ]
+
+
+def test_shedding_threshold_validation():
+    with pytest.raises(ValueError):
+        SheddingPolicy({}, high_water=0.2, low_water=0.5)
+
+
+def test_processor_shed_integration():
+    proc = BeaconProcessor(
+        handlers={"gossip_attestation": lambda b: None},
+        bounds={"gossip_attestation": 4},
+    )
+    accepted = [proc.submit("gossip_attestation", i) for i in range(8)]
+    # 3 admitted (depth 0,1,2), shed from depth 3 (3/4 >= 0.75)
+    assert accepted == [True] * 3 + [False] * 5
+    assert proc.metrics["shed"] == 5
+    assert proc.metrics["dropped"] == 0
+    proc.process_pending()
+    assert not proc.shedder.is_shedding("gossip_attestation")
+
+
+# ---------------------------------------------------- admission control
+
+
+def test_classify_request_classes():
+    assert classify("GET", "/lighthouse/health") == "cheap_read"
+    assert classify("GET", "/eth/v1/beacon/headers/head") == "cheap_read"
+    assert (
+        classify("GET", "/eth/v1/beacon/states/head/validators")
+        == "expensive_read"
+    )
+    assert (
+        classify("GET", "/eth/v1/beacon/states/head/committees?epoch=1")
+        == "expensive_read"
+    )
+    assert (
+        classify("GET", "/eth/v1/debug/beacon/states/head")
+        == "expensive_read"
+    )
+    assert classify("POST", "/eth/v1/beacon/blocks") == "write"
+    # duty POSTs are read-shaped committee walks: they must not share
+    # the write class a block publish degrades last in
+    assert (
+        classify("POST", "/eth/v1/validator/duties/attester/3")
+        == "expensive_read"
+    )
+    assert (
+        classify("GET", "/eth/v1/validator/duties/proposer/3")
+        == "expensive_read"
+    )
+
+
+def test_admission_concurrency_limit_and_release():
+    ctl = AdmissionController({"expensive_read": (2, 5.0)})
+    s1 = ctl.acquire("expensive_read", "/x")
+    s2 = ctl.acquire("expensive_read", "/x")
+    with pytest.raises(AdmissionError) as e:
+        ctl.acquire("expensive_read", "/x")
+    assert e.value.code == 503
+    assert e.value.retry_after > 0
+    with s1:
+        pass  # releases on exit
+    with s2:
+        pass
+    with ctl.acquire("expensive_read", "/x"):
+        assert ctl.inflight()["expensive_read"] == 1
+    assert ctl.inflight()["expensive_read"] == 0
+
+
+def test_deadline_check_aborts():
+    dl = Deadline(-1.0)  # already expired
+    assert dl.expired()
+    import lighthouse_tpu.http_api.admission as adm
+
+    adm._DEADLINE.value = dl
+    try:
+        with pytest.raises(AdmissionError) as e:
+            check_deadline("unit test")
+        assert e.value.code == 503
+    finally:
+        adm._DEADLINE.value = None
+    # no deadline armed: no-op
+    check_deadline("outside request")
+
+
+def test_ttl_cache_generation_discards_stale_put():
+    """The read-resolve-put race: a response computed BEFORE an
+    invalidation must not be cached AFTER it (it describes the old
+    head)."""
+    c = TTLCache("unit_gen", ttl_s=10.0)
+    gen = c.generation
+    # ... resolver computes against the pre-import head ...
+    c.invalidate()  # import thread moves the head meanwhile
+    c.put("k", {"head": "old"}, generation=gen)
+    hit, _ = c.get("k")
+    assert not hit, "stale-generation put must be discarded"
+    # a put with the CURRENT generation lands
+    c.put("k", {"head": "new"}, generation=c.generation)
+    hit, v = c.get("k")
+    assert hit and v == {"head": "new"}
+
+
+def test_ttl_cache_hit_miss_expire_invalidate():
+    c = TTLCache("unit", ttl_s=0.05, max_entries=2)
+    hit, _ = c.get("k")
+    assert not hit
+    c.put("k", {"v": 1})
+    hit, v = c.get("k")
+    assert hit and v == {"v": 1}
+    time.sleep(0.06)
+    hit, _ = c.get("k")  # expired by TTL
+    assert not hit
+    c.put("k", 1)
+    c.put("k2", 2)
+    c.put("k3", 3)  # bound: evicts oldest
+    assert c.stats()["entries"] == 2
+    c.invalidate()
+    assert c.stats()["entries"] == 0
+    assert c.stats()["invalidations"] == 1
+
+
+# ------------------------------------------------------- wire behavior
+
+
+@pytest.fixture(scope="module")
+def served():
+    spec = minimal_spec(ALTAIR_FORK_EPOCH=0)
+    h = Harness(spec, 16)
+    chain = BeaconChain(h.state.copy(), spec, backend="ref")
+    for slot in range(1, 4):
+        chain.process_block(h.advance_slot_with_block(slot))
+        chain.set_slot(slot)
+    srv = BeaconApiServer(chain).start()
+    yield spec, h, chain, srv
+    srv.stop()
+
+
+def _get(srv, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}{path}", timeout=10
+    )
+
+
+def test_wire_503_with_retry_after_on_concurrency(served):
+    """Occupy the expensive_read class with handler threads parked on
+    an event; the next expensive request is refused 503 + Retry-After
+    while cheap reads still serve."""
+    spec, h, chain, srv = served
+    limit = srv.admission.limits["expensive_read"][0]
+    gate = threading.Event()
+    real = srv.handle_get
+
+    def slow(path, headers=None):
+        if "validators" in path:
+            gate.wait(timeout=10)
+        return real(path, headers)
+
+    srv.handle_get = slow
+    try:
+        parked = [
+            threading.Thread(
+                target=lambda: _get(
+                    srv, "/eth/v1/beacon/states/head/validators"
+                ).read(),
+                daemon=True,
+            )
+            for _ in range(limit)
+        ]
+        for th in parked:
+            th.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if srv.admission.inflight()["expensive_read"] >= limit:
+                break
+            time.sleep(0.01)
+        assert srv.admission.inflight()["expensive_read"] >= limit
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv, "/eth/v1/beacon/states/head/validators")
+        assert e.value.code == 503
+        assert int(e.value.headers["Retry-After"]) >= 1
+        # cheap reads are a separate class: still served
+        assert _get(srv, "/eth/v1/node/version").status == 200
+    finally:
+        gate.set()
+        srv.handle_get = real
+        for th in parked:
+            th.join(timeout=10)
+
+
+def test_wire_deadline_abort_mid_handler(served):
+    """A handler that outlives its class budget aborts with 503 +
+    Retry-After at the next store/state lookup checkpoint."""
+    spec, h, chain, srv = served
+    # the earlier concurrency test may have cached this path's 200
+    srv._hot_caches["state_reads"].invalidate()
+    real = srv.handle_get
+    old = srv.admission.limits["expensive_read"]
+    srv.admission.limits["expensive_read"] = (old[0], 0.05)
+
+    def slow(path, headers=None):
+        if "validators" in path:
+            time.sleep(0.1)  # blow the 50 ms budget...
+            check_deadline("test handler")  # ...abort at the next gate
+        return real(path, headers)
+
+    srv.handle_get = slow
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv, "/eth/v1/beacon/states/head/validators")
+        assert e.value.code == 503
+        assert "Retry-After" in e.value.headers
+    finally:
+        srv.handle_get = real
+        srv.admission.limits["expensive_read"] = old
+
+
+def test_wire_hot_cache_invalidated_on_import(served):
+    """A repeated finalized/head read costs one resolve per TTL window,
+    and a REAL block import invalidates the cache immediately."""
+    spec, h, chain, srv = served
+    cache = srv._hot_caches["state_reads"]
+    cache.invalidate()
+    path = "/eth/v1/beacon/states/finalized/finality_checkpoints"
+    m0 = cache.misses
+    first = json.loads(_get(srv, path).read())
+    for _ in range(5):
+        assert json.loads(_get(srv, path).read()) == first
+    assert cache.misses == m0 + 1, "read flood must hit the cache"
+    assert cache.hits >= 5
+    # a real import through the chain fires the invalidation hook
+    inv0 = cache.invalidations
+    chain.process_block(h.advance_slot_with_block(4))
+    chain.set_slot(4)
+    assert cache.invalidations == inv0 + 1
+    m1 = cache.misses
+    _get(srv, path).read()
+    assert cache.misses == m1 + 1, "post-import read must re-resolve"
+
+
+def test_wire_429_when_processor_saturated(served):
+    """POSTs that enqueue processor work answer 429 + Retry-After while
+    the matching kind's shed window is open; block publishes (forensic)
+    are never gated."""
+    spec, h, chain, srv = served
+
+    class _NodeStub:
+        processor = BeaconProcessor(
+            handlers={"gossip_attestation": lambda b: None},
+            bounds={"gossip_attestation": 4},
+        )
+
+    srv.node = _NodeStub()
+    proc = srv.node.processor
+    try:
+        for i in range(4):  # open the shed window
+            proc.submit("gossip_attestation", i)
+        assert proc.shedder.is_shedding("gossip_attestation")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/eth/v1/beacon/pool/attestations",
+            data=b"[]",
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 429
+        assert int(e.value.headers["Retry-After"]) >= 1
+        # draining closes the window; the endpoint serves again
+        proc.process_pending()
+        assert json.loads(
+            urllib.request.urlopen(req, timeout=10).read()
+        ) == {}
+    finally:
+        srv.node = None
+
+
+def test_accept_queue_overflow_returns_raw_503():
+    """The outermost shed point: a full accept queue answers a raw 503
+    and closes — overload never grows a thread."""
+    from lighthouse_tpu.http_api.server import PooledHTTPServer
+
+    class _H:
+        def __init__(self, *a, **kw):
+            raise AssertionError("no worker should run")
+
+    srv = PooledHTTPServer(
+        ("127.0.0.1", 0), _H, workers=0, accept_queue=1
+    )
+    try:
+        import socket as _socket
+
+        class _FakeSock:
+            def __init__(self):
+                self.sent = b""
+                self.closed = False
+
+            def sendall(self, b):
+                self.sent += b
+
+            def close(self):
+                self.closed = True
+
+            def shutdown(self, how):
+                pass
+
+        s1, s2 = _FakeSock(), _FakeSock()
+        srv.process_request(s1, ("127.0.0.1", 1))  # fills the queue
+        srv.process_request(s2, ("127.0.0.1", 2))  # overflow: raw 503
+        assert b"503" in s2.sent and b"Retry-After" in s2.sent
+        assert s2.closed
+        assert srv.accept_shed == 1
+        assert not s1.sent
+    finally:
+        srv.server_close()
+
+
+# ------------------------------------------- forward-gate decode parity
+
+
+def test_gossip_sidecar_decoded_exactly_once_per_node():
+    """Satellite of PR 9's accepted finding: the forward gate's decode
+    is threaded through to delivery, so one published sidecar costs
+    each receiving node exactly ONE BlobSidecar.decode."""
+    from lighthouse_tpu.node import BeaconNode
+
+    spec = minimal_spec(
+        name="decode-parity", ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=1
+    )
+    h = Harness(spec, 8, backend="fake")
+    nodes = [
+        BeaconNode(f"dp{i}", h.state, spec, backend="fake")
+        for i in range(3)
+    ]
+    nets = [n.attach_socket_net() for n in nodes]
+    try:
+        nets[0].connect(nets[1].host, nets[1].tcp_port)
+        nets[0].connect(nets[2].host, nets[2].tcp_port)
+        nets[1].connect(nets[2].host, nets[2].tcp_port)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not all(
+            len(n.peers) >= 2 for n in nets
+        ):
+            time.sleep(0.01)
+        assert all(len(n.peers) >= 2 for n in nets)
+
+        t = nodes[0].chain.t
+        cls = t.BlobSidecar
+        counts = {"n": 0}
+        real_decode = cls.decode
+
+        def counting_decode(data):
+            counts["n"] += 1
+            return real_decode(data)
+
+        cls.decode = staticmethod(counting_decode)
+        try:
+            blob = bytes(32) * spec.FIELD_ELEMENTS_PER_BLOB
+            header = t.SignedBeaconBlockHeader(
+                message=t.BeaconBlockHeader(
+                    slot=1,
+                    proposer_index=0,
+                    parent_root=b"\x11" * 32,
+                    state_root=b"\x22" * 32,
+                    body_root=b"\x33" * 32,
+                ),
+                signature=b"\x44" * 96,
+            )
+            sidecar = t.BlobSidecar(
+                index=0,
+                blob=blob,
+                kzg_commitment=b"\x55" * 48,
+                kzg_proof=b"\x66" * 48,
+                signed_block_header=header,
+            )
+            nodes[0].publish_blob_sidecar(sidecar)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                depths = [
+                    n.processor.queue_depths()["gossip_blob_sidecar"]
+                    for n in nodes[1:]
+                ]
+                if all(d >= 1 for d in depths):
+                    break
+                time.sleep(0.01)
+            assert all(
+                n.processor.queue_depths()["gossip_blob_sidecar"] == 1
+                for n in nodes[1:]
+            ), "both receivers must see the sidecar once"
+            # let any straggling forwarded duplicates land (they are
+            # deduped by message id and must cost zero decodes)
+            time.sleep(0.2)
+            assert counts["n"] == 2, (
+                f"expected one decode per receiving node, got "
+                f"{counts['n']}"
+            )
+        finally:
+            cls.decode = real_decode
+    finally:
+        for n in nets:
+            n.close()
+
+
+def test_forward_gate_undecodable_scores_without_second_decode():
+    """Junk that fails the gate's decode is never decoded again: the
+    delivery path scores the sender off the sentinel."""
+    from lighthouse_tpu.node import BeaconNode, GATE_UNDECODABLE
+
+    spec = minimal_spec(name="decode-junk")
+    h = Harness(spec, 8, backend="fake")
+    node = BeaconNode("dj0", h.state, spec, backend="fake")
+    forward, decoded = node._gossip_forward_gate(
+        "/eth2/00000000/blob_sidecar_0/ssz_snappy", b"\xff garbage"
+    )
+    assert forward is False and decoded is GATE_UNDECODABLE
+
+    reports = []
+
+    class _Hub:
+        def report(self, peer, delta):
+            reports.append((peer, delta))
+
+    node.hub = _Hub()
+    node._deliver(
+        "/eth2/00000000/blob_sidecar_0/ssz_snappy",
+        b"\xff garbage",
+        "evil",
+        decoded=GATE_UNDECODABLE,
+    )
+    assert reports and reports[0][0] == "evil" and reports[0][1] < 0

@@ -108,6 +108,43 @@ def test_conditioner_masks_and_rpc():
         c3.check_rpc("a", "b", "status")  # never raises
 
 
+def test_conditioner_distributions_are_seeded_and_sized():
+    """Per-pair bandwidth/latency DISTRIBUTIONS (not just fixed
+    per-message holds): seeded jitter replays exactly, varies across
+    messages, and the bandwidth model charges holds proportional to
+    message size."""
+    pol = PairPolicy(
+        latency_holds=1,
+        latency_jitter_holds=3,
+        bandwidth_bytes_per_hold=100,
+    )
+    mids = [bytes([i]) * 20 for i in range(64)]
+    c1 = NetworkConditioner(seed=7, default=pol)
+    c2 = NetworkConditioner(seed=7, default=pol)
+    plans1 = [c1.plan_gossip("a", "b", m, size=50) for m in mids]
+    plans2 = [c2.plan_gossip("a", "b", m, size=50) for m in mids]
+    assert [(p.copies, p.hold) for p in plans1] == [
+        (p.copies, p.hold) for p in plans2
+    ], "same (seed, pair, mid, size) must replay the same plan"
+    holds = [p.hold for p in plans1]
+    # base latency floor: every frame pays at least latency_holds
+    assert min(holds) >= 1
+    # the jitter DISTRIBUTION actually spreads (not one fixed hold)
+    assert len(set(holds)) > 1
+    assert max(holds) <= 1 + 3  # base + jitter cap (size < bandwidth)
+    # bandwidth: a 350-byte frame pays 3 extra holds over a 50-byte one
+    small = c1.plan_gossip("a", "b", b"\xaa" * 20, size=50)
+    big = c1.plan_gossip("a", "b", b"\xaa" * 20, size=350)
+    assert big.hold - small.hold == 3
+    # a different seed reshuffles the jitter draws
+    c3 = NetworkConditioner(seed=8, default=pol)
+    assert [
+        c3.plan_gossip("a", "b", m, size=50).hold for m in mids
+    ] != holds
+    # distributions never change the fate: copies stay 1
+    assert all(p.copies == 1 for p in plans1)
+
+
 # -------------------------------------------------------- scenario spec
 
 
@@ -152,6 +189,45 @@ def test_scenario_validation_rejects_bad_documents():
         ]))
     with pytest.raises(scenario_mod.ScenarioError):
         validate(_base_doc(blob_slots=[99]))
+    # link-shape distribution knobs are integers, not rates
+    with pytest.raises(scenario_mod.ScenarioError):
+        validate(_base_doc(conditioner={"latency_jitter_holds": 0.5}))
+    with pytest.raises(scenario_mod.ScenarioError):
+        validate(_base_doc(conditioner={"latency_holds": -1}))
+    validate(_base_doc(conditioner={"latency_jitter_holds": 2}))
+    # processor_bounds: known work kinds, positive integers
+    with pytest.raises(scenario_mod.ScenarioError):
+        validate(_base_doc(processor_bounds={"martian_work": 4}))
+    with pytest.raises(scenario_mod.ScenarioError):
+        validate(_base_doc(processor_bounds={"gossip_attestation": 0}))
+    validate(_base_doc(processor_bounds={"gossip_attestation": 64}))
+    # overload fault kinds ride the standard node/window validation
+    with pytest.raises(scenario_mod.ScenarioError):
+        validate(_base_doc(faults=[
+            {"kind": "att_flood", "at_slot": 2, "node": "ghost"},
+        ]))
+    validate(_base_doc(
+        adversaries=["f0"],
+        faults=[
+            {"kind": "att_flood", "at_slot": 2, "until_slot": 4,
+             "node": "f0", "rate": 32},
+            {"kind": "rest_flood", "at_slot": 2, "until_slot": 4,
+             "node": 0, "rate": 8},
+        ],
+    ))
+    # sheds_bounded is incompatible with reboots and duplicate delivery
+    # (per-node-life counters vs global registry; at-most-once bound)
+    with pytest.raises(scenario_mod.ScenarioError):
+        validate(_base_doc(
+            invariants=["sheds_bounded"],
+            faults=[{"kind": "offline", "at_slot": 2, "until_slot": 4,
+                     "node": 0}],
+        ))
+    with pytest.raises(scenario_mod.ScenarioError):
+        validate(_base_doc(
+            invariants=["sheds_bounded"],
+            conditioner={"duplicate_rate": 0.1},
+        ))
 
 
 def test_scenario_library_gate():
@@ -162,7 +238,7 @@ def test_scenario_library_gate():
     entries = scenario_mod.list_scenarios()
     names = {s.name for _, s in entries}
     # the acceptance scenarios must stay committed
-    assert {"smoke_mixed", "eclipse", "vc_http"} <= names
+    assert {"smoke_mixed", "eclipse", "vc_http", "overload"} <= names
     # every scenario must assert SOMETHING
     for _, s in entries:
         assert s.invariants, s.name
@@ -368,6 +444,37 @@ def test_fallback_client_facade_semantics():
 def test_slow_fault_matrix(name, tmp_path):
     report = _run_scenario(name, tmp=str(tmp_path))
     assert report["ok"], report["violations"]
+
+
+@pytest.mark.slow
+def test_overload_scenario_sheds_and_recovers():
+    """The serving-plane acceptance scenario, run TWICE with one seed:
+    under a mixed REST + gossip flood every victim keeps importing
+    (forensic kinds never shed), shed counters grow and stay bounded,
+    the shed windows land in the journal and in /lighthouse/health,
+    the hot-read cache absorbs the read flood, post-flood probes serve
+    within the pre-flood budget — and the canonical journals replay
+    byte-identically (the shed-window record is part of the replay
+    surface)."""
+    r1 = _run_scenario("overload")
+    assert r1["ok"], r1["violations"]
+    diff = r1["registry_diff"]
+    assert diff.get(
+        'lighthouse_tpu_sim_spam_messages_total'
+        '{kind="gossip_attestation_flood"}', 0) > 0
+    assert diff.get(
+        'lighthouse_tpu_sim_spam_messages_total{kind="rest_read"}', 0
+    ) > 0
+    # the shed windows are part of the canonical forensic record
+    assert any(
+        '"kind": "shed_window"' in jsonl
+        for jsonl in r1["journals"].values()
+    )
+    r2 = _run_scenario("overload")
+    assert r2["ok"], r2["violations"]
+    assert r1["journals"] == r2["journals"], (
+        "overload run must replay byte-identically from its seed"
+    )
 
 
 @pytest.mark.slow
