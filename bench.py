@@ -531,15 +531,15 @@ def _measure_kzg_msm(jax, platform):
         for i in range(n)
     )
     t0 = time.perf_counter()
-    first = kzg.blob_to_kzg_commitment(blob, setup, backend="tpu")
+    first = kzg.blob_to_kzg_commitment(blob, setup, backend="tpu", consumer="bench")
     compile_s = time.perf_counter() - t0
-    assert first == kzg.blob_to_kzg_commitment(blob, setup), (
+    assert first == kzg.blob_to_kzg_commitment(blob, setup, consumer="bench"), (
         "kzg: device commitment disagrees with the host oracle"
     )
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        kzg.blob_to_kzg_commitment(blob, setup, backend="tpu")
+        kzg.blob_to_kzg_commitment(blob, setup, backend="tpu", consumer="bench")
         times.append(time.perf_counter() - t0)
     p50 = sorted(times)[len(times) // 2]
     on_tpu = platform in ("tpu", "axon")
@@ -577,20 +577,22 @@ def _measure_kzg_fold(jax, platform):
             ((k * 997 + i * 31 + 1) % (2**128)).to_bytes(32, "big")
             for i in range(blob_n)
         )
-        comm = kzg.blob_to_kzg_commitment(blob, setup)
+        comm = kzg.blob_to_kzg_commitment(blob, setup, consumer="bench")
         blobs.append(blob)
         comms.append(comm)
-        proofs.append(kzg.compute_blob_kzg_proof(blob, comm, setup))
+        proofs.append(kzg.compute_blob_kzg_proof(blob, comm, setup, consumer="bench"))
 
     def batch_once():
         assert kzg.verify_blob_kzg_proof_batch(
-            blobs, comms, proofs, backend="tpu", setup=setup, seed=7
+            blobs, comms, proofs, backend="tpu", setup=setup, seed=7,
+            consumer="bench"
         )
 
     def singles_once():
         for b, c, p in zip(blobs, comms, proofs):
             assert kzg.verify_blob_kzg_proof_batch(
-                [b], [c], [p], backend="tpu", setup=setup, seed=7
+                [b], [c], [p], backend="tpu", setup=setup, seed=7,
+                consumer="bench"
             )
 
     t0 = time.perf_counter()

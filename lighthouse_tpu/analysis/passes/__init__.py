@@ -7,6 +7,9 @@ live next to each other so a reviewer can audit the invariant without
 reading the framework.
 """
 
+from lighthouse_tpu.analysis.passes.consumer_label import (
+    ConsumerLabelPass,
+)
 from lighthouse_tpu.analysis.passes.device_purity import DevicePurityPass
 from lighthouse_tpu.analysis.passes.exception_hygiene import (
     ExceptionHygienePass,
@@ -25,6 +28,7 @@ PASS_CLASSES = (
     HandlerHygienePass,
     ExceptionHygienePass,
     MetricNamesPass,
+    ConsumerLabelPass,
 )
 
 

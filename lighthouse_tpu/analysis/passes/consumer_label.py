@@ -1,0 +1,95 @@
+"""Consumer-label pass: device-plane entry points carry explicit
+attribution.
+
+The observability layer prices the device plane PER CONSUMER
+(common/device_attribution): every batch entering the BLS/KZG/MSM/
+sharded planes is labeled with who pays it, the sim's
+`attribution_complete` invariant cross-checks the labels against the
+forensic journal, and the ROADMAP's verification-bus scheduler will
+consume the per-consumer cost model. A single call site that forgets
+``consumer=`` silently regresses the whole attribution — so the rule is
+mechanical: every package call of a device-plane entry point must pass
+an EXPLICIT ``consumer=`` keyword (``consumer=None`` is allowed — it
+reads as a deliberate "unattributed"; forwarding through ``**kwargs``
+is not, explicitness is the point).
+
+Exemption: calls whose receiver is the raw device-graph namespace
+``batch_verify`` (``ops/batch_verify.py`` shares the
+``verify_signature_sets`` name with the api boundary but is the
+shape-level jit graph, below the attribution boundary).
+"""
+
+import ast
+
+from lighthouse_tpu.analysis.core import LintPass, attr_chain
+
+# the attribution boundary: api dispatchers, their tpu backends, the
+# sharded program builders, and the KZG producer/verify surface
+ENTRY_POINTS = {
+    "verify_signature_sets",
+    "verify_signature_set_batches",
+    "verify_signature_sets_individually",
+    "verify_signature_sets_tpu",
+    "verify_signature_set_batches_tpu",
+    "verify_signature_sets_tpu_individual",
+    "verify_blob_kzg_proof_batch",
+    "verify_blob_kzg_proof_batch_tpu",
+    "blob_to_kzg_commitment",
+    "compute_kzg_proof",
+    "compute_blob_kzg_proof",
+    "g1_msm_tpu",
+    "g1_msm_fixed_base_tpu",
+    "sharded_verify_signature_sets",
+    "sharded_verify_signature_sets_grouped",
+}
+
+# raw jit-graph namespace sharing names with the api boundary
+EXEMPT_RECEIVERS = {"batch_verify"}
+
+
+class ConsumerLabelPass(LintPass):
+    name = "consumer-label"
+    description = (
+        "device-plane entry points are called with an explicit "
+        "consumer= keyword so per-consumer attribution cannot "
+        "silently regress"
+    )
+
+    def run(self, modules):
+        findings = []
+        for m in modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = self._entry_point_name(node.func)
+                if name is None:
+                    continue
+                if any(kw.arg == "consumer" for kw in node.keywords):
+                    continue
+                findings.append(
+                    self.finding(
+                        m,
+                        node,
+                        f"device-plane entry point '{name}' called "
+                        "without an explicit consumer= keyword "
+                        "(device_attribution.CONSUMERS)",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _entry_point_name(func):
+        """The matched entry-point name for a call's func expression,
+        or None (not an entry point / exempt raw-graph receiver)."""
+        if isinstance(func, ast.Name):
+            return func.id if func.id in ENTRY_POINTS else None
+        if isinstance(func, ast.Attribute):
+            if func.attr not in ENTRY_POINTS:
+                return None
+            chain = attr_chain(func)
+            if chain and len(chain) >= 2 and (
+                chain[-2] in EXEMPT_RECEIVERS
+            ):
+                return None
+            return func.attr
+        return None

@@ -124,14 +124,22 @@ def batch_verify_unaggregated(chain, state, attestations):
                 else AttestationError(str(e))
             )
     if sets:
-        ok = bls.verify_signature_sets(sets, backend=chain.backend)
+        ok = bls.verify_signature_sets(
+            sets,
+            backend=chain.backend,
+            consumer="gossip_single",
+            journal=chain.journal,
+        )
         # batch failure -> exact per-set verdicts in ONE extra device
         # call (per-set residues), not a round trip per set
         verdicts = (
             [True] * len(sets)
             if ok
             else bls.verify_signature_sets_individually(
-                sets, backend=chain.backend
+                sets,
+                backend=chain.backend,
+                consumer="gossip_single",
+                journal=chain.journal,
             )
         )
         for (i, indices), good in zip(set_owner, verdicts):
@@ -198,12 +206,20 @@ def batch_verify_aggregates(chain, state, signed_aggregates):
             )
     if triples:
         flat = [s for triple in triples for s in triple]
-        ok = bls.verify_signature_sets(flat, backend=chain.backend)
+        ok = bls.verify_signature_sets(
+            flat,
+            backend=chain.backend,
+            consumer="gossip_single",
+            journal=chain.journal,
+        )
         if ok:
             verdicts = [True] * len(triples)
         else:
             per_set = bls.verify_signature_sets_individually(
-                flat, backend=chain.backend
+                flat,
+                backend=chain.backend,
+                consumer="gossip_single",
+                journal=chain.journal,
             )
             verdicts = [
                 all(per_set[3 * i : 3 * i + 3])

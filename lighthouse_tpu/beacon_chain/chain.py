@@ -114,6 +114,9 @@ class BeaconChain:
         # separate forensic records (common/events_journal.py)
         self.journal = Journal()
         self.store = HotColdDB(kv or MemoryStore(), spec)
+        # state replay re-verifies deposit signatures; keep those
+        # batches on this node's forensic record
+        self.store.journal = self.journal
         self.pubkey_cache = PubkeyCache()
         self.pubkey_cache.import_new(genesis_state)
         self.slot_clock = slot_clock
@@ -526,6 +529,8 @@ class BeaconChain:
                     self.pubkey_cache,
                     backend=self.backend,
                     execution_engine=engine,
+                    consumer="gossip_single",
+                    journal=self.journal,
                 )
         except BlockProcessingError as e:
             raise BlockError(str(e)) from e
@@ -659,8 +664,15 @@ class BeaconChain:
         # one collector spanning the segment: per_block_processing feeds
         # it each block's sets (built eagerly against the in-hand
         # advanced state) and leaves finish() to us
+        # consumer/journal ride on the collector so the deposit checks
+        # INSIDE per_block_processing (verified individually regardless
+        # of strategy) stay attributed and journaled too
         collector = SignatureCollector(
-            BlockSignatureStrategy.VERIFY_BULK, backend=self.backend
+            BlockSignatureStrategy.VERIFY_BULK,
+            backend=self.backend,
+            consumer="sync_segment",
+            journal=self.journal,
+            slot=int(signed_blocks[-1].message.slot),
         )
         roots = []
         state = None
@@ -685,18 +697,18 @@ class BeaconChain:
                 )
             except BlockProcessingError as e:
                 raise BlockError(f"segment block invalid: {e}") from e
+        # signature-batch membership: the api layer journals one
+        # consumer-attributed event per batch (how many sets from how
+        # many blocks shared this bulk verification, plus the device
+        # lane/waste economics), so a segment failure is attributable
+        # to the batch that carried it
         batch_ok = bool(collector.sets) and bls.verify_signature_sets(
-            collector.sets, backend=self.backend
-        )
-        # signature-batch membership: one event records how many sets
-        # from how many blocks shared this bulk verification, so a
-        # segment failure is attributable to the batch that carried it
-        self.journal.emit(
-            "signature_batch",
+            collector.sets,
+            backend=self.backend,
+            consumer="sync_segment",
+            journal=self.journal,
             slot=int(signed_blocks[-1].message.slot),
-            outcome="ok" if batch_ok else "failed",
-            n_sets=len(collector.sets),
-            n_blocks=len(signed_blocks),
+            journal_attrs={"n_blocks": len(signed_blocks)},
         )
         if not batch_ok:
             raise BlockError("segment signature batch failed")
@@ -757,6 +769,9 @@ class BeaconChain:
                     )
                 ],
                 backend=self.backend,
+                consumer="sidecar_header",
+                journal=self.journal,
+                slot=int(msg.slot),
             )
         except Exception as e:
             # malformed points/unknown proposer index verify to False;
@@ -869,6 +884,9 @@ class BeaconChain:
             self._copy_state(parent_state), block.slot, spec
         )
         engine = _EngineAdapter(self.execution_layer)
+        # NO_VERIFICATION skips the batch-checked signatures, but
+        # deposit signatures still verify individually — keep them
+        # attributed and journaled on the sync path
         per_block_processing(
             state,
             signed_block,
@@ -876,6 +894,8 @@ class BeaconChain:
             BlockSignatureStrategy.NO_VERIFICATION,
             self.pubkey_cache,
             execution_engine=engine,
+            consumer="sync_segment",
+            journal=self.journal,
         )
         if bytes(block.state_root) != cached_state_root(state):
             raise BlockError("state root mismatch")
@@ -1256,12 +1276,17 @@ class BeaconChain:
         """Trial-run the block (signatures skipped) on a cache-carried
         copy and stamp its post-state root."""
         trial = self._copy_state(state)
+        # deposit signatures (packed from the eth1 queue) verify
+        # individually even under NO_VERIFICATION — attribute them to
+        # the op-packing consumer
         per_block_processing(
             trial,
             signed_cls(message=block, signature=b"\x00" * 96),
             self.spec,
             BlockSignatureStrategy.NO_VERIFICATION,
             self.pubkey_cache,
+            consumer="oppool",
+            journal=self.journal,
         )
         block.state_root = cached_state_root(trial)
         return block

@@ -258,6 +258,7 @@ class DataAvailabilityChecker:
             [bytes(sc.kzg_commitment) for sc in sidecars],
             [bytes(sc.kzg_proof) for sc in sidecars],
             backend=self.backend,
+            consumer="kzg",
         )
 
     # ------------------------------------------------------------- queries

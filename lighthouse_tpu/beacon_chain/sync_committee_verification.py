@@ -138,13 +138,21 @@ def batch_verify_sync_messages(chain, state, messages):
                 else SyncCommitteeError(str(e))
             )
     if sets:
-        ok = bls.verify_signature_sets(sets, backend=chain.backend)
+        ok = bls.verify_signature_sets(
+            sets,
+            backend=chain.backend,
+            consumer="gossip_single",
+            journal=chain.journal,
+        )
         # batch failure -> per-set verdicts in one extra device call
         verdicts = (
             [True] * len(sets)
             if ok
             else bls.verify_signature_sets_individually(
-                sets, backend=chain.backend
+                sets,
+                backend=chain.backend,
+                consumer="gossip_single",
+                journal=chain.journal,
             )
         )
         for (i, positions), good in zip(owners, verdicts):
@@ -236,12 +244,20 @@ def batch_verify_contributions(chain, state, signed_contributions):
             )
     if triples:
         flat = [s for triple in triples for s in triple]
-        ok = bls.verify_signature_sets(flat, backend=chain.backend)
+        ok = bls.verify_signature_sets(
+            flat,
+            backend=chain.backend,
+            consumer="gossip_single",
+            journal=chain.journal,
+        )
         if ok:
             verdicts = [True] * len(triples)
         else:
             per_set = bls.verify_signature_sets_individually(
-                flat, backend=chain.backend
+                flat,
+                backend=chain.backend,
+                consumer="gossip_single",
+                journal=chain.journal,
             )
             verdicts = [
                 all(per_set[3 * i : 3 * i + 3])

@@ -191,7 +191,7 @@ def measure(jax, platform) -> dict:
             ]
         )
     verdicts = bls.verify_signature_set_batches(
-        batches, backend="tpu", seed=7
+        batches, backend="tpu", seed=7, consumer="oppool"
     )
     assert all(verdicts), "benchmark batch failed to verify"
     t_verify = time.perf_counter()

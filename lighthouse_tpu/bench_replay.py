@@ -110,7 +110,9 @@ def measure(jax, platform):
         t0 = time.perf_counter()
         for b in blocks:
             replayer.import_block(
-                b, strategy=BlockSignatureStrategy.VERIFY_BULK
+                b,
+                strategy=BlockSignatureStrategy.VERIFY_BULK,
+                consumer="bench",
             )
         return time.perf_counter() - t0
 

@@ -64,7 +64,7 @@ def _build_node():
     h = Harness(spec, N_VALIDATORS, backend="fake")
     node = BeaconNode("bench0", h.state, spec, backend="fake")
     for slot in range(1, CHAIN_SLOTS + 1):
-        block = h.advance_slot_with_block(slot)
+        block = h.advance_slot_with_block(slot, consumer="bench")
         node.on_slot(slot)
         node.chain.process_block(block)
     return h, node
@@ -121,8 +121,38 @@ def _class_quantiles():
     return out
 
 
+def _device_seconds_snapshot() -> dict:
+    """{(consumer, plane): (batches, seconds)} from the attribution
+    histogram family — diffed around the run so the summary reports the
+    measured per-consumer device seconds, not process history."""
+    fam = REGISTRY.get("lighthouse_tpu_device_seconds")
+    out = {}
+    if fam is None:
+        return out
+    for key, child in fam.children().items():
+        out[key] = (child.n, child.total)
+    return out
+
+
+def _consumer_device_report(before: dict, after: dict) -> dict:
+    report: dict = {}
+    for key, (n1, s1) in after.items():
+        n0, s0 = before.get(key, (0, 0.0))
+        if n1 - n0 <= 0:
+            continue
+        consumer, plane = key
+        doc = report.setdefault(
+            consumer, {"batches": 0, "device_s": 0.0}
+        )
+        doc["batches"] += n1 - n0
+        doc["device_s"] = round(doc["device_s"] + (s1 - s0), 5)
+        doc.setdefault("planes", []).append(plane)
+    return report
+
+
 def measure(jax, platform):
     shed_enabled = os.environ.get("BENCH_SERVE_SHED", "1") != "0"
+    device_before = _device_seconds_snapshot()
     if platform == "cpu":
         n_threads, reqs_per_thread = 4, 40
         cache_reads, flood_n, rpc_n = 200, 400, 50
@@ -249,6 +279,11 @@ def measure(jax, platform):
         "rpc_rate_limited": rpc_limited,
         "rpc_per_sec": round(rpc_n / rpc_wall_s, 2),
         "shed_enabled": shed_enabled,
+        # who paid the device plane during the run (the measured
+        # per-class device seconds the self-tuning serving item needs)
+        "consumer_device_seconds": _consumer_device_report(
+            device_before, _device_seconds_snapshot()
+        ),
         # a node-local serving measurement, never a hardware headline
         "valid_for_headline": False,
     }

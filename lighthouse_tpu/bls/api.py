@@ -24,9 +24,11 @@ from __future__ import annotations
 import hashlib
 import os
 import secrets
+import time
 
 from lighthouse_tpu.bls import point_serde
 from lighthouse_tpu.bls.hash_to_curve import hash_to_g2
+from lighthouse_tpu.common import device_attribution as attribution
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.crypto import ref_pairing
@@ -297,8 +299,44 @@ def aggregate_verify(pubkeys, messages, sig: Signature) -> bool:
 # ----------------------------------------------------------- batch dispatch
 
 
+def _journal_batch(
+    journal, consumer, ok, n_sets, backend, slot, extra=None,
+    individual=False,
+):
+    """One `signature_batch` journal event per dispatched batch, with
+    the consumer label and (when the tpu backend marshalled it on this
+    thread) the batch's exact lane/waste economics. Draining the
+    thread-local pending records even when journal is None keeps the
+    window scoped to one call."""
+    records = attribution.take_batches()
+    if journal is None:
+        return
+    attrs = {"consumer": consumer, "n_sets": n_sets, "backend": backend}
+    if individual:
+        attrs["individual"] = True
+    if len(records) == 1 and records[0].get("lanes") is not None:
+        r = records[0]
+        attrs["lanes"] = r["lanes"]
+        attrs["waste"] = r.get("waste", 0)
+        attrs["amortized_fixed_ms"] = r.get("amortized_fixed_ms")
+    if extra:
+        attrs.update(extra)
+    journal.emit(
+        "signature_batch",
+        slot=slot,
+        outcome="ok" if ok else "failed",
+        **attrs,
+    )
+
+
 def verify_signature_sets(
-    sets, backend: str | None = None, seed: int | None = None
+    sets,
+    backend: str | None = None,
+    seed: int | None = None,
+    consumer: str | None = None,
+    journal=None,
+    slot: int | None = None,
+    journal_attrs: dict | None = None,
 ) -> bool:
     """Batch-verify signature sets — the north-star boundary
     (blst.rs:36-119 verify_signature_sets).
@@ -306,13 +344,23 @@ def verify_signature_sets(
     Empty batches fail. On the tpu backend the whole batch becomes one
     device multi-pairing with >=64-bit RLC scalars; "ref" verifies each set
     with an independent pairing check (ground truth); "fake" returns True.
+
+    `consumer` names who pays this batch (device_attribution.CONSUMERS;
+    the consumer-label lint requires it explicitly at every package call
+    site). `journal` (a chain's events journal) makes the batch a
+    `signature_batch` forensic event carrying the consumer, set count,
+    and — on the tpu backend — the exact lanes/padding-waste economics;
+    `slot`/`journal_attrs` enrich that event.
     """
     sets = list(sets)
     if not sets:
         return False
     backend = backend or _DEFAULT_BACKEND
+    consumer = attribution.note_sets(consumer, len(sets))
     _VERIFY_SETS.inc(len(sets))
     _VERIFY_BATCH_SIZE.observe(len(sets))
+    attribution.begin_batch_window()
+    t0 = time.perf_counter()
     with _VERIFY_BATCH_SECONDS.time(), span(
         "verify", n_sets=len(sets), backend=backend
     ):
@@ -325,15 +373,32 @@ def verify_signature_sets(
                 verify_signature_sets_tpu,
             )
 
-            result = verify_signature_sets_tpu(sets, seed=seed)
+            result = verify_signature_sets_tpu(
+                sets, seed=seed, consumer=consumer
+            )
         else:
             raise BlsError(f"unknown BLS backend {backend!r}")
+    if backend != "tpu":
+        # host backends have no lane padding; the batch still counts
+        attribution.note_batch(
+            consumer, "bls", lanes=None, live=len(sets),
+            duration_s=time.perf_counter() - t0,
+        )
     _VERIFY_BATCHES.labels(backend, "ok" if result else "fail").inc()
+    _journal_batch(
+        journal, consumer, result, len(sets), backend, slot,
+        extra=journal_attrs,
+    )
     return result
 
 
 def verify_signature_set_batches(
-    batches, backend: str | None = None, seed: int | None = None
+    batches,
+    backend: str | None = None,
+    seed: int | None = None,
+    consumer: str | None = None,
+    journal=None,
+    slot: int | None = None,
 ) -> list:
     """Verify several batches with host/device overlap: on the tpu
     backend batch N+1 marshals while batch N verifies on device
@@ -346,15 +411,44 @@ def verify_signature_set_batches(
             verify_signature_set_batches_tpu,
         )
 
-        return verify_signature_set_batches_tpu(batches, seed=seed)
+        consumer = attribution.normalize(consumer)
+        attribution.begin_batch_window()
+        results = verify_signature_set_batches_tpu(
+            batches, seed=seed, consumer=consumer
+        )
+        attribution.take_batches()  # economics live in the registry
+        for b, ok in zip(batches, results):
+            if not b:
+                continue
+            attribution.note_sets(consumer, len(b))
+            if journal is not None:
+                journal.emit(
+                    "signature_batch",
+                    slot=slot,
+                    outcome="ok" if ok else "failed",
+                    consumer=consumer,
+                    n_sets=len(b),
+                    backend=backend,
+                    streamed=True,
+                )
+        return results
     return [
-        verify_signature_sets(b, backend=backend) if b else False
+        verify_signature_sets(
+            b, backend=backend, consumer=consumer, journal=journal,
+            slot=slot,
+        )
+        if b
+        else False
         for b in batches
     ]
 
 
 def verify_signature_sets_individually(
-    sets, backend: str | None = None
+    sets,
+    backend: str | None = None,
+    consumer: str | None = None,
+    journal=None,
+    slot: int | None = None,
 ) -> list:
     """Per-set verdicts for a batch — the exact-fallback half of the
     reference's batch semantics (attestation batch.rs:115-131): when the
@@ -365,14 +459,30 @@ def verify_signature_sets_individually(
     if not sets:
         return []
     backend = backend or _DEFAULT_BACKEND
+    consumer = attribution.note_sets(consumer, len(sets))
+    attribution.begin_batch_window()
+    t0 = time.perf_counter()
     if backend == "fake":
-        return [True] * len(sets)
-    if backend == "ref":
-        return [_verify_one_ref(s) for s in sets]
-    if backend == "tpu":
+        out = [True] * len(sets)
+    elif backend == "ref":
+        out = [_verify_one_ref(s) for s in sets]
+    elif backend == "tpu":
         from lighthouse_tpu.bls.tpu_backend import (
             verify_signature_sets_tpu_individual,
         )
 
-        return verify_signature_sets_tpu_individual(sets)
-    raise BlsError(f"unknown BLS backend {backend!r}")
+        out = verify_signature_sets_tpu_individual(
+            sets, consumer=consumer
+        )
+    else:
+        raise BlsError(f"unknown BLS backend {backend!r}")
+    if backend != "tpu":
+        attribution.note_batch(
+            consumer, "bls", lanes=None, live=len(sets),
+            duration_s=time.perf_counter() - t0,
+        )
+    _journal_batch(
+        journal, consumer, all(out), len(sets), backend, slot,
+        individual=True,
+    )
+    return out

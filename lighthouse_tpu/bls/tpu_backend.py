@@ -23,7 +23,6 @@ blst.rs:72-81.
 """
 
 import secrets
-import threading
 import time
 
 import numpy as np
@@ -31,6 +30,8 @@ import numpy as np
 import jax
 
 from lighthouse_tpu.bls.hash_to_curve import hash_to_g2
+from lighthouse_tpu.common import device_attribution as attribution
+from lighthouse_tpu.common.compile_ledger import LEDGER
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
@@ -58,36 +59,23 @@ _MARSHAL_SECONDS = REGISTRY.histogram_vec(
     ("phase",),
 )
 
-# last observed XLA trace-cache size per (entry point, jit object):
-# impl-key flips build NEW jax.jit objects whose caches start empty, so
-# the delta must not be computed against the old object's size (the jit
-# objects live forever in the _jitted* caches, so id() cannot be reused)
-_XLA_CACHE_SIZES: dict = {}
-_XLA_CACHE_LOCK = threading.Lock()
-
-
 def _note_wrapper_event(fn_name: str, hit: bool):
     _JIT_EVENTS.labels(fn_name, "wrapper", "hit" if hit else "trace").inc()
 
 
-def _note_xla_events(fn_name: str, jitted):
-    """Compare the jitted object's trace-cache size against the last
-    observation: growth means this dispatch retraced (new shape class),
-    otherwise it hit a compiled program. The size dict is read-modify-
-    write under a lock — concurrent worker dispatches must not count
-    one compile as two retraces. Version-tolerant — older jax without
-    _cache_size just skips the xla layer."""
-    try:
-        size = jitted._cache_size()
-    # lint: allow(except-swallow): version probe, documented above —
-    except Exception:  # older jax: the xla layer just goes dark
-        return
-    key = (fn_name, id(jitted))
-    with _XLA_CACHE_LOCK:
-        prev = _XLA_CACHE_SIZES.get(key, 0)
-        grew = size - prev
-        if grew > 0:
-            _XLA_CACHE_SIZES[key] = size
+def _note_xla_events(fn_name: str, jitted, shape="", duration_s=None):
+    """Classify this dispatch as retrace (the jitted object's trace
+    cache grew — a new shape class compiled) or hit, via the process
+    compile LEDGER which owns the cache-size bookkeeping (read-modify-
+    write under its lock — concurrent worker dispatches must not count
+    one compile as two retraces) and records the structured entry with
+    impl key, shape bucket, and dispatch wall time. Version-tolerant —
+    older jax without _cache_size records warm."""
+    grew = LEDGER.note_dispatch(
+        fn_name, jitted, _impl_key(), shape, duration_s=duration_s
+    )
+    if grew is None:
+        return  # unclassifiable (old jax): the xla layer goes dark
     if grew > 0:
         _JIT_EVENTS.labels(fn_name, "xla", "retrace").inc(grew)
     else:
@@ -587,7 +575,18 @@ def _record_stats(n_sets, m, t_start, t_subgroup, t_marshal, t_end):
     )
 
 
-def verify_signature_sets_tpu(sets, seed: int | None = None) -> bool:
+def _shape_key(m) -> str:
+    """Shape-bucket string for the compile ledger: the (set, key)
+    bucket class this marshal compiled/hit."""
+    if m.grouped:
+        g_b, sg_b = m.set_mask.shape
+        return f"g{g_b}x{sg_b}k{m.k_bucket}"
+    return f"s{m.s_bucket}k{m.k_bucket}"
+
+
+def verify_signature_sets_tpu(
+    sets, seed: int | None = None, consumer: str | None = None
+) -> bool:
     t_start = time.perf_counter()
     # host-side policy checks (exact reference semantics)
     with span("verify/subgroup_check", n_sets=len(sets)):
@@ -614,9 +613,15 @@ def verify_signature_sets_tpu(sets, seed: int | None = None) -> bool:
         indexed=m.table is not None,
     ):
         result = bool(np.asarray(_dispatch(m, rand_bits)))
-    _record_stats(
-        len(sets), m, t_start, t_subgroup, t_marshal, time.perf_counter()
+    t_end = time.perf_counter()
+    attribution.note_batch(
+        consumer,
+        "bls",
+        lanes=m.s_bucket,
+        live=len(sets),
+        duration_s=t_end - t_marshal,
     )
+    _record_stats(len(sets), m, t_start, t_subgroup, t_marshal, t_end)
     return result
 
 
@@ -626,8 +631,13 @@ LAST_STREAM_STATS: dict = {}
 
 def _dispatch(m, rand_bits):
     """Async device dispatch of a marshalled batch — returns the
-    unforced device value."""
+    unforced device value. The dispatch call is timed for the compile
+    ledger: JAX dispatch is async, so a cold (retraced) call's wall is
+    dominated by trace+compile while a warm call's is dispatch
+    overhead."""
     CALL_COUNTS["batch"] += 1
+    shape = _shape_key(m)
+    t0 = time.perf_counter()
     if m.grouped:
         # rand bits were sampled for s_bucket lanes; the grouped verify
         # takes them on the (G, Sg) grid
@@ -641,13 +651,18 @@ def _dispatch(m, rand_bits):
                 m.msgs, m.sigs, tx, ty, m.indices, m.key_mask,
                 rand_bits, m.set_mask, m.group_mask,
             )
-            _note_xla_events("verify_grouped_indexed", indexed)
+            _note_xla_events(
+                "verify_grouped_indexed", indexed, shape,
+                time.perf_counter() - t0,
+            )
         else:
             out = plain(
                 m.msgs, m.sigs, m.pubkeys, m.key_mask, rand_bits,
                 m.set_mask, m.group_mask,
             )
-            _note_xla_events("verify_grouped", plain)
+            _note_xla_events(
+                "verify_grouped", plain, shape, time.perf_counter() - t0
+            )
         return out
     if m.table is not None:
         tx, ty = m.table.rows()
@@ -656,17 +671,21 @@ def _dispatch(m, rand_bits):
             m.msgs, m.sigs, tx, ty, m.indices, m.key_mask, rand_bits,
             m.set_mask,
         )
-        _note_xla_events("verify_indexed", fn)
+        _note_xla_events(
+            "verify_indexed", fn, shape, time.perf_counter() - t0
+        )
         return out
     fn = _get_fn()
     out = fn(
         m.msgs, m.sigs, m.pubkeys, m.key_mask, rand_bits, m.set_mask
     )
-    _note_xla_events("verify", fn)
+    _note_xla_events("verify", fn, shape, time.perf_counter() - t0)
     return out
 
 
-def verify_signature_set_batches_tpu(batches, seed=None) -> list:
+def verify_signature_set_batches_tpu(
+    batches, seed=None, consumer: str | None = None
+) -> list:
     """Streamed (double-buffered) verification of several batches: batch
     N+1 is marshalled on the host WHILE batch N runs on the device.
 
@@ -700,6 +719,12 @@ def verify_signature_set_batches_tpu(batches, seed=None) -> list:
         )
         host_ms += time.perf_counter() - t0
         ok = _dispatch(m, rand_bits)
+        # per-batch economics; duration omitted — the double-buffered
+        # overlap makes per-batch device time unmeasurable (the whole
+        # call's wall is observed once below)
+        attribution.note_batch(
+            consumer, "bls", lanes=m.s_bucket, live=len(sets)
+        )
         n_dispatched += 1
         if pending is not None:
             results[pending[0]] = bool(np.asarray(pending[1]))
@@ -707,6 +732,8 @@ def verify_signature_set_batches_tpu(batches, seed=None) -> list:
     if pending is not None:
         results[pending[0]] = bool(np.asarray(pending[1]))
     wall_ms = (time.perf_counter() - t_wall0) * 1e3
+    if n_dispatched:
+        attribution.observe_seconds(consumer, "bls", wall_ms / 1e3)
     LAST_STREAM_STATS.clear()
     LAST_STREAM_STATS.update(
         {
@@ -750,7 +777,9 @@ def _get_individual_fns():
     return _jitted_individual, _jitted_individual_indexed
 
 
-def verify_signature_sets_tpu_individual(sets) -> list:
+def verify_signature_sets_tpu_individual(
+    sets, consumer: str | None = None
+) -> list:
     """Per-set verdicts in ONE device call — the batch-failure fallback
     without per-set round trips (attestation batch.rs:115-131 made
     device-shaped; SURVEY §7 hard part 5)."""
@@ -774,22 +803,36 @@ def verify_signature_sets_tpu_individual(sets) -> list:
 
     plain_fn, indexed_fn = _get_individual_fns()
     CALL_COUNTS["individual"] += 1
+    shape = _shape_key(m)
     with span("verify/device", s_bucket=m.s_bucket, individual=True):
+        t0 = time.perf_counter()
         if m.table is not None:
             tx, ty = m.table.rows()
             ok = indexed_fn(
                 m.msgs, m.sigs, tx, ty, m.indices, m.key_mask, m.set_mask
             )
-            _note_xla_events("verify_individual_indexed", indexed_fn)
+            _note_xla_events(
+                "verify_individual_indexed", indexed_fn, shape,
+                time.perf_counter() - t0,
+            )
         else:
             ok = plain_fn(
                 m.msgs, m.sigs, m.pubkeys, m.key_mask, m.set_mask
             )
-            _note_xla_events("verify_individual", plain_fn)
+            _note_xla_events(
+                "verify_individual", plain_fn, shape,
+                time.perf_counter() - t0,
+            )
         ok = np.asarray(ok)
+    t_end = time.perf_counter()
     for j, i in enumerate(live):
         verdicts[i] = bool(ok[j])
-    _record_stats(
-        len(sets), m, t_start, t_subgroup, t_marshal, time.perf_counter()
+    attribution.note_batch(
+        consumer,
+        "bls",
+        lanes=m.s_bucket,
+        live=len(live),
+        duration_s=t_end - t_marshal,
     )
+    _record_stats(len(sets), m, t_start, t_subgroup, t_marshal, t_end)
     return verdicts

@@ -54,7 +54,8 @@ def _apply_trace_flags(args) -> None:
 
 
 def _apply_journal_flags(chain, args) -> None:
-    """Size (or disable, with 0) the node's lifecycle event journal."""
+    """Size (or disable, with 0) the node's lifecycle event journal;
+    point the process compile ledger at its persistent JSONL file."""
     from lighthouse_tpu.common import events_journal
 
     capacity = getattr(
@@ -63,6 +64,11 @@ def _apply_journal_flags(chain, args) -> None:
     chain.journal.configure(
         enabled=capacity > 0, capacity=max(capacity, 1)
     )
+    ledger_path = getattr(args, "compile_ledger", None)
+    if ledger_path:
+        from lighthouse_tpu.common.compile_ledger import LEDGER
+
+        LEDGER.configure(path=ledger_path)
 
 
 def _export_trace(args, chain=None) -> None:
@@ -671,6 +677,14 @@ def build_parser():
         default=None,
         help="write the buffered journal events to this JSONL file on "
         "shutdown (chaos-run forensics input)",
+    )
+    bn.add_argument(
+        "--compile-ledger",
+        default=None,
+        help="append every COLD jit (re)compile event to this "
+        "persistent JSONL ledger (warm dispatches stay in the "
+        "in-memory ring served at GET /lighthouse/compiles; env "
+        "LIGHTHOUSE_TPU_COMPILE_LEDGER is the flagless spelling)",
     )
     bn.set_defaults(fn=cmd_bn)
 

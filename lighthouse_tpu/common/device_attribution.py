@@ -1,0 +1,205 @@
+"""Per-consumer device-plane attribution: who pays the batch boundary.
+
+The whole design funnels every BLS signature through ONE batch boundary
+(`verify_signature_sets`, PAPER.md / blst.rs) — but the boundary is
+shared by very different consumers (gossip single-object batches, sync
+segment bulks, sidecar header checks, op-pool revalidation, the
+slasher, the KZG plane, benches), and the ROADMAP's verification-bus
+refactor needs DATA on which consumer pays the ~90 ms fixed device cost
+alone, how often, and how much lane padding is wasted doing it ("
+Performance of EdDSA and BLS Signatures in Committee-Based Consensus",
+PAPERS.md, is the per-committee cost model this reproduces).
+
+This module owns that vocabulary and the metric families:
+
+  * ``lighthouse_tpu_device_batches_total{consumer,plane,lanes}`` — one
+    inc per dispatched batch; `lanes` is the bucketed device lane count
+    (``host`` for the ref/fake backends, which have no padding).
+  * ``lighthouse_tpu_device_sets_total{consumer}`` — signature sets
+    entering the BLS plane per consumer (the series the sim's
+    `attribution_complete` invariant cross-checks against the journal).
+  * ``lighthouse_tpu_device_seconds{consumer,plane}`` — device (or
+    host-verify) wall time per batch.
+  * padding-waste accounting: the marshal layer always knew
+    ``s_bucket``/``k_bucket``, it just never reported them —
+    ``device_padding_waste_lanes`` (last batch, gauge),
+    ``device_waste_lanes_total`` / ``device_live_lanes_total``
+    (cumulative; waste fraction = waste / (waste + live)).
+  * ``lighthouse_tpu_device_amortized_fixed_ms{consumer,plane}`` — the
+    fixed-cost amortization estimate for the LAST batch: the Pallas
+    scaling model's fixed device cost (PERF_NOTES: p50 ≈ 90 ms +
+    97 µs/sig) divided by the batch's live sets. A consumer whose gauge
+    sits near FIXED_DEVICE_COST_MS is paying the whole dispatch alone —
+    exactly the traffic the verification bus exists to merge.
+
+Consumer labels are a CLOSED vocabulary (`CONSUMERS`); `normalize`
+raises on anything else, and the ``consumer-label`` lint pass
+(analysis/passes/consumer_label.py) statically requires every package
+call site of a device-plane entry point to pass ``consumer=``
+explicitly, so attribution cannot silently regress.
+
+`note_batch` also records the batch's economics in a THREAD-LOCAL
+pending list so the dispatching API layer (bls/api, which owns the
+journal emission) can attach exact lanes/waste numbers to the
+`signature_batch` journal event without racing concurrent worker
+threads' batches.
+"""
+
+import threading
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+# the closed consumer vocabulary — every device-plane call site names
+# one of these (None normalizes to "unattributed", which production
+# call sites never pass: the lint keeps them explicit)
+CONSUMERS = frozenset(
+    {
+        "gossip_single",   # gossip object batches (blocks, atts, sync msgs)
+        "sync_segment",    # range-sync / backfill bulk segment batches
+        "sidecar_header",  # blob-sidecar proposer-header checks
+        "oppool",          # op-pool / aggregation revalidation
+        "kzg",             # KZG proof verification + producer MSMs
+        "slasher",         # slashing-proof verification
+        "bench",           # benchmarks and measurement harnesses
+    }
+)
+UNATTRIBUTED = "unattributed"
+
+# fixed device cost of one batch dispatch, from the measured Pallas
+# scaling model (PERF_NOTES: p50 ≈ 90 ms + 97 µs/sig at S<=30720)
+FIXED_DEVICE_COST_MS = 90.0
+
+_BATCHES = REGISTRY.counter_vec(
+    "lighthouse_tpu_device_batches_total",
+    "device-plane batch dispatches by consumer, plane, and bucketed "
+    "lane count (lanes='host' for ref/fake backends)",
+    ("consumer", "plane", "lanes"),
+)
+_SETS = REGISTRY.counter_vec(
+    "lighthouse_tpu_device_sets_total",
+    "signature sets entering the BLS verification plane, by consumer",
+    ("consumer",),
+)
+_SECONDS = REGISTRY.histogram_vec(
+    "lighthouse_tpu_device_seconds",
+    "per-batch device (or host-verify) wall time by consumer and plane",
+    ("consumer", "plane"),
+)
+_WASTE_GAUGE = REGISTRY.gauge_vec(
+    "lighthouse_tpu_device_padding_waste_lanes",
+    "padding lanes (bucket minus live sets) of the LAST batch",
+    ("consumer", "plane"),
+)
+_WASTE_TOTAL = REGISTRY.counter_vec(
+    "lighthouse_tpu_device_waste_lanes_total",
+    "cumulative padding lanes dispatched (bucket minus live sets)",
+    ("consumer", "plane"),
+)
+_LIVE_TOTAL = REGISTRY.counter_vec(
+    "lighthouse_tpu_device_live_lanes_total",
+    "cumulative live lanes dispatched (the waste denominator partner)",
+    ("consumer", "plane"),
+)
+_AMORTIZED = REGISTRY.gauge_vec(
+    "lighthouse_tpu_device_amortized_fixed_ms",
+    "estimated fixed-device-cost share per live set of the LAST batch "
+    "(FIXED_DEVICE_COST_MS / live sets)",
+    ("consumer", "plane"),
+)
+
+_TLS = threading.local()
+
+
+def normalize(consumer) -> str:
+    """None -> 'unattributed'; unknown labels raise (fail-loud, the
+    bench exit-4 convention — a typo must not silently misattribute)."""
+    if consumer is None or consumer == UNATTRIBUTED:
+        return UNATTRIBUTED
+    if consumer not in CONSUMERS:
+        raise ValueError(
+            f"unknown device-plane consumer {consumer!r} "
+            f"(one of {sorted(CONSUMERS)} or None)"
+        )
+    return consumer
+
+
+def note_sets(consumer, n: int) -> str:
+    """Count `n` signature sets entering the BLS plane; returns the
+    normalized consumer label."""
+    consumer = normalize(consumer)
+    _SETS.labels(consumer).inc(n)
+    return consumer
+
+
+def begin_batch_window():
+    """Open this thread's batch-economics window — the BLS api layer
+    calls it before dispatching so `take_batches` returns exactly the
+    batches of the call it wraps. Outside an open window `note_batch`
+    records metrics only (the KZG/MSM/sharded planes have no journal
+    emission to feed, and a no-window append would leak one dict per
+    batch on threads that never drain)."""
+    _TLS.pending = []
+
+
+def take_batches() -> list:
+    """Drain this thread's pending batch-economics records (one dict
+    per `note_batch` since `begin_batch_window`) and CLOSE the
+    window."""
+    out = getattr(_TLS, "pending", None) or []
+    _TLS.pending = None
+    return out
+
+
+def note_batch(
+    consumer,
+    plane: str,
+    lanes,
+    live: int,
+    duration_s: float | None = None,
+):
+    """Record one dispatched batch: counters, waste/amortization
+    gauges, and the thread-local pending record for journal attrs.
+
+    `lanes` is the bucketed lane count (int) or None for host backends
+    (no padding concept — counted under lanes='host', no waste)."""
+    consumer = normalize(consumer)
+    lanes_label = "host" if lanes is None else str(int(lanes))
+    _BATCHES.labels(consumer, plane, lanes_label).inc()
+    record = {
+        "consumer": consumer,
+        "plane": plane,
+        "lanes": None if lanes is None else int(lanes),
+        "live": int(live),
+    }
+    if duration_s is not None:
+        _SECONDS.labels(consumer, plane).observe(duration_s)
+        record["duration_s"] = duration_s
+    if lanes is not None:
+        waste = max(0, int(lanes) - int(live))
+        _WASTE_GAUGE.labels(consumer, plane).set(waste)
+        _WASTE_TOTAL.labels(consumer, plane).inc(waste)
+        _LIVE_TOTAL.labels(consumer, plane).inc(int(live))
+        amortized = FIXED_DEVICE_COST_MS / max(1, int(live))
+        _AMORTIZED.labels(consumer, plane).set(amortized)
+        record["waste"] = waste
+        record["amortized_fixed_ms"] = round(amortized, 3)
+    pending = getattr(_TLS, "pending", None)
+    if pending is not None:  # window open: the api layer will drain
+        pending.append(record)
+    return record
+
+
+def observe_seconds(consumer, plane: str, seconds: float):
+    """Record wall time against a consumer without a batch record (the
+    streamed multi-batch path: per-batch device time is hidden by the
+    double-buffered overlap, so the whole call observes once)."""
+    _SECONDS.labels(normalize(consumer), plane).observe(seconds)
+
+
+def consumer_totals() -> dict:
+    """{consumer: cumulative sets} from the registry — the notifier's
+    per-consumer throughput read (no series creation side effect)."""
+    out = {}
+    for (consumer,), child in _SETS.children().items():
+        out[consumer] = child.value
+    return out

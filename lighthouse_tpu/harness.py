@@ -260,7 +260,7 @@ class Harness:
 
     # ------------------------------------------------------------ import
 
-    def import_block(self, signed_block, strategy=None):
+    def import_block(self, signed_block, strategy=None, consumer=None):
         spec = self.spec
         state = self.state.copy()
         carry_tree_cache(state, self.state)
@@ -275,6 +275,7 @@ class Harness:
             self.pubkey_cache,
             backend=self.backend,
             seed=int(signed_block.message.slot) + 1,
+            consumer=consumer,
         )
         # verify the block's claimed post-state root
         post_root = cached_state_root(state)
@@ -286,16 +287,18 @@ class Harness:
 
     # ----------------------------------------------------------- driving
 
-    def advance_slot_with_block(self, slot: int, strategy=None):
+    def advance_slot_with_block(self, slot: int, strategy=None,
+                                consumer=None):
         """Produce + import the block for `slot` including all pending
         attestations, then attest at `slot` with every committee.
-        `strategy` forwards to import_block (e.g. NO_VERIFICATION for a
-        builder whose blocks will be verified elsewhere)."""
+        `strategy`/`consumer` forward to import_block (e.g.
+        NO_VERIFICATION for a builder whose blocks will be verified
+        elsewhere; consumer="bench" in measurement harnesses)."""
         capacity = self.spec.MAX_ATTESTATIONS
         atts = self.pending_attestations[:capacity]
         self.pending_attestations = self.pending_attestations[capacity:]
         block = self.produce_block(slot, atts)
-        self.import_block(block, strategy=strategy)
+        self.import_block(block, strategy=strategy, consumer=consumer)
         self.pending_attestations.extend(
             self.make_attestations(self.state, slot)
         )
@@ -329,7 +332,7 @@ class Harness:
                     blob=bytes(blob),
                     kzg_commitment=commitment,
                     kzg_proof=kzg.compute_blob_kzg_proof(
-                        bytes(blob), commitment
+                        bytes(blob), commitment, consumer="kzg"
                     ),
                     signed_block_header=header,
                 )

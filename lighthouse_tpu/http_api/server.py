@@ -34,6 +34,8 @@ Endpoints (the operative subset):
   GET  /lighthouse/events?root=...&slot=...&kind=...&peer=...&outcome=...
        (object-lifecycle journal forensics)
   GET  /lighthouse/metrics/snapshot  (flat registry snapshot for diffs)
+  GET  /lighthouse/compiles  (process compile ledger: jit (re)compiles
+       with impl key, shape bucket, cold/warm, wall duration)
   GET  /lighthouse/tpu/stats  (chain internals namespace)
   GET  /eth/v1/validator/attestation_data?slot=...&committee_index=...
   GET  /eth/v1/validator/aggregate_attestation?slot=...&attestation_data_root=...
@@ -99,7 +101,7 @@ _CACHE_STATS = REGISTRY.gauge_vec(
 _ROUTE_SEGMENTS = frozenset(
     """
     eth lighthouse v1 v2 metrics spans health tpu stats node beacon
-    snapshot
+    snapshot compiles
     config validator debug events genesis states headers blocks blinded
     blob_sidecars pool duties liveness register_validator blinded_blocks
     aggregate_and_proofs contribution_and_proofs aggregate_attestation
@@ -1005,6 +1007,20 @@ class BeaconApiServer:
             return {
                 "data": events,
                 "meta": chain.journal.stats(),
+            }
+        if parts[:2] == ["lighthouse", "compiles"]:
+            # the process compile ledger: every jit dispatch with its
+            # impl key, shape bucket, cold/warm status and wall
+            # duration — tier-1's cold-compile dominance and watcher
+            # sweeps as structured data instead of log archaeology.
+            # PROCESS-global (jit caches are process state, not chain
+            # state), unlike /lighthouse/events.
+            from lighthouse_tpu.common.compile_ledger import LEDGER
+
+            q = self._query(path)
+            return {
+                "data": LEDGER.entries(self._int_q(q, "limit")),
+                "meta": LEDGER.stats(),
             }
         if parts[:3] == ["lighthouse", "metrics", "snapshot"]:
             # flat registry snapshot (series key -> value): the remote

@@ -24,6 +24,7 @@ ops/kzg_verify), "fake" (always true).
 
 import hashlib
 import secrets
+import time
 
 import numpy as np
 
@@ -32,6 +33,7 @@ from lighthouse_tpu.bls.point_serde import (
     g1_compress,
     g1_decompress,
 )
+from lighthouse_tpu.common import device_attribution as attribution
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.crypto.constants import R
@@ -192,21 +194,31 @@ def _g1_lincomb(points_affine, scalars):
     return acc
 
 
-def _msm_backend(scalars, setup: TrustedSetup, backend: str):
+def _msm_backend(
+    scalars, setup: TrustedSetup, backend: str,
+    consumer: str | None = None,
+):
     """Producer-side MSM dispatch over the setup's G1 powers — the same
     ref|tpu|fake selection surface as `verify_blob_kzg_proof_batch`.
     Returns a Jacobian point (compression happens at the caller)."""
     n = len(scalars)
     if backend == "ref":
-        return _g1_lincomb(setup.g1_powers[:n], scalars)
+        t0 = time.perf_counter()
+        out = _g1_lincomb(setup.g1_powers[:n], scalars)
+        attribution.note_batch(
+            consumer, "msm", lanes=None, live=n,
+            duration_s=time.perf_counter() - t0,
+        )
+        return out
     if backend == "tpu":
         from lighthouse_tpu.kzg.tpu_backend import g1_msm_fixed_base_tpu
 
-        return g1_msm_fixed_base_tpu(scalars, setup)
+        return g1_msm_fixed_base_tpu(scalars, setup, consumer=consumer)
     if backend == "fake":
         # fake crypto plane: commitments/proofs are structural bytes
         # only (the fake verifier accepts everything), so the identity
         # point — cheap and round-trippable — stands in
+        attribution.note_batch(consumer, "msm", lanes=None, live=n)
         return G1_GROUP.infinity
     raise KzgError(f"unknown KZG backend {backend!r}")
 
@@ -218,6 +230,7 @@ def blob_to_kzg_commitment(
     blob: bytes,
     setup: TrustedSetup | None = None,
     backend: str = "ref",
+    consumer: str | None = None,
 ) -> bytes:
     """Commit to the blob: C = sum_i b_i [tau^i]G1, compressed. The MSM
     runs on the selected backend (ref = host Pippenger oracle, tpu =
@@ -229,7 +242,9 @@ def blob_to_kzg_commitment(
     with _MSM_SECONDS.labels(backend, "commit").time(), span(
         "kzg/commit_msm", n=len(poly), backend=backend
     ):
-        return g1_compress(_msm_backend(poly, s, backend))
+        return g1_compress(
+            _msm_backend(poly, s, backend, consumer=consumer)
+        )
 
 
 def compute_kzg_proof(
@@ -237,6 +252,7 @@ def compute_kzg_proof(
     z: int,
     setup: TrustedSetup | None = None,
     backend: str = "ref",
+    consumer: str | None = None,
 ) -> tuple:
     """KZG opening proof at z: W = commit((p(X) - p(z)) / (X - z)).
     Returns (proof_bytes48, y = p(z)). The quotient MSM runs on the
@@ -254,7 +270,9 @@ def compute_kzg_proof(
     with _MSM_SECONDS.labels(backend, "proof").time(), span(
         "kzg/proof_msm", n=len(q), backend=backend
     ):
-        proof = g1_compress(_msm_backend(q, s, backend))
+        proof = g1_compress(
+            _msm_backend(q, s, backend, consumer=consumer)
+        )
     return proof, y
 
 
@@ -274,11 +292,16 @@ def compute_blob_kzg_proof(
     commitment: bytes,
     setup: TrustedSetup | None = None,
     backend: str = "ref",
+    consumer: str | None = None,
 ) -> bytes:
     """Proof for the blob at its own Fiat-Shamir challenge point — the
     sidecar-production path (c-kzg compute_blob_kzg_proof)."""
     proof, _ = compute_kzg_proof(
-        blob, compute_challenge(blob, commitment), setup, backend=backend
+        blob,
+        compute_challenge(blob, commitment),
+        setup,
+        backend=backend,
+        consumer=consumer,
     )
     return proof
 
@@ -403,6 +426,7 @@ def verify_blob_kzg_proof_batch(
     backend: str = "ref",
     setup: TrustedSetup | None = None,
     seed: int | None = None,
+    consumer: str | None = None,
 ) -> bool:
     """Batch availability check: N (blob, commitment, proof) triples in
     ONE pairing-product identity (two Miller pairs total, any N).
@@ -418,6 +442,7 @@ def verify_blob_kzg_proof_batch(
     if not blobs:
         return True
     _BATCH_SIZE.observe(len(blobs))
+    t0 = time.perf_counter()
     with _VERIFY_SECONDS.labels(backend).time(), span(
         "kzg/verify_batch", n=len(blobs), backend=backend
     ):
@@ -433,10 +458,16 @@ def verify_blob_kzg_proof_batch(
             )
 
             result = verify_blob_kzg_proof_batch_tpu(
-                blobs, commitments, proofs, setup=setup, seed=seed
+                blobs, commitments, proofs, setup=setup, seed=seed,
+                consumer=consumer,
             )
         else:
             raise KzgError(f"unknown KZG backend {backend!r}")
+    if backend != "tpu":
+        attribution.note_batch(
+            consumer, "kzg", lanes=None, live=len(blobs),
+            duration_s=time.perf_counter() - t0,
+        )
     _BATCHES.labels(backend, "ok" if result else "fail").inc()
     if result:
         _PROOFS.inc(len(blobs))

@@ -22,8 +22,12 @@ Lane counts are bucketed to powers of two so recompiles stay
 logarithmic in batch size (same policy as bls/tpu_backend).
 """
 
+import time
+
 import numpy as np
 
+from lighthouse_tpu.common import device_attribution as attribution
+from lighthouse_tpu.common.compile_ledger import LEDGER
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.crypto.constants import P, R
@@ -112,7 +116,8 @@ def _scalar_bits(scalars) -> np.ndarray:
 
 
 def verify_blob_kzg_proof_batch_tpu(
-    blobs, commitments, proofs, setup=None, seed=None
+    blobs, commitments, proofs, setup=None, seed=None,
+    consumer: str | None = None,
 ) -> bool:
     s, zs, ys, cs, ws = _api._batch_inputs(
         blobs, commitments, proofs, setup
@@ -148,10 +153,22 @@ def verify_blob_kzg_proof_batch_tpu(
 
     _DEVICE_BATCHES.labels(str(3 * bucket)).inc()
     with span("kzg/device", lanes=3 * bucket):
-        ok = _get_fn()(
-            pts_aff, bits, lane_mask, aux_aff, aux_mask, tau_g2
+        fn = _get_fn()
+        t0 = time.perf_counter()
+        ok = fn(pts_aff, bits, lane_mask, aux_aff, aux_mask, tau_g2)
+        LEDGER.note_dispatch(
+            "kzg_verify_batch", fn, _impl_key(),
+            f"lanes{3 * bucket}", time.perf_counter() - t0,
         )
-        return bool(np.asarray(ok))
+        result = bool(np.asarray(ok))
+    attribution.note_batch(
+        consumer,
+        "kzg",
+        lanes=3 * bucket,
+        live=3 * n,
+        duration_s=time.perf_counter() - t0,
+    )
+    return result
 
 
 # ------------------------------------------------------------- MSM plane
@@ -229,7 +246,9 @@ def _packed_window_table(setup, bucket: int, c: int):
     return packed
 
 
-def g1_msm_fixed_base_tpu(scalars, setup, c: int | None = None):
+def g1_msm_fixed_base_tpu(
+    scalars, setup, c: int | None = None, consumer: str | None = None
+):
     """Fixed-base windowed device MSM: sum [s_i] setup.g1_powers[i].
     Returns a host Jacobian point (the api layer compresses). The
     per-setup digit-multiple table amortizes over every commitment and
@@ -257,11 +276,27 @@ def g1_msm_fixed_base_tpu(scalars, setup, c: int | None = None):
         )
     _MSM_DEVICE_BATCHES.labels("fixed", str(bucket)).inc()
     with span("kzg/msm_device", kind="fixed", lanes=bucket):
-        out = _get_msm_fn("fixed", c)(tx, ty, tv, mags, negs)
-        return _unpack_affine(*out)
+        fn = _get_msm_fn("fixed", c)
+        t0 = time.perf_counter()
+        out = fn(tx, ty, tv, mags, negs)
+        # ledger times the async DISPATCH call only (compile when cold,
+        # ~overhead when warm); attribution times through the force
+        LEDGER.note_dispatch(
+            "kzg_msm_fixed", fn, _impl_key(), f"fixed{bucket}c{c}",
+            time.perf_counter() - t0,
+        )
+        point = _unpack_affine(*out)
+    attribution.note_batch(
+        consumer, "msm", lanes=bucket, live=n,
+        duration_s=time.perf_counter() - t0,
+    )
+    return point
 
 
-def g1_msm_tpu(points_affine, scalars, c: int | None = None):
+def g1_msm_tpu(
+    points_affine, scalars, c: int | None = None,
+    consumer: str | None = None,
+):
     """Variable-base Pippenger device MSM over arbitrary affine int
     points (None = infinity). Returns a host Jacobian point."""
     from lighthouse_tpu.ops import msm as msm_ops
@@ -282,5 +317,17 @@ def g1_msm_tpu(points_affine, scalars, c: int | None = None):
         mags, negs = msm_ops.signed_digit_arrays(scalars + [0] * pad, c)
     _MSM_DEVICE_BATCHES.labels("pippenger", str(bucket)).inc()
     with span("kzg/msm_device", kind="pippenger", lanes=bucket):
-        out = _get_msm_fn("pippenger", c)(px, py, mask, mags, negs)
-        return _unpack_affine(*out)
+        fn = _get_msm_fn("pippenger", c)
+        t0 = time.perf_counter()
+        out = fn(px, py, mask, mags, negs)
+        # ledger times the async DISPATCH call only, like the fixed path
+        LEDGER.note_dispatch(
+            "kzg_msm_pippenger", fn, _impl_key(),
+            f"pippenger{bucket}c{c}", time.perf_counter() - t0,
+        )
+        point = _unpack_affine(*out)
+    attribution.note_batch(
+        consumer, "msm", lanes=bucket, live=n,
+        duration_s=time.perf_counter() - t0,
+    )
+    return point

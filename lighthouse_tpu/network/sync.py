@@ -145,8 +145,12 @@ class SyncManager:
         # lands there with peer attribution
         self.journal = getattr(chain, "journal", None) or JOURNAL
         # dict-compatible view mirrored onto lighthouse_tpu_sync_client_*
-        # registry gauges (PR 5 deferred note): sync internals, /metrics
-        # scrapes, and registry snapshots read the same numbers — the
+        # registry gauges (the PR 5 deferred note, now fully closed):
+        # EVERY sync-internal number — progress counters AND the
+        # previously hand-rolled peer-view gauges (usable peers,
+        # quarantine size, live rate-limit strikes, status-cache
+        # occupancy) — rides this one view, so sync internals, /metrics
+        # scrapes, and registry snapshots read the same numbers; the
         # sync_* counter families above stay the cross-peer totals
         self.metrics = RegistryBackedMetrics(
             "lighthouse_tpu_sync_client_",
@@ -156,6 +160,10 @@ class SyncManager:
                 "retries": 0,
                 "requeues": 0,
                 "sidecars_fetched": 0,
+                "peers": 0,
+                "quarantined": 0,
+                "rl_strikes_active": 0,
+                "status_cache_entries": 0,
             },
         )
         self.request_timeout = REQUEST_TIMEOUT_SECONDS
@@ -167,10 +175,19 @@ class SyncManager:
 
     # -------------------------------------------------------------- peers
 
+    def _refresh_peer_gauges(self):
+        """Mirror the peer-view internals onto the registry-backed view
+        so /metrics carries them (the PR 5 deferred-note closure)."""
+        self.metrics["peers"] = len(self.peers)
+        self.metrics["quarantined"] = len(self.quarantined)
+        self.metrics["rl_strikes_active"] = len(self._rl_strikes)
+        self.metrics["status_cache_entries"] = len(self._status_cache)
+
     def add_peer(self, peer_id: str, rpc_server):
         self.peers.setdefault(peer_id, rpc_server)
         self.quarantined.discard(peer_id)
         _QUARANTINED.set(len(self.quarantined))
+        self._refresh_peer_gauges()
 
     def remove_peer(self, peer_id: str):
         self.peers.pop(peer_id, None)
@@ -178,6 +195,7 @@ class SyncManager:
         self._status_cache.pop(peer_id, None)
         self._rl_strikes.pop(peer_id, None)
         _QUARANTINED.set(len(self.quarantined))
+        self._refresh_peer_gauges()
 
     def disconnect(self, peer_id: str, reason: int = 1):
         """Clean client-side disconnect: send `goodbye`, drop the peer."""
@@ -217,6 +235,7 @@ class SyncManager:
             "peer_quarantine", peer=peer_id, outcome=reason
         )
         _QUARANTINED.set(len(self.quarantined))
+        self._refresh_peer_gauges()
 
     def _peer_status(self, peer_id: str, rpc):
         """Cached Status with a short TTL. RateLimitExceeded falls back
@@ -234,6 +253,7 @@ class SyncManager:
             _REQUEST_ERRORS.labels("status", "error").inc()
             return self._stale_status(peer_id, now)
         self._status_cache[peer_id] = (st, now)
+        self._refresh_peer_gauges()
         return st
 
     def _stale_status(self, peer_id: str, now: float):
@@ -246,6 +266,7 @@ class SyncManager:
         ):
             return cached[0]
         self._status_cache.pop(peer_id, None)
+        self._refresh_peer_gauges()
         return None
 
     def _usable_peers(self):
@@ -343,6 +364,7 @@ class SyncManager:
                 _req_event("rate_limited")
                 strikes = self._rl_strikes.get(pid, 0) + 1
                 self._rl_strikes[pid] = strikes
+                self._refresh_peer_gauges()
                 if strikes >= MAX_RATE_LIMIT_STRIKES:
                     _DOWNSCORES.labels("rate_limit_starvation").inc()
                     self.journal.emit(
@@ -431,6 +453,7 @@ class SyncManager:
                 self.quarantined.clear()
                 self._rl_strikes.clear()
                 _QUARANTINED.set(0)
+                self._refresh_peer_gauges()
                 _QUARANTINE_RESETS.inc()
                 self.journal.emit("peer_quarantine", outcome="forgiven")
                 forgiven = True
@@ -767,7 +790,15 @@ class SyncManager:
                 for sb in blocks
             ]
             ok = bls.verify_signature_sets(
-                sets, backend=self.chain.backend
+                sets,
+                backend=self.chain.backend,
+                consumer="sync_segment",
+                journal=self.journal,
+                slot=start,
+                journal_attrs={
+                    "n_blocks": len(blocks),
+                    "backfill": True,
+                },
             )
             if ok:
                 # hash-chain walk backwards against the known child:

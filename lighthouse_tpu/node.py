@@ -76,6 +76,25 @@ class BeaconNode:
             bytes(genesis_state.genesis_validators_root),
         )
         self.slasher = slasher
+        if slasher is not None and slasher.set_builder is None:
+            # wire slashing-proof verification through this node's
+            # device plane (consumer=slasher) and forensic journal; the
+            # builder resolves pubkeys/domain against the live head
+            # state at verification time
+            from lighthouse_tpu.state_processing import (
+                signature_sets as _sigsets,
+            )
+
+            slasher.set_builder = (
+                lambda sl: _sigsets.attester_slashing_sets(
+                    self.chain.head_state,
+                    sl,
+                    self.chain.pubkey_cache.get,
+                    self.chain.spec,
+                )
+            )
+            slasher.backend = backend
+            slasher.journal = self.chain.journal
         # live node: run the finality-driven store migration on its own
         # thread (migrate.rs:29-35) so a slow freezer write cannot stall
         # block import; the chain's default is synchronous

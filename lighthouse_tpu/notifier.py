@@ -21,6 +21,8 @@ class Notifier:
         self.log = get_logger("notifier")
         # (verify_sets_total, monotonic time) at the previous tick
         self._verify_mark: tuple[float, float] | None = None
+        # per-consumer device_sets_total at the previous tick
+        self._consumer_mark: tuple[dict, float] | None = None
 
     def tick(self, slot: int):
         if not self.latch.elapsed():
@@ -39,6 +41,13 @@ class Notifier:
             extra["vm_hits"] = summary["hits"]
             extra["vm_misses"] = summary["misses"]
             extra["vm_missed_proposals"] = summary["missed_proposals"]
+        top = self.consumer_throughput()
+        if top:
+            # who is paying the device plane right now, next to the
+            # aggregate rate: top-3 consumers by sets/sec this tick
+            extra["consumers"] = ",".join(
+                f"{name}:{rate}" for name, rate in top
+            )
         kv(
             self.log,
             logging.INFO,
@@ -69,6 +78,30 @@ class Notifier:
         if mark is None or now <= mark[1]:
             return 0.0
         return round((total - mark[0]) / (now - mark[1]), 1)
+
+    def consumer_throughput(self, top: int = 3) -> list:
+        """[(consumer, sets/sec)] for the top-`top` device-plane
+        consumers since the previous tick (device_attribution's
+        per-consumer counters) — empty on the first tick or when no
+        consumer moved."""
+        from lighthouse_tpu.common.device_attribution import (
+            consumer_totals,
+        )
+
+        now = time.monotonic()
+        totals = consumer_totals()
+        mark = self._consumer_mark
+        self._consumer_mark = (totals, now)
+        if mark is None or now <= mark[1]:
+            return []
+        dt = now - mark[1]
+        rates = [
+            (name, round((total - mark[0].get(name, 0.0)) / dt, 1))
+            for name, total in totals.items()
+        ]
+        rates = [(n, r) for n, r in rates if r > 0]
+        rates.sort(key=lambda kv_: (-kv_[1], kv_[0]))
+        return rates[:top]
 
     def _synced(self, slot: int) -> bool:
         return chainable(self.chain.head_state.slot, slot)

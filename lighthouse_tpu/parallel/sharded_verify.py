@@ -44,6 +44,12 @@ def _shard_map(f, mesh, in_specs, out_specs):
             check_rep=False,
         )
 
+import time
+
+import numpy as np
+
+from lighthouse_tpu.common import device_attribution as attribution
+from lighthouse_tpu.common.compile_ledger import LEDGER
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.ops import batch_verify, curve, pairing, tower
 from lighthouse_tpu.ops import window_ladder as wl
@@ -142,7 +148,38 @@ def _finish_multi_pairing(
     return pairing.final_exp_is_one(prod)
 
 
-def sharded_verify_signature_sets(mesh, ring: bool = False):
+def _wrap_attributed(inner, fn_name: str, layout: str, consumer):
+    """Attribution wrapper over a built sharded program: each dispatch
+    counts a `sharded`-plane batch with lane/waste economics read from
+    the set_mask argument (index 5 in both the flat and grouped
+    signatures — (..., rand_bits, set_mask[, group_mask])), and lands a
+    compile-ledger entry classified cold/warm from the jit trace
+    cache. The wrapper does NOT force the device value — callers keep
+    the async-dispatch contract."""
+    def dispatch(*args):
+        set_mask = np.asarray(args[5])
+        t0 = time.perf_counter()
+        out = inner(*args)
+        dt = time.perf_counter() - t0
+        LEDGER.note_dispatch(
+            fn_name, inner, (layout,), f"lanes{set_mask.size}", dt
+        )
+        attribution.note_batch(
+            consumer,
+            "sharded",
+            lanes=set_mask.size,
+            live=int(set_mask.sum()),
+            duration_s=dt,
+        )
+        return out
+
+    dispatch._inner = inner
+    return dispatch
+
+
+def sharded_verify_signature_sets(
+    mesh, ring: bool = False, consumer: str | None = None
+):
     """Build the jitted multi-chip verify step for a given mesh.
 
     Returns fn(msgs, sigs, pubkeys, key_mask, rand_bits, set_mask) -> bool.
@@ -152,6 +189,9 @@ def sharded_verify_signature_sets(mesh, ring: bool = False):
     recursive-doubling ppermute butterfly (_butterfly_reduce) — point
     sums over "keys"/"sets" and the Fp12 product over "sets" — when the
     axis is a power of two (gather+fold otherwise).
+
+    `consumer` labels every dispatch through the returned program on
+    the `sharded` device plane (device_attribution).
     """
     bundle = P("sets", None, None)        # (S, slots, NB)
     pk_leaf = P("sets", "keys", None, None)  # (S, K, 1, NB)
@@ -189,10 +229,15 @@ def sharded_verify_signature_sets(mesh, ring: bool = False):
         )
 
     _SHARDED_BUILDS.labels("flat").inc()
-    return jax.jit(_shard_map(step, mesh, in_specs, out_specs))
+    return _wrap_attributed(
+        jax.jit(_shard_map(step, mesh, in_specs, out_specs)),
+        "sharded_verify", "flat", consumer,
+    )
 
 
-def sharded_verify_signature_sets_grouped(mesh, ring: bool = False):
+def sharded_verify_signature_sets_grouped(
+    mesh, ring: bool = False, consumer: str | None = None
+):
     """Multi-chip MESSAGE-GROUPED verify: shard the GROUP axis over the
     mesh's "sets" dimension — each device owns G/n whole groups
     (their per-set ladders, the group MSM fold, and their Miller
@@ -246,4 +291,7 @@ def sharded_verify_signature_sets_grouped(mesh, ring: bool = False):
         )
 
     _SHARDED_BUILDS.labels("grouped").inc()
-    return jax.jit(_shard_map(step, mesh, in_specs, out_specs))
+    return _wrap_attributed(
+        jax.jit(_shard_map(step, mesh, in_specs, out_specs)),
+        "sharded_verify_grouped", "grouped", consumer,
+    )

@@ -16,6 +16,7 @@ evidence itself always comes from the three surfaces above.
 """
 
 import json
+import re
 import time
 import urllib.parse
 import urllib.request
@@ -460,6 +461,100 @@ def faults_fired(ctx: SimContext) -> list:
     return []
 
 
+def attribution_complete(ctx: SimContext) -> list:
+    """Device-plane attribution survives chaos: every journaled
+    `signature_batch` event carries a consumer label, the registry's
+    per-consumer set totals (`lighthouse_tpu_device_sets_total`)
+    EXACTLY equal the journals' summed `n_sets`, and nothing entered
+    the plane unattributed. Journal evidence covers every node LIFE of
+    every FULL node — adversaries included: a spammer is still a full
+    node verifying the gossip it receives, and its device batches land
+    in ITS journal — via the live /lighthouse/events plus the
+    crash/offline archives the orchestrator captured at shutdown (the
+    same journal surface, read at archive time). Scenarios using this
+    invariant must end with their nodes ONLINE (an end-offline node's
+    post-archive events would be unreadable and report as a false
+    mismatch)."""
+    out = []
+    totals: dict = {}
+    unlabeled = 0
+    n_events = 0
+    for name, sn in sorted(ctx.nodes.items()):
+        docs = list()
+        for archive in getattr(sn, "journal_archives", ()):
+            docs.extend(archive)
+        if sn.online:
+            dropped = ctx.health(name)["journal"]["dropped"]
+            if dropped:
+                out.append(
+                    f"{name}: journal evicted {dropped} events — "
+                    "attribution equality cannot be asserted (size "
+                    "journal_capacity to the run)"
+                )
+            docs.extend(ctx.events(name, kind="signature_batch"))
+        for ev in docs:
+            if ev.get("kind") != "signature_batch":
+                continue
+            n_events += 1
+            attrs = ev.get("attrs") or {}
+            consumer = attrs.get("consumer")
+            n_sets = attrs.get("n_sets")
+            if not consumer or consumer == "unattributed" or (
+                n_sets is None
+            ):
+                unlabeled += 1
+                continue
+            totals[consumer] = totals.get(consumer, 0) + int(n_sets)
+    if unlabeled:
+        out.append(
+            f"{unlabeled} signature_batch events lack a consumer label"
+        )
+    if not n_events:
+        out.append(
+            "no signature_batch events journaled — the device plane "
+            "went dark (or lost its journal threading)"
+        )
+    for consumer, journal_total in sorted(totals.items()):
+        reg = ctx.diff(
+            "lighthouse_tpu_device_sets_total"
+            f'{{consumer="{consumer}"}}'
+        )
+        if int(reg) != journal_total:
+            out.append(
+                f"consumer {consumer!r}: registry counted {int(reg)} "
+                f"sets but the journals carry {journal_total}"
+            )
+    # the equality must be TWO-sided: a consumer whose call sites lost
+    # their journal threading entirely would vanish from `totals` and
+    # escape the loop above — walk the registry's per-consumer series
+    # and require journal evidence for every one that moved
+    series_re = re.compile(
+        r'lighthouse_tpu_device_sets_total\{consumer="([^"]+)"\}$'
+    )
+    for key in set(ctx.snapshot_after) | set(ctx.snapshot_before):
+        m = series_re.match(key)
+        if m is None:
+            continue
+        consumer = m.group(1)
+        if consumer == "unattributed" or consumer in totals:
+            continue
+        delta = ctx.diff(key)
+        if delta > 0:
+            out.append(
+                f"consumer {consumer!r}: registry counted {int(delta)} "
+                "sets but no journal carries a single batch for it — "
+                "journal threading lost"
+            )
+    unattr = ctx.diff(
+        'lighthouse_tpu_device_sets_total{consumer="unattributed"}'
+    )
+    if unattr > 0:
+        out.append(
+            f"{int(unattr)} sets entered the device plane unattributed"
+        )
+    return out
+
+
 def finalized(ctx: SimContext) -> list:
     out = []
     for name in ctx.honest_online():
@@ -478,6 +573,7 @@ CHECKS = {
     "eclipse_rejoin": eclipse_rejoin,
     "spam_priced": spam_priced,
     "faults_fired": faults_fired,
+    "attribution_complete": attribution_complete,
     "finalized": finalized,
     "sheds_bounded": sheds_bounded,
     "overload_reported": overload_reported,

@@ -358,7 +358,10 @@ class Simulation:
                 _deterministic_blob(self.spec, slot * 16 + i)
                 for i in range(2)
             ]
-            comms = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+            comms = [
+                kzg.blob_to_kzg_commitment(b, consumer="kzg")
+                for b in blobs
+            ]
         try:
             block = sn.chain.produce_block_unsigned(
                 slot, reveal, blob_kzg_commitments=comms

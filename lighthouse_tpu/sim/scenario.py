@@ -41,6 +41,7 @@ INVARIANT_NAMES = (
     "eclipse_rejoin",
     "spam_priced",
     "faults_fired",
+    "attribution_complete",
     "finalized",
     "sheds_bounded",
     "overload_reported",

@@ -22,7 +22,10 @@ detected from a (slot, proposer) -> root map.
 
 import numpy as np
 
+from lighthouse_tpu.common.logging import get_logger
 from lighthouse_tpu.store.kv import MemoryStore
+
+_LOG = get_logger("slasher")
 
 COL_MIN = b"sl_min"
 COL_MAX = b"sl_max"
@@ -46,7 +49,15 @@ class SlasherConfig:
 
 
 class Slasher:
-    def __init__(self, t, kv=None, config: SlasherConfig | None = None):
+    def __init__(
+        self,
+        t,
+        kv=None,
+        config: SlasherConfig | None = None,
+        set_builder=None,
+        backend=None,
+        journal=None,
+    ):
         self.t = t
         self.kv = kv or MemoryStore()
         self.config = config or SlasherConfig()
@@ -54,6 +65,18 @@ class Slasher:
         self._block_queue = []
         self.slashings_found = []
         self.proposer_slashings_found = []
+        # optional proof verification before a discovered slashing is
+        # published: `set_builder(attester_slashing) -> [SignatureSet,
+        # SignatureSet]` (the node wires state_processing's
+        # attester_slashing_sets against the head state). The re-check
+        # batches through the shared device plane under the `slasher`
+        # consumer label — a stored attestation corrupted since its
+        # gossip verification must not become an unprovable slashing in
+        # the op pool. None (the default) keeps detection-only behavior.
+        self.set_builder = set_builder
+        self.backend = backend
+        self.journal = journal
+        self.rejected_slashings = 0
 
     # ------------------------------------------------------------- queues
 
@@ -137,6 +160,62 @@ class Slasher:
 
     # ---------------------------------------------------------- processing
 
+    def _verify_slashings(self, found: list) -> list:
+        """Batch-verify the discovered slashings' attestation signatures
+        through the shared device plane (consumer=`slasher`) when a
+        set_builder is wired; unprovable slashings are dropped and
+        counted, never published."""
+        if self.set_builder is None or not found:
+            return found
+        from lighthouse_tpu import bls
+
+        owners, sets = [], []
+        rejected = 0
+        for sl in found:
+            try:
+                proof_sets = self.set_builder(sl)
+            except Exception as e:
+                # pubkeys/domain unavailable for this pair: unprovable
+                # against the current state — drop, don't publish
+                _LOG.warning("slashing proof set build failed: %s", e)
+                proof_sets = None
+            if not proof_sets:
+                rejected += 1
+                continue
+            owners.append((sl, len(proof_sets)))
+            sets.extend(proof_sets)
+        kept = []
+        if sets:
+            ok = bls.verify_signature_sets(
+                sets,
+                backend=self.backend,
+                consumer="slasher",
+                journal=self.journal,
+            )
+            if ok:
+                verdicts = [True] * len(owners)
+            else:
+                per_set = bls.verify_signature_sets_individually(
+                    sets,
+                    backend=self.backend,
+                    consumer="slasher",
+                    journal=self.journal,
+                )
+                verdicts, i = [], 0
+                for _, n in owners:
+                    verdicts.append(all(per_set[i : i + n]))
+                    i += n
+            for (sl, _), good in zip(owners, verdicts):
+                if good:
+                    kept.append(sl)
+                else:
+                    rejected += 1
+                    _LOG.warning(
+                        "dropping slashing with unverifiable signatures"
+                    )
+        self.rejected_slashings += rejected
+        return kept
+
     def process_queued(self, current_epoch: int):
         """Batch-process queued attestations & blocks; returns (attester
         slashings, proposer slashings) discovered."""
@@ -203,6 +282,7 @@ class Slasher:
                     COL_MIN, v, range(lo, s + 1), t, min
                 )
         self._queue = []
+        found = self._verify_slashings(found)
 
         seen = {}
         for sh in self._block_queue:

@@ -63,12 +63,23 @@ class BlockSignatureStrategy(Enum):
 class SignatureCollector:
     """Accumulates signature sets per the strategy; `finish` runs the batch
     (or nothing). Individual mode verifies eagerly so errors surface at the
-    offending operation, exactly like the reference's VerifyIndividual."""
+    offending operation, exactly like the reference's VerifyIndividual.
 
-    def __init__(self, strategy, backend=None, seed=None):
+    `consumer`/`journal`/`slot` ride into every `bls.verify_signature_sets`
+    call this collector issues, so block-processing batches carry
+    device-plane attribution and land as `signature_batch` journal
+    events (common/device_attribution)."""
+
+    def __init__(
+        self, strategy, backend=None, seed=None, consumer=None,
+        journal=None, slot=None,
+    ):
         self.strategy = strategy
         self.backend = backend
         self.seed = seed
+        self.consumer = consumer
+        self.journal = journal
+        self.slot = slot
         self.sets = []
 
     def add(self, make_set):
@@ -84,7 +95,13 @@ class SignatureCollector:
         if sset is None:
             return
         if self.strategy == BlockSignatureStrategy.VERIFY_INDIVIDUAL:
-            if not bls.verify_signature_sets([sset], backend=self.backend):
+            if not bls.verify_signature_sets(
+                [sset],
+                backend=self.backend,
+                consumer=self.consumer,
+                journal=self.journal,
+                slot=self.slot,
+            ):
                 raise BlockProcessingError("invalid signature")
         else:
             self.sets.append(sset)
@@ -101,7 +118,12 @@ class SignatureCollector:
             and self.sets
         ):
             if not bls.verify_signature_sets(
-                self.sets, backend=self.backend, seed=self.seed
+                self.sets,
+                backend=self.backend,
+                seed=self.seed,
+                consumer=self.consumer,
+                journal=self.journal,
+                slot=self.slot,
             ):
                 raise BlockProcessingError("bulk signature verification failed")
 
@@ -123,6 +145,8 @@ def per_block_processing(
     seed: int | None = None,
     execution_engine=None,
     collector: SignatureCollector | None = None,
+    consumer=None,
+    journal=None,
 ):
     """Apply `signed_block` to `state` (which must already be advanced to
     the block's slot via process_slots). Mutates state in place.
@@ -133,13 +157,20 @@ def per_block_processing(
     batches across blocks and verifies once. This is how a chain segment
     verifies EVERY signature of every block in one device batch
     (block_verification.rs:509 signature_verify_chain_segment semantics),
-    not just the proposer signatures."""
+    not just the proposer signatures.
+
+    `consumer`/`journal` thread device-plane attribution into the
+    internally-built collector's verify call (ignored when an external
+    collector is given — its own attribution applies)."""
     block = signed_block.message
     fork = spec.fork_name_at_epoch(get_current_epoch(state, spec))
     pubkey_cache.import_new(state)
     deferred = collector is not None
     if collector is None:
-        collector = SignatureCollector(strategy, backend=backend, seed=seed)
+        collector = SignatureCollector(
+            strategy, backend=backend, seed=seed, consumer=consumer,
+            journal=journal, slot=int(block.slot),
+        )
     pk = pubkey_cache.get
 
     if committee_cache is None or committee_cache.epoch != get_current_epoch(
@@ -404,7 +435,9 @@ def process_operations(
             state, att, spec, fork, pubkey_for, collector, committee_cache
         )
     for dep in body.deposits:
-        process_deposit(state, dep, spec, fork, pubkey_cache)
+        process_deposit(
+            state, dep, spec, fork, pubkey_cache, collector=collector
+        )
     for exit_ in body.voluntary_exits:
         process_voluntary_exit(state, exit_, spec, pubkey_for, collector)
 
@@ -623,7 +656,9 @@ def _apply_attestation_altair(state, att, indexed, spec):
 # --------------------------------------------------------------- deposits
 
 
-def process_deposit(state, deposit, spec, fork, pubkey_cache):
+def process_deposit(
+    state, deposit, spec, fork, pubkey_cache, collector=None
+):
     leaf = type(deposit.data).hash_tree_root(deposit.data)
     if not verify_merkle_proof(
         leaf,
@@ -633,21 +668,35 @@ def process_deposit(state, deposit, spec, fork, pubkey_cache):
     ):
         raise BlockProcessingError("deposit: bad merkle proof")
     state.eth1_deposit_index += 1
-    apply_deposit(state, deposit.data, spec, fork, pubkey_cache)
+    apply_deposit(
+        state, deposit.data, spec, fork, pubkey_cache,
+        collector=collector,
+    )
 
 
-def apply_deposit(state, deposit_data, spec, fork, pubkey_cache):
+def apply_deposit(
+    state, deposit_data, spec, fork, pubkey_cache, collector=None
+):
     pubkey_cache.import_new(state)
     pk_bytes = bytes(deposit_data.pubkey)
     existing = pubkey_cache.index_of(pk_bytes)
     if existing is None:
         # new validator: deposit signature is checked INDIVIDUALLY and an
         # invalid one skips the deposit without failing the block
+        # (deposit signatures verify against the deposit domain with
+        # the DEFAULT backend, spec semantics; attribution rides the
+        # enclosing collector's consumer/journal when block processing
+        # supplies one — genesis passes none)
         try:
             sset = sigsets.deposit_set(deposit_data, spec)
         except bls.BlsError:
             return
-        if not bls.verify_signature_sets([sset]):
+        if not bls.verify_signature_sets(
+            [sset],
+            consumer=getattr(collector, "consumer", None),
+            journal=getattr(collector, "journal", None),
+            slot=getattr(collector, "slot", None),
+        ):
             return
         _add_validator(state, deposit_data, spec, fork)
     else:

@@ -62,6 +62,11 @@ class HotColdDB:
         # migrate_to_cold calls prune_blob_sidecars under its own hold.
         self.lock = threading.RLock()
         self._replay_pubkeys = PubkeyCache()
+        # the owning chain's forensic journal (set by BeaconChain after
+        # construction): state replay re-verifies deposit signatures
+        # individually, and those device batches must stay journaled so
+        # per-consumer attribution cross-checks exactly
+        self.journal = None
         # schema versioning: stamp fresh stores, migrate old ones on open
         # (store/src/metadata.rs + schema_change.rs). Every production
         # store is created through here, so a missing version record means
@@ -273,12 +278,18 @@ class HotColdDB:
                 block = self.get_block(root)
                 if block is not None and block.message.slot == next_slot:
                     self._replay_pubkeys.import_new(state)
+                    # NO_VERIFICATION still verifies deposit signatures
+                    # individually (an invalid deposit must be skipped
+                    # identically on replay) — attribute the recheck of
+                    # stored chain data as segment re-verification
                     per_block_processing(
                         state,
                         block,
                         spec,
                         BlockSignatureStrategy.NO_VERIFICATION,
                         self._replay_pubkeys,
+                        consumer="sync_segment",
+                        journal=self.journal,
                     )
         return state
 

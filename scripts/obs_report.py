@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Render p50/p99 stage reports from the Prometheus histogram families.
+"""Render p50/p99 stage reports from the Prometheus histogram families,
+and merge multi-node journals into per-object causal timelines.
 
 The bench/chaos assertion tool: takes a `/metrics` text exposition —
 from a live node (``--url http://127.0.0.1:5052/metrics``), a dump file
@@ -11,13 +12,31 @@ registry's `*_stage_seconds` / `*_request_seconds` histograms into the
 "p50/p99 from the existing histograms" number the ROADMAP's serving
 plane asks for, with no Prometheus server in the loop.
 
+Multi-node mode (``--timeline``): merge per-node lifecycle journals —
+live nodes' ``GET /lighthouse/events`` (``--node-url``, repeatable)
+and/or raw ``--journal-jsonl`` exports (``--journal``, repeatable) —
+into per-block-root causal timelines: which node produced root X (first
+import), the gossip receipt lag on every other node, the redelivery
+(duplicate) count, the consumer-attributed verify batch (journal seq =
+batch id, lanes, padding waste), and the import latency — plus the
+POPULATION metrics the 100+-node simulator item needs: gossip
+propagation-lag p50/p99 and the mean gossip amplification factor
+(deliveries per importing node). Timelines need wall-clock timestamps,
+so the inputs are RAW journals (the sim's canonical replay journals
+strip `t` by design — export raw ones with `bn --journal-jsonl` or
+read live nodes).
+
 Importable pieces (used by tests and bench tooling):
   parse_histograms(text)   -> {(name, labels): {"buckets", "sum", "count"}}
   bucket_quantile(buckets, count, q) -> float | None
   render_report(text, family_filter=None) -> str
+  build_timelines({node: [event, ...]}) -> {root: timeline}
+  timeline_population_stats(timelines) -> dict
+  render_timeline_report({node: [event, ...]}) -> str
 """
 
 import argparse
+import json
 import math
 import re
 import sys
@@ -165,6 +184,202 @@ def render_report(text: str, family_filter: str | None = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+# --------------------------------------------------- cross-node timelines
+
+
+def load_journal_jsonl(path) -> list:
+    """Raw journal export (Journal.export_jsonl / to_jsonl lines) ->
+    event dicts; malformed lines are skipped so a torn tail can't kill
+    the report. (Near-twin of compile_ledger.load_jsonl, duplicated on
+    purpose: this script stays importable standalone against any dump,
+    and a user-passed --journal path that does not exist should raise,
+    where the watcher's maybe-absent ledger should not.)"""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def fetch_node_events(base_url: str) -> list:
+    """Every journaled event from a live node's observability plane."""
+    from urllib.request import urlopen
+
+    url = base_url.rstrip("/") + "/lighthouse/events"
+    with urlopen(url, timeout=10) as r:
+        return json.loads(r.read())["data"]
+
+
+def _percentile(values, q: float):
+    if not values:
+        return None
+    values = sorted(values)
+    idx = min(len(values) - 1, int(q * (len(values) - 1) + 0.5))
+    return values[idx]
+
+
+def build_timelines(events_by_node: dict) -> dict:
+    """Merge per-node journals into per-block-root causal timelines.
+
+    Returns {root_hex: {"slot", "producer", "produced_t", "nodes":
+    {node: {"import_t", "lag_s", "deliveries", "outcome",
+    "import_duration_s", "verify_batches": [...]}}}}.
+
+    The producing node is the one with the EARLIEST successful import
+    (a producer imports its own block before gossip fans out); every
+    other node's receipt lag is measured against that. `deliveries`
+    counts every journaled arrival (import + duplicate outcomes) — the
+    per-node amplification numerator. `verify_batches` are the node's
+    consumer-attributed `signature_batch` events at the block's slot
+    (the journal seq is the batch id; tpu batches carry lanes/waste)."""
+    timelines: dict = {}
+    for node, events in sorted(events_by_node.items()):
+        # slot -> verify batches on this node (batch events are
+        # slot-correlated, not root-correlated: one bulk batch can span
+        # many blocks)
+        batches_by_slot: dict = {}
+        for ev in events:
+            if ev.get("kind") != "signature_batch":
+                continue
+            attrs = ev.get("attrs") or {}
+            doc = {
+                "batch_id": ev.get("seq"),
+                "consumer": attrs.get("consumer"),
+                "n_sets": attrs.get("n_sets"),
+            }
+            for k in ("lanes", "waste", "amortized_fixed_ms"):
+                if attrs.get(k) is not None:
+                    doc[k] = attrs[k]
+            batches_by_slot.setdefault(ev.get("slot"), []).append(doc)
+        for ev in events:
+            if ev.get("kind") != "block_import":
+                continue
+            root = ev.get("root")
+            if root is None:
+                continue
+            tl = timelines.setdefault(
+                root, {"slot": ev.get("slot"), "nodes": {}}
+            )
+            doc = tl["nodes"].setdefault(
+                node, {"deliveries": 0, "verify_batches": []}
+            )
+            doc["deliveries"] += 1
+            if ev.get("outcome") == "imported":
+                doc["import_t"] = ev.get("t")
+                doc["outcome"] = "imported"
+                if ev.get("duration_s") is not None:
+                    doc["import_duration_s"] = ev["duration_s"]
+                if ev.get("slot") is not None:
+                    tl["slot"] = ev["slot"]
+                doc["verify_batches"] = batches_by_slot.get(
+                    ev.get("slot"), []
+                )
+            elif "outcome" not in doc:
+                doc["outcome"] = ev.get("outcome")
+    for root, tl in timelines.items():
+        imported = {
+            n: d["import_t"]
+            for n, d in tl["nodes"].items()
+            if d.get("import_t") is not None
+        }
+        if not imported:
+            tl["producer"] = None
+            continue
+        producer = min(imported, key=imported.get)
+        tl["producer"] = producer
+        tl["produced_t"] = imported[producer]
+        for n, d in tl["nodes"].items():
+            if d.get("import_t") is not None:
+                d["lag_s"] = d["import_t"] - tl["produced_t"]
+    return timelines
+
+
+def timeline_population_stats(timelines: dict) -> dict:
+    """Population metrics over every root: gossip propagation-lag
+    distribution (non-producer receipt lags), import latency
+    distribution, and the mean amplification factor (journaled
+    deliveries per importing node — 1.0 == each block arrived exactly
+    once everywhere)."""
+    lags, durations, amps = [], [], []
+    for tl in timelines.values():
+        producer = tl.get("producer")
+        importing = 0
+        deliveries = 0
+        for node, d in tl["nodes"].items():
+            if d.get("import_t") is not None:
+                importing += 1
+                deliveries += d["deliveries"]
+                if node != producer and d.get("lag_s") is not None:
+                    lags.append(d["lag_s"])
+            if d.get("import_duration_s") is not None:
+                durations.append(d["import_duration_s"])
+        if importing:
+            amps.append(deliveries / importing)
+    return {
+        "blocks": len(timelines),
+        "lag_samples": len(lags),
+        "lag_p50_s": _percentile(lags, 0.50),
+        "lag_p99_s": _percentile(lags, 0.99),
+        "lag_max_s": _percentile(lags, 1.0),
+        "import_p50_s": _percentile(durations, 0.50),
+        "import_p99_s": _percentile(durations, 0.99),
+        "amplification_mean": (
+            round(sum(amps) / len(amps), 3) if amps else None
+        ),
+    }
+
+
+def render_timeline_report(events_by_node: dict) -> str:
+    timelines = build_timelines(events_by_node)
+    if not timelines:
+        return "no block_import events in the merged journals\n"
+    lines = []
+    ordered = sorted(
+        timelines.items(), key=lambda kv: (kv[1].get("slot") or 0, kv[0])
+    )
+    for root, tl in ordered:
+        lines.append(
+            f"block {root[:18]}… slot={tl.get('slot')} "
+            f"producer={tl.get('producer')}"
+        )
+        for node, d in sorted(tl["nodes"].items()):
+            lag = d.get("lag_s")
+            lag_s = "-" if lag is None else f"{lag * 1e3:8.1f}ms"
+            batches = ", ".join(
+                "#{batch_id} {consumer} n={n_sets}".format(**b)
+                + (
+                    f" lanes={b['lanes']} waste={b['waste']}"
+                    if b.get("lanes") is not None
+                    else ""
+                )
+                for b in d.get("verify_batches", [])
+            )
+            lines.append(
+                f"  {node:<12} {d.get('outcome', '-'):<10} "
+                f"lag={lag_s} deliveries={d['deliveries']}"
+                + (f"  verify[{batches}]" if batches else "")
+            )
+    stats = timeline_population_stats(timelines)
+    lines.append("")
+    lines.append(
+        "population: blocks={blocks} lag_p50={p50} lag_p99={p99} "
+        "import_p50={ip50} amplification={amp}".format(
+            blocks=stats["blocks"],
+            p50=_fmt(stats["lag_p50_s"]),
+            p99=_fmt(stats["lag_p99_s"]),
+            ip50=_fmt(stats["import_p50_s"]),
+            amp=stats["amplification_mean"],
+        )
+    )
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="p50/p99 stage report from a /metrics exposition"
@@ -180,7 +395,45 @@ def main(argv=None) -> int:
         help="substring filter on the family name "
         "(e.g. stage_seconds, http_request)",
     )
+    ap.add_argument(
+        "--timeline",
+        action="store_true",
+        help="multi-node mode: merge per-node journals into per-block "
+        "causal timelines + population stats",
+    )
+    ap.add_argument(
+        "--node-url",
+        action="append",
+        default=None,
+        help="timeline source: a live node's base URL (repeatable; "
+        "events read from <url>/lighthouse/events)",
+    )
+    ap.add_argument(
+        "--journal",
+        action="append",
+        default=None,
+        help="timeline source: a raw journal JSONL export "
+        "(repeatable; node name taken from the file name)",
+    )
     args = ap.parse_args(argv)
+    if args.timeline:
+        import os
+
+        events_by_node = {}
+        for url in args.node_url or ():
+            events_by_node[url] = fetch_node_events(url)
+        for path in args.journal or ():
+            name = os.path.splitext(os.path.basename(path))[0]
+            if name in events_by_node:
+                # per-node-directory layouts share a basename
+                # (node0/events.jsonl, node1/events.jsonl) — keep both
+                name = os.path.normpath(path)
+            events_by_node[name] = load_journal_jsonl(path)
+        if not events_by_node:
+            print("--timeline needs --node-url and/or --journal sources")
+            return 2
+        sys.stdout.write(render_timeline_report(events_by_node))
+        return 0
     if args.url:
         from urllib.request import urlopen
 

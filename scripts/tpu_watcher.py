@@ -105,7 +105,13 @@ def probe() -> bool:
 
 def run_one(impl: str, n_sets: int, cache_dir: str, config: str = "sigsets"):
     """One measurement config in a subprocess; returns the parsed JSON
-    line or None."""
+    line or None. The subprocess writes its compile LEDGER (every jit
+    dispatch with impl key, shape, cold/warm, wall duration) to a
+    per-config JSONL which rides back into the measurement record —
+    sweep compile behavior as structured data, not log archaeology."""
+    ledger_path = os.path.join(
+        cache_dir, f"ledger_{impl}_{config}_{n_sets}.jsonl"
+    )
     env = dict(
         os.environ,
         BENCH_INNER="1",
@@ -115,6 +121,7 @@ def run_one(impl: str, n_sets: int, cache_dir: str, config: str = "sigsets"):
         BENCH_NSETS=str(n_sets),
         BENCH_CONFIG=config,
         LIGHTHOUSE_TPU_CACHE_DIR=cache_dir,
+        LIGHTHOUSE_TPU_COMPILE_LEDGER=ledger_path,
     )
     try:
         r = subprocess.run(
@@ -156,7 +163,28 @@ def run_one(impl: str, n_sets: int, cache_dir: str, config: str = "sigsets"):
         f"(p50 {rec.get('p50_s')}s, compile {rec.get('compile_s')}s, "
         f"platform {rec.get('platform')})"
     )
+    rec["compile_ledger"] = _ledger_summary(ledger_path)
     return rec
+
+
+def _ledger_summary(ledger_path: str) -> dict:
+    """The subprocess's persisted compile ledger (COLD entries only —
+    the ledger never writes warm dispatches to disk), summarized for
+    the measurement line: each entry carries fn/impl_key/shape/
+    duration, so a sweep's compile behavior is one structured field."""
+    from lighthouse_tpu.common.compile_ledger import load_jsonl
+
+    cold = [
+        e for e in load_jsonl(ledger_path)
+        if e.get("event") == "cold"
+    ]
+    return {
+        "cold": len(cold),
+        "cold_wall_s": round(
+            sum(e.get("duration_s", 0.0) for e in cold), 3
+        ),
+        "cold_entries": cold[:64],
+    }
 
 
 def append_measurement(rec: dict) -> None:

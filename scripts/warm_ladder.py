@@ -92,12 +92,13 @@ def main():
     n = 4
     blob = b"".join((3 * i + 2).to_bytes(32, "big") for i in range(n))
     setup = kzg.dev_setup(n)
-    comm = kzg.blob_to_kzg_commitment(blob, setup)
-    proof = kzg.compute_blob_kzg_proof(blob, comm, setup)
+    comm = kzg.blob_to_kzg_commitment(blob, setup, consumer="bench")
+    proof = kzg.compute_blob_kzg_proof(blob, comm, setup, consumer="bench")
     _t(
         "kzg verify bucket=2",
         lambda: kzg.verify_blob_kzg_proof_batch(
-            [blob], [comm], [proof], backend="tpu", setup=setup, seed=3
+            [blob], [comm], [proof], backend="tpu", setup=setup,
+            seed=3, consumer="bench"
         ),
     )
 
