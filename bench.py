@@ -150,6 +150,7 @@ def _active_metric():
         "kzgfold": "kzg_batch_fold_factor",
         "ladder": "ladder_unified_speedup",
         "serve": "serve_mixed_traffic_throughput",
+        "busmix": "bus_amortization_speedup",
     }.get(cfg, "verify_signature_sets_throughput")
 
 
@@ -302,6 +303,12 @@ def _measure(jax, platform):
         from lighthouse_tpu import bench_serve
 
         return bench_serve.measure(jax, platform)
+    if config == "busmix":
+        # mixed-consumer replay through the verification bus vs direct
+        # dispatch — the real-hardware amortization A/B
+        from lighthouse_tpu import bench_busmix
+
+        return bench_busmix.measure(jax, platform)
     return _measure_sigsets(jax, platform)
 
 

@@ -7,6 +7,7 @@ live next to each other so a reviewer can audit the invariant without
 reading the framework.
 """
 
+from lighthouse_tpu.analysis.passes.bus_submit import BusSubmitPass
 from lighthouse_tpu.analysis.passes.consumer_label import (
     ConsumerLabelPass,
 )
@@ -29,6 +30,7 @@ PASS_CLASSES = (
     ExceptionHygienePass,
     MetricNamesPass,
     ConsumerLabelPass,
+    BusSubmitPass,
 )
 
 

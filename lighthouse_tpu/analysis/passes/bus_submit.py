@@ -1,0 +1,96 @@
+"""Bus-submit pass: consumer subsystems reach the BLS device plane
+through the verification bus.
+
+The verification bus (verification_bus/bus.py) exists so that EVERY
+consumer's signature batches coalesce across subsystems and share the
+~90 ms fixed device cost. One consumer call site that dispatches
+`verify_signature_sets` directly forks the traffic back off the bus —
+its batches pay the fixed cost alone AND stop co-amortizing everyone
+else's, silently regressing exactly the p99/amortization numbers the
+bus is measured by. So the rule is mechanical: inside the consumer
+namespaces (beacon_chain, network, slasher, node assembly — the op-pool
+paths live under beacon_chain), any direct call of a BLS batch entry
+point is a finding; those modules must go through
+`VerificationBus.submit` / `submit_individual`.
+
+The crypto-plane namespaces (bls, kzg, ops, parallel), the bus itself,
+state_processing (the collector library the bus threads through), and
+the bench/test harnesses stay exempt: they ARE the layers under the
+submit boundary.
+"""
+
+import ast
+
+from lighthouse_tpu.analysis.core import LintPass
+
+# the BLS batch boundary, api + backend + sharded spellings — a
+# consumer calling ANY of these has left the bus
+BATCH_ENTRY_POINTS = {
+    "verify_signature_sets",
+    "verify_signature_set_batches",
+    "verify_signature_sets_individually",
+    "verify_signature_sets_shared",
+    "verify_signature_sets_tpu",
+    "verify_signature_set_batches_tpu",
+    "verify_signature_sets_tpu_individual",
+    "sharded_verify_signature_sets",
+    "sharded_verify_signature_sets_grouped",
+}
+
+# module prefixes (package-relative posix paths) where the rule
+# applies: the consumer subsystems
+CONSUMER_NAMESPACE_PREFIXES = (
+    "beacon_chain/",
+    "network/",
+    "slasher/",
+)
+CONSUMER_MODULES = ("node.py", "notifier.py")
+
+
+def _in_consumer_namespace(rel: str) -> bool:
+    return rel.startswith(CONSUMER_NAMESPACE_PREFIXES) or (
+        rel in CONSUMER_MODULES
+    )
+
+
+class BusSubmitPass(LintPass):
+    name = "bus-submit"
+    description = (
+        "consumer subsystems (beacon_chain, network, slasher, node) "
+        "reach the BLS device plane through VerificationBus.submit, "
+        "never by calling verify_signature_sets* directly"
+    )
+
+    def run(self, modules):
+        findings = []
+        for m in modules:
+            if not _in_consumer_namespace(m.rel):
+                continue
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = self._entry_point_name(node.func)
+                if name is None:
+                    continue
+                findings.append(
+                    self.finding(
+                        m,
+                        node,
+                        f"BLS batch entry point '{name}' called "
+                        "directly from a consumer subsystem — submit "
+                        "through the chain's VerificationBus "
+                        "(submit/submit_individual) so the batch "
+                        "coalesces across consumers",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _entry_point_name(func):
+        if isinstance(func, ast.Name):
+            return func.id if func.id in BATCH_ENTRY_POINTS else None
+        if isinstance(func, ast.Attribute):
+            return (
+                func.attr if func.attr in BATCH_ENTRY_POINTS else None
+            )
+        return None

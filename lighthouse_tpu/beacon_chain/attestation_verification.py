@@ -124,10 +124,13 @@ def batch_verify_unaggregated(chain, state, attestations):
                 else AttestationError(str(e))
             )
     if sets:
-        ok = bls.verify_signature_sets(
+        # the verification bus coalesces this batch with coterminous
+        # consumers' submissions (deadline = the slot clock's 1/3-slot
+        # attestation window)
+        ok = chain.verification_bus.submit(
             sets,
-            backend=chain.backend,
             consumer="gossip_single",
+            backend=chain.backend,
             journal=chain.journal,
         )
         # batch failure -> exact per-set verdicts in ONE extra device
@@ -135,10 +138,10 @@ def batch_verify_unaggregated(chain, state, attestations):
         verdicts = (
             [True] * len(sets)
             if ok
-            else bls.verify_signature_sets_individually(
+            else chain.verification_bus.submit_individual(
                 sets,
-                backend=chain.backend,
                 consumer="gossip_single",
+                backend=chain.backend,
                 journal=chain.journal,
             )
         )
@@ -206,19 +209,19 @@ def batch_verify_aggregates(chain, state, signed_aggregates):
             )
     if triples:
         flat = [s for triple in triples for s in triple]
-        ok = bls.verify_signature_sets(
+        ok = chain.verification_bus.submit(
             flat,
-            backend=chain.backend,
             consumer="gossip_single",
+            backend=chain.backend,
             journal=chain.journal,
         )
         if ok:
             verdicts = [True] * len(triples)
         else:
-            per_set = bls.verify_signature_sets_individually(
+            per_set = chain.verification_bus.submit_individual(
                 flat,
-                backend=chain.backend,
                 consumer="gossip_single",
+                backend=chain.backend,
                 journal=chain.journal,
             )
             verdicts = [

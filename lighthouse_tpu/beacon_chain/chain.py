@@ -113,6 +113,40 @@ class BeaconChain:
         # API) emits into THIS instance, so multi-node simulations keep
         # separate forensic records (common/events_journal.py)
         self.journal = Journal()
+        # the ONE device-plane submit boundary for every verification
+        # consumer this chain assembles (gossip batches, segment bulks,
+        # sidecar headers, op-pool packing, the slasher via the node):
+        # deadline-aware cross-consumer batch coalescing that amortizes
+        # the fixed device cost (verification_bus/bus.py). On host
+        # backends the default hold is zero — an attributed
+        # passthrough — so test/sim behavior is latency-identical.
+        from lighthouse_tpu.verification_bus import VerificationBus
+
+        self.verification_bus = VerificationBus(
+            backend=backend, journal=self.journal
+        )
+        if slot_clock is not None:
+            # gossip-class deadlines are the slot clock's 1/3-slot
+            # attestation deadline, not a hand-set constant: budget =
+            # time remaining to the next 1/3-slot boundary (floored so
+            # a submission just past the boundary still gets a usable
+            # window into the next slot)
+            def _gossip_budget():
+                clock = self.slot_clock
+                rem = (
+                    clock.attestation_deadline(clock.current_slot())
+                    - clock.now()
+                )
+                if rem <= 0:
+                    rem += spec.SECONDS_PER_SLOT
+                return max(0.25, min(rem, float(spec.SECONDS_PER_SLOT)))
+
+            self.verification_bus.budget_fns["gossip_single"] = (
+                _gossip_budget
+            )
+            self.verification_bus.budget_fns["sidecar_header"] = (
+                _gossip_budget
+            )
         self.store = HotColdDB(kv or MemoryStore(), spec)
         # state replay re-verifies deposit signatures; keep those
         # batches on this node's forensic record
@@ -531,6 +565,7 @@ class BeaconChain:
                     execution_engine=engine,
                     consumer="gossip_single",
                     journal=self.journal,
+                    bus=self.verification_bus,
                 )
         except BlockProcessingError as e:
             raise BlockError(str(e)) from e
@@ -657,22 +692,23 @@ class BeaconChain:
             BlockProcessingError,
             SignatureCollector,
         )
-        from lighthouse_tpu import bls
 
         if not signed_blocks:
             return []
         # one collector spanning the segment: per_block_processing feeds
         # it each block's sets (built eagerly against the in-hand
         # advanced state) and leaves finish() to us
-        # consumer/journal ride on the collector so the deposit checks
-        # INSIDE per_block_processing (verified individually regardless
-        # of strategy) stay attributed and journaled too
+        # consumer/journal/bus ride on the collector so the deposit
+        # checks INSIDE per_block_processing (verified individually
+        # regardless of strategy) stay attributed, journaled, and
+        # bus-routed too
         collector = SignatureCollector(
             BlockSignatureStrategy.VERIFY_BULK,
             backend=self.backend,
             consumer="sync_segment",
             journal=self.journal,
             slot=int(signed_blocks[-1].message.slot),
+            bus=self.verification_bus,
         )
         roots = []
         state = None
@@ -697,15 +733,17 @@ class BeaconChain:
                 )
             except BlockProcessingError as e:
                 raise BlockError(f"segment block invalid: {e}") from e
-        # signature-batch membership: the api layer journals one
-        # consumer-attributed event per batch (how many sets from how
-        # many blocks shared this bulk verification, plus the device
-        # lane/waste economics), so a segment failure is attributable
-        # to the batch that carried it
-        batch_ok = bool(collector.sets) and bls.verify_signature_sets(
+        # signature-batch membership: the bus journals one
+        # consumer-attributed event per submission (how many sets from
+        # how many blocks shared this bulk verification, plus the
+        # shared-batch device lane/waste economics), so a segment
+        # failure is attributable to the batch that carried it
+        batch_ok = bool(
+            collector.sets
+        ) and self.verification_bus.submit(
             collector.sets,
-            backend=self.backend,
             consumer="sync_segment",
+            backend=self.backend,
             journal=self.journal,
             slot=int(signed_blocks[-1].message.slot),
             journal_attrs={"n_blocks": len(signed_blocks)},
@@ -739,7 +777,6 @@ class BeaconChain:
         damage to a delayed import). Verified (header root, signature)
         pairs are cached so the N sidecars of one block — and mesh
         redeliveries — cost one pairing total."""
-        from lighthouse_tpu import bls
         from lighthouse_tpu.state_processing import signature_sets as ss
 
         if self.backend == "fake":
@@ -759,7 +796,7 @@ class BeaconChain:
         except (KeyError, IndexError):
             return False
         try:
-            ok = bls.verify_signature_sets(
+            ok = self.verification_bus.submit(
                 [
                     ss.block_header_set(
                         self.head_state,
@@ -768,8 +805,8 @@ class BeaconChain:
                         self.spec,
                     )
                 ],
-                backend=self.backend,
                 consumer="sidecar_header",
+                backend=self.backend,
                 journal=self.journal,
                 slot=int(msg.slot),
             )
@@ -896,6 +933,7 @@ class BeaconChain:
             execution_engine=engine,
             consumer="sync_segment",
             journal=self.journal,
+            bus=self.verification_bus,
         )
         if bytes(block.state_root) != cached_state_root(state):
             raise BlockError("state root mismatch")
@@ -1287,6 +1325,7 @@ class BeaconChain:
             self.pubkey_cache,
             consumer="oppool",
             journal=self.journal,
+            bus=self.verification_bus,
         )
         block.state_root = cached_state_root(trial)
         return block

@@ -13,7 +13,6 @@ on batch failure, mirroring attestation batch.rs semantics.
 
 from dataclasses import dataclass
 
-from lighthouse_tpu import bls
 from lighthouse_tpu.ssz.hashing import hash32
 from lighthouse_tpu.state_processing.signature_sets import (
     signed_contribution_and_proof_set,
@@ -138,20 +137,20 @@ def batch_verify_sync_messages(chain, state, messages):
                 else SyncCommitteeError(str(e))
             )
     if sets:
-        ok = bls.verify_signature_sets(
+        ok = chain.verification_bus.submit(
             sets,
-            backend=chain.backend,
             consumer="gossip_single",
+            backend=chain.backend,
             journal=chain.journal,
         )
         # batch failure -> per-set verdicts in one extra device call
         verdicts = (
             [True] * len(sets)
             if ok
-            else bls.verify_signature_sets_individually(
+            else chain.verification_bus.submit_individual(
                 sets,
-                backend=chain.backend,
                 consumer="gossip_single",
+                backend=chain.backend,
                 journal=chain.journal,
             )
         )
@@ -244,19 +243,19 @@ def batch_verify_contributions(chain, state, signed_contributions):
             )
     if triples:
         flat = [s for triple in triples for s in triple]
-        ok = bls.verify_signature_sets(
+        ok = chain.verification_bus.submit(
             flat,
-            backend=chain.backend,
             consumer="gossip_single",
+            backend=chain.backend,
             journal=chain.journal,
         )
         if ok:
             verdicts = [True] * len(triples)
         else:
-            per_set = bls.verify_signature_sets_individually(
+            per_set = chain.verification_bus.submit_individual(
                 flat,
-                backend=chain.backend,
                 consumer="gossip_single",
+                backend=chain.backend,
                 journal=chain.journal,
             )
             verdicts = [

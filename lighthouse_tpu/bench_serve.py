@@ -93,32 +93,64 @@ def _request(base: str, method: str, path: str, body):
         return -1
 
 
-def _class_quantiles():
-    """(class -> {count, p50, p99}) from the live registry via the
-    obs_report parsing path — the same numbers a scrape would show."""
+def _parse_family(family: str, label: str) -> dict:
+    """{label value: {buckets, count}} for one histogram family from
+    the live registry via the obs_report parsing path — the same
+    numbers a scrape would show."""
     import sys
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if repo not in sys.path:
         sys.path.insert(0, repo)
-    from scripts.obs_report import bucket_quantile, parse_histograms
+    from scripts.obs_report import parse_histograms
 
     out = {}
-    text = REGISTRY.render()
-    for (family, labels), h in parse_histograms(text).items():
-        if family != "lighthouse_tpu_http_class_seconds":
+    for (fam, labels), h in parse_histograms(REGISTRY.render()).items():
+        if fam == family:
+            out[dict(labels).get(label, "?")] = h
+    return out
+
+
+def _histogram_quantiles(family: str, label: str, before: dict | None = None):
+    """(label value -> {count, p50, p99}); with `before` (an earlier
+    `_parse_family` snapshot) the quantiles cover ONLY the samples
+    observed since — a phase's numbers must not be diluted by the rest
+    of the run's traffic through the same family."""
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from scripts.obs_report import bucket_quantile
+
+    out = {}
+    for key, h in _parse_family(family, label).items():
+        buckets, count = h["buckets"], h["count"]
+        prev = (before or {}).get(key)
+        if prev is not None:
+            prev_by_le = {le: c for le, c in prev["buckets"]}
+            buckets = [
+                (le, c - prev_by_le.get(le, 0)) for le, c in buckets
+            ]
+            count = count - prev["count"]
+        if count <= 0:
             continue
-        cls_ = dict(labels).get("cls", "?")
-        out[cls_] = {
-            "count": h["count"],
+        out[key] = {
+            "count": count,
             "p50_s": round(
-                bucket_quantile(h["buckets"], h["count"], 0.50) or 0, 5
+                bucket_quantile(buckets, count, 0.50) or 0, 5
             ),
             "p99_s": round(
-                bucket_quantile(h["buckets"], h["count"], 0.99) or 0, 5
+                bucket_quantile(buckets, count, 0.99) or 0, 5
             ),
         }
     return out
+
+
+def _class_quantiles():
+    return _histogram_quantiles(
+        "lighthouse_tpu_http_class_seconds", "cls"
+    )
 
 
 def _device_seconds_snapshot() -> dict:
@@ -148,6 +180,105 @@ def _consumer_device_report(before: dict, after: dict) -> dict:
         doc["device_s"] = round(doc["device_s"] + (s1 - s0), 5)
         doc.setdefault("planes", []).append(plane)
     return report
+
+
+def _bus_phase(node, platform) -> dict:
+    """Mixed-consumer verification traffic through the chain's bus:
+    concurrent gossip singles + sync-segment bulks + a sidecar-header
+    single per wave. Reports per-consumer cumulative amortized fixed
+    cost, batches formed, mean live sets/batch, and p50/p99
+    submit-to-verdict latency — the bus on/off A/B table."""
+    from lighthouse_tpu import bls
+    from lighthouse_tpu.common import device_attribution as attribution
+
+    bus_enabled = os.environ.get("BENCH_SERVE_BUS", "1") != "0"
+    bus = node.chain.verification_bus
+    if bus_enabled:
+        bus.max_hold_ms = 4.0
+        bus.fill_target = 64
+    else:
+        # direct-dispatch shape: zero hold, every submission its own
+        # batch — exactly the pre-bus call-site behavior
+        bus.max_hold_ms = 0.0
+    if platform == "cpu":
+        n_threads, singles_per_thread, segments = 4, 40, 8
+    else:
+        n_threads, singles_per_thread, segments = 8, 80, 16
+    # one real set reused across submissions: the fake backend never
+    # inspects it, and the bus's scheduling is what this phase measures
+    kp = bls.interop_keypairs(1)[0]
+    msg = b"bench-serve-bus"
+    sset = bls.SignatureSet(kp.sk.sign(msg), [kp.pk], msg)
+
+    amort_before = attribution.amortized_totals()
+    stats_before = bus.stats()
+    wait_before = _parse_family(
+        "lighthouse_tpu_bus_wait_seconds", "consumer"
+    )
+    t0 = time.perf_counter()
+
+    def gossip_thread(i: int):
+        for _ in range(singles_per_thread):
+            bus.submit([sset], consumer="gossip_single")
+
+    def segment_thread():
+        for _ in range(segments):
+            bus.submit([sset] * 8, consumer="sync_segment")
+            bus.submit([sset], consumer="sidecar_header")
+
+    threads = [
+        threading.Thread(target=gossip_thread, args=(i,), daemon=True)
+        for i in range(n_threads)
+    ]
+    threads.append(threading.Thread(target=segment_thread, daemon=True))
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    hung = sum(1 for th in threads if th.is_alive())
+    if hung:
+        raise RuntimeError(
+            f"bus phase: {hung} submitter thread(s) still alive after "
+            "join — a submission wedged in the bus"
+        )
+    wall_s = time.perf_counter() - t0
+
+    amort_after = attribution.amortized_totals()
+    stats_after = bus.stats()
+    amortized = {}
+    for key, v1 in amort_after.items():
+        v0 = amort_before.get(key, 0.0)
+        if v1 - v0 > 0:
+            consumer, _plane = key
+            amortized[consumer] = round(
+                amortized.get(consumer, 0.0) + (v1 - v0), 3
+            )
+    batches = (
+        stats_after["batches_formed"] - stats_before["batches_formed"]
+    )
+    submitted = stats_after["submitted"] - stats_before["submitted"]
+    live = (
+        stats_after["live_dispatched"] - stats_before["live_dispatched"]
+    )
+    return {
+        "enabled": bus_enabled,
+        "submissions": submitted,
+        "batches_formed": batches,
+        "mean_live_per_batch": round(live / batches, 3)
+        if batches
+        else 0.0,
+        "coalesced_batches": stats_after["coalesced_batches"]
+        - stats_before["coalesced_batches"],
+        "deadline_misses": stats_after["deadline_misses"]
+        - stats_before["deadline_misses"],
+        "amortized_fixed_ms": amortized,
+        "wait_quantiles": _histogram_quantiles(
+            "lighthouse_tpu_bus_wait_seconds",
+            "consumer",
+            before=wait_before,
+        ),
+        "wall_s": round(wall_s, 4),
+    }
 
 
 def measure(jax, platform):
@@ -246,6 +377,14 @@ def measure(jax, platform):
             pass
     rpc_wall_s = time.perf_counter() - t0
 
+    # ---- phase 5: verification-bus A/B (amortizing the fixed cost) --
+    # BENCH_SERVE_BUS=1 (default) holds submissions a few ms so
+    # concurrent consumers coalesce into shared batches;
+    # BENCH_SERVE_BUS=0 forces zero hold — every submission dispatches
+    # alone, the pre-bus shape. The diff of the cumulative modeled
+    # fixed cost (device_amortized_fixed_ms_total) is the headline.
+    bus_report = _bus_phase(node, platform)
+
     classes = _class_quantiles()
     total_requests = len(statuses) + cache_reads
     api.stop()
@@ -279,6 +418,11 @@ def measure(jax, platform):
         "rpc_rate_limited": rpc_limited,
         "rpc_per_sec": round(rpc_n / rpc_wall_s, 2),
         "shed_enabled": shed_enabled,
+        # the verification-bus A/B: per-consumer cumulative modeled
+        # fixed cost, batches formed, mean live sets/batch, and
+        # submit-to-verdict p50/p99 (BENCH_SERVE_BUS=0 for the
+        # direct-dispatch partner)
+        "bus": bus_report,
         # who paid the device plane during the run (the measured
         # per-class device seconds the self-tuning serving item needs)
         "consumer_device_seconds": _consumer_device_report(

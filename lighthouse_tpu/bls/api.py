@@ -61,6 +61,14 @@ INFINITY_SIGNATURE_BYTES = bytes([0xC0]) + b"\x00" * 95
 _DEFAULT_BACKEND = os.environ.get("LIGHTHOUSE_TPU_BLS_BACKEND", "ref")
 
 
+def default_backend() -> str:
+    """The process-default backend (env-selected) — for callers that
+    must verify on the DEFAULT backend regardless of their chain's
+    (deposit signatures, spec semantics) while routing through the
+    verification bus, which would otherwise substitute its own."""
+    return _DEFAULT_BACKEND
+
+
 class BlsError(ValueError):
     pass
 
@@ -390,6 +398,83 @@ def verify_signature_sets(
         extra=journal_attrs,
     )
     return result
+
+
+def verify_signature_sets_shared(
+    submissions,
+    backend: str | None = None,
+    seed: int | None = None,
+) -> tuple:
+    """ONE dispatch spanning several consumers' set batches — the
+    verification bus's boundary. `submissions` is a list of
+    (sets, consumer) pairs; the whole collection becomes a single
+    batch (one device multi-pairing on the tpu backend) while the
+    per-consumer attribution fans out: `device_sets_total` counts each
+    contributor's own sets, and the batch economics (participation,
+    proportional device seconds/waste, the SHARED amortized fixed
+    cost) distribute via `device_attribution.begin_shared_window`.
+
+    Returns `(ok, record)` where `record` is the batch-economics dict
+    (lanes/waste/amortized_fixed_ms when the tpu marshal ran) or None.
+    NO journal emission happens here: the bus emits one
+    `signature_batch` event per contributing submission itself, with a
+    shared batch id, so `attribution_complete` holds per consumer."""
+    contribs = []
+    flat = []
+    for sets, consumer in submissions:
+        sets = list(sets)
+        if not sets:
+            continue
+        consumer = attribution.note_sets(consumer, len(sets))
+        contribs.append((consumer, len(sets)))
+        flat.extend(sets)
+    if not flat:
+        return False, None
+    backend = backend or _DEFAULT_BACKEND
+    # the largest contributor labels the raw backend call; the shared
+    # window redistributes the actual accounting over every contributor
+    primary = max(contribs, key=lambda cn: cn[1])[0]
+    _VERIFY_SETS.inc(len(flat))
+    _VERIFY_BATCH_SIZE.observe(len(flat))
+    attribution.begin_shared_window(contribs)
+    t0 = time.perf_counter()
+    try:
+        with _VERIFY_BATCH_SECONDS.time(), span(
+            "verify",
+            n_sets=len(flat),
+            backend=backend,
+            n_consumers=len(contribs),
+        ):
+            if backend == "fake":
+                result = True
+            elif backend == "ref":
+                result = all(_verify_one_ref(s) for s in flat)
+            elif backend == "tpu":
+                from lighthouse_tpu.bls.tpu_backend import (
+                    verify_signature_sets_tpu,
+                )
+
+                result = verify_signature_sets_tpu(
+                    flat, seed=seed, consumer=primary
+                )
+            else:
+                raise BlsError(f"unknown BLS backend {backend!r}")
+        if backend != "tpu":
+            attribution.note_batch(
+                primary, "bls", lanes=None, live=len(flat),
+                duration_s=time.perf_counter() - t0,
+            )
+    finally:
+        # a raising dispatch must not leave the shared window open on
+        # this thread (the next unrelated batch would fan out over it)
+        records = attribution.take_batches()
+    _VERIFY_BATCHES.labels(backend, "ok" if result else "fail").inc()
+    record = records[0] if records else None
+    if record is not None:
+        record.setdefault(
+            "duration_s", time.perf_counter() - t0
+        )
+    return result, record
 
 
 def verify_signature_set_batches(

@@ -71,6 +71,80 @@ def _apply_journal_flags(chain, args) -> None:
         LEDGER.configure(path=ledger_path)
 
 
+def parse_admission_limits(spec_str):
+    """``cls=concurrency:deadline,...`` -> {cls: (int, float)}; classes
+    must exist in the admission vocabulary (typos are errors, not
+    silently-ignored knobs)."""
+    from lighthouse_tpu.http_api.admission import DEFAULT_LIMITS
+
+    if not spec_str:
+        return {}
+    out = {}
+    for part in spec_str.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cls_, _, limits = part.partition("=")
+        conc, _, budget = limits.partition(":")
+        if cls_ not in DEFAULT_LIMITS:
+            raise ValueError(
+                f"unknown admission class {cls_!r} "
+                f"(one of {sorted(DEFAULT_LIMITS)})"
+            )
+        out[cls_] = (int(conc), float(budget or DEFAULT_LIMITS[cls_][1]))
+    return out
+
+
+def parse_bus_deadlines(spec_str):
+    """``consumer=seconds,...`` -> {consumer: float}; consumers must be
+    in the closed attribution vocabulary."""
+    from lighthouse_tpu.common.device_attribution import CONSUMERS
+
+    if not spec_str:
+        return {}
+    out = {}
+    for part in spec_str.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        consumer, _, seconds = part.partition("=")
+        if consumer not in CONSUMERS:
+            raise ValueError(
+                f"unknown bus consumer {consumer!r} "
+                f"(one of {sorted(CONSUMERS)})"
+            )
+        out[consumer] = float(seconds)
+    return out
+
+
+def _apply_bus_flags(chain, args) -> None:
+    """Verification-bus knobs (max hold, bucket fill target, per-class
+    deadline budgets) — the control surface for the ROADMAP self-tuning
+    item, mirrored live at /lighthouse/health."""
+    bus = getattr(chain, "verification_bus", None)
+    if bus is None:
+        return
+    hold = getattr(args, "bus_max_hold_ms", None)
+    if hold is not None and hold >= 0:
+        bus.max_hold_ms = float(hold)
+    fill = getattr(args, "bus_fill_target", 0)
+    if fill:
+        bus.fill_target = int(fill)
+    deadlines = getattr(args, "bus_deadlines", None)
+    if deadlines:
+        bus.class_budgets.update(parse_bus_deadlines(deadlines))
+
+
+def _apply_admission_flags(srv, args) -> None:
+    """PR 10's hand-set admission constants become a flag: per-class
+    concurrency + deadline overrides on the live controller."""
+    limits = parse_admission_limits(
+        getattr(args, "admission_limits", None)
+    )
+    if limits:
+        srv.admission.limits.update(limits)
+
+
 def _export_trace(args, chain=None) -> None:
     """Dump the buffered span trees (and journal events) as JSONL on
     shutdown when asked."""
@@ -93,9 +167,12 @@ def _serve_api(chain, args, banner: str) -> int:
 
     _apply_store_flags(chain, args)
     _apply_journal_flags(chain, args)
+    _apply_bus_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
-    ).start()
+    )
+    _apply_admission_flags(srv, args)
+    srv.start()
     print(f"{banner}; HTTP API on {args.http_address}:{srv.port}")
     try:
         if args.serve_seconds:
@@ -226,9 +303,12 @@ def cmd_bn(args):
     )
     _apply_store_flags(chain, args)
     _apply_journal_flags(chain, args)
+    _apply_bus_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
-    ).start()
+    )
+    _apply_admission_flags(srv, args)
+    srv.start()
     print(f"HTTP API on {args.http_address}:{srv.port}")
     try:
         if args.slots:
@@ -685,6 +765,36 @@ def build_parser():
         "persistent JSONL ledger (warm dispatches stay in the "
         "in-memory ring served at GET /lighthouse/compiles; env "
         "LIGHTHOUSE_TPU_COMPILE_LEDGER is the flagless spelling)",
+    )
+    bn.add_argument(
+        "--admission-limits",
+        default=None,
+        help="per-class HTTP admission overrides, "
+        "'cls=concurrency:deadline_s,...' (classes: cheap_read, "
+        "expensive_read, write) — the PR 10 hand-set constants as a "
+        "control surface, mirrored at /lighthouse/health",
+    )
+    bn.add_argument(
+        "--bus-max-hold-ms",
+        type=float,
+        default=None,
+        help="verification bus: maximum milliseconds a submission may "
+        "hold waiting for co-riders (default: 25 on the tpu backend, "
+        "0 — attributed passthrough — on host backends)",
+    )
+    bn.add_argument(
+        "--bus-fill-target",
+        type=int,
+        default=0,
+        help="verification bus: pending live sets that close a batch "
+        "(one pow2 lane bucket's worth; 0 keeps the default 64)",
+    )
+    bn.add_argument(
+        "--bus-deadlines",
+        default=None,
+        help="verification bus per-class deadline budgets, "
+        "'consumer=seconds,...' over the closed consumer vocabulary "
+        "(gossip classes default to the slot clock's 1/3-slot window)",
     )
     bn.set_defaults(fn=cmd_bn)
 

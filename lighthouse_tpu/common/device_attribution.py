@@ -106,6 +106,15 @@ _AMORTIZED = REGISTRY.gauge_vec(
     "(FIXED_DEVICE_COST_MS / live sets)",
     ("consumer", "plane"),
 )
+_AMORTIZED_TOTAL = REGISTRY.counter_vec(
+    "lighthouse_tpu_device_amortized_fixed_ms_total",
+    "cumulative modeled fixed-cost milliseconds paid per consumer: each "
+    "dispatched batch charges its contributors live_sets x "
+    "(FIXED_DEVICE_COST_MS / batch live sets). Counted for host "
+    "backends too (what the dispatch WOULD pay on device), so bus "
+    "on/off A/B comparisons run off-hardware",
+    ("consumer", "plane"),
+)
 
 _TLS = threading.local()
 
@@ -139,6 +148,21 @@ def begin_batch_window():
     emission to feed, and a no-window append would leak one dict per
     batch on threads that never drain)."""
     _TLS.pending = []
+    _TLS.shared = None
+
+
+def begin_shared_window(contributions):
+    """Open a batch window for ONE dispatch shared by several consumers
+    (the verification bus's coalesced batches): `contributions` is a
+    list of (consumer, live_sets). The next `note_batch` on this thread
+    fans its accounting out per contributor — participation-counted
+    batches, proportional device seconds and waste, and the SHARED
+    amortized fixed cost (FIXED_DEVICE_COST_MS / total live) that is
+    the whole point of coalescing."""
+    _TLS.pending = []
+    _TLS.shared = [
+        (normalize(c), int(n)) for c, n in contributions
+    ]
 
 
 def take_batches() -> list:
@@ -147,6 +171,7 @@ def take_batches() -> list:
     window."""
     out = getattr(_TLS, "pending", None) or []
     _TLS.pending = None
+    _TLS.shared = None
     return out
 
 
@@ -161,7 +186,13 @@ def note_batch(
     gauges, and the thread-local pending record for journal attrs.
 
     `lanes` is the bucketed lane count (int) or None for host backends
-    (no padding concept — counted under lanes='host', no waste)."""
+    (no padding concept — counted under lanes='host', no waste).
+
+    Inside a `begin_shared_window` the single-consumer arguments are
+    advisory: accounting fans out over the window's contributions."""
+    shared = getattr(_TLS, "shared", None)
+    if shared:
+        return _note_shared_batch(shared, plane, lanes, live, duration_s)
     consumer = normalize(consumer)
     lanes_label = "host" if lanes is None else str(int(lanes))
     _BATCHES.labels(consumer, plane, lanes_label).inc()
@@ -174,17 +205,61 @@ def note_batch(
     if duration_s is not None:
         _SECONDS.labels(consumer, plane).observe(duration_s)
         record["duration_s"] = duration_s
+    amortized = FIXED_DEVICE_COST_MS / max(1, int(live))
+    # a solo batch pays the WHOLE modeled fixed cost, however many live
+    # sets amortize it: live x (fixed / live)
+    _AMORTIZED_TOTAL.labels(consumer, plane).inc(FIXED_DEVICE_COST_MS)
     if lanes is not None:
         waste = max(0, int(lanes) - int(live))
         _WASTE_GAUGE.labels(consumer, plane).set(waste)
         _WASTE_TOTAL.labels(consumer, plane).inc(waste)
         _LIVE_TOTAL.labels(consumer, plane).inc(int(live))
-        amortized = FIXED_DEVICE_COST_MS / max(1, int(live))
         _AMORTIZED.labels(consumer, plane).set(amortized)
         record["waste"] = waste
-        record["amortized_fixed_ms"] = round(amortized, 3)
+    record["amortized_fixed_ms"] = round(amortized, 3)
     pending = getattr(_TLS, "pending", None)
     if pending is not None:  # window open: the api layer will drain
+        pending.append(record)
+    return record
+
+
+def _note_shared_batch(contributions, plane, lanes, live, duration_s):
+    """Fan one dispatched batch's accounting out over its contributing
+    consumers: each contributor is charged its PROPORTIONAL share of
+    device seconds and padding waste, participation-counted in
+    `device_batches_total`, and credited the SHARED amortized fixed
+    cost (fixed / total live — the number coalescing exists to
+    shrink)."""
+    total = sum(n for _, n in contributions)
+    total = max(1, total)
+    lanes_label = "host" if lanes is None else str(int(lanes))
+    waste = max(0, int(lanes) - total) if lanes is not None else None
+    amortized = FIXED_DEVICE_COST_MS / total
+    record = {
+        "consumer": None,
+        "consumers": list(contributions),
+        "plane": plane,
+        "lanes": None if lanes is None else int(lanes),
+        "live": int(live),
+        "amortized_fixed_ms": round(amortized, 3),
+    }
+    if waste is not None:
+        record["waste"] = waste
+    if duration_s is not None:
+        record["duration_s"] = duration_s
+    for consumer, n in contributions:
+        share = n / total
+        _BATCHES.labels(consumer, plane, lanes_label).inc()
+        if duration_s is not None:
+            _SECONDS.labels(consumer, plane).observe(duration_s * share)
+        _AMORTIZED_TOTAL.labels(consumer, plane).inc(amortized * n)
+        if lanes is not None:
+            _WASTE_GAUGE.labels(consumer, plane).set(waste)
+            _WASTE_TOTAL.labels(consumer, plane).inc(waste * share)
+            _LIVE_TOTAL.labels(consumer, plane).inc(n)
+            _AMORTIZED.labels(consumer, plane).set(amortized)
+    pending = getattr(_TLS, "pending", None)
+    if pending is not None:
         pending.append(record)
     return record
 
@@ -194,6 +269,15 @@ def observe_seconds(consumer, plane: str, seconds: float):
     streamed multi-batch path: per-batch device time is hidden by the
     double-buffered overlap, so the whole call observes once)."""
     _SECONDS.labels(normalize(consumer), plane).observe(seconds)
+
+
+def amortized_totals() -> dict:
+    """{(consumer, plane): cumulative modeled fixed-cost ms} from the
+    registry — the bench's bus on/off A/B read."""
+    out = {}
+    for key, child in _AMORTIZED_TOTAL.children().items():
+        out[key] = child.value
+    return out
 
 
 def consumer_totals() -> dict:

@@ -589,6 +589,12 @@ class BeaconApiServer:
         )
         if processor is not None:
             doc["processor"] = processor.shed_state()
+        # verification-bus control surface: knobs (max hold, fill
+        # target, per-class deadlines) + live batch-formation counters,
+        # so the self-tuning loop can read what it would adjust
+        bus = getattr(self.chain, "verification_bus", None)
+        if bus is not None:
+            doc["verification_bus"] = bus.stats()
         return doc
 
     # ------------------------------------------------------------ routing

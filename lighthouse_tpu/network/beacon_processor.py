@@ -146,6 +146,21 @@ class BeaconProcessor:
         exact shed counts, window transitions."""
         return self.shedder.state()
 
+    def pressure_high(self) -> bool:
+        """Queue-depth pressure signal for the verification bus's flush
+        policy: True while any shed window is open or any queue sits
+        at/over its high-water fraction — the node is loaded, so the
+        bus should dispatch immediately instead of holding for
+        co-riders (big batches form naturally from the backlog)."""
+        if self.shedder.any_open():
+            return True
+        with self._lock:
+            for kind, q in self._queues.items():
+                bound = self.bounds.get(kind)
+                if bound and len(q) / bound >= self.shedder.high_water:
+                    return True
+        return False
+
     # -------------------------------------------------------------- submit
 
     def submit(self, kind: str, payload) -> bool:

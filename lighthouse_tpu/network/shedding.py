@@ -154,6 +154,12 @@ class SheddingPolicy:
         with self._lock:
             return self._open.get(kind, False)
 
+    def any_open(self) -> bool:
+        """True while ANY kind's shed window is open — the cheap
+        whole-node pressure read the verification bus polls."""
+        with self._lock:
+            return any(self._open.values())
+
     def state(self) -> dict:
         """The health-plane view: which windows are open right now,
         exact shed counts, and how many windows each kind has opened."""

@@ -747,7 +747,6 @@ class SyncManager:
         from the anchor, verify the parent-root hash chain plus one bulk
         proposer-signature batch per batch (no state transitions), and
         store them. Failed batches rotate peers like range sync."""
-        from lighthouse_tpu import bls
         from lighthouse_tpu.state_processing import signature_sets as ss
 
         anchor = getattr(self.chain, "anchor_slot", None)
@@ -789,10 +788,10 @@ class SyncManager:
                 )
                 for sb in blocks
             ]
-            ok = bls.verify_signature_sets(
+            ok = self.chain.verification_bus.submit(
                 sets,
-                backend=self.chain.backend,
                 consumer="sync_segment",
+                backend=self.chain.backend,
                 journal=self.journal,
                 slot=start,
                 journal_attrs={

@@ -76,6 +76,10 @@ class BeaconNode:
             bytes(genesis_state.genesis_validators_root),
         )
         self.slasher = slasher
+        if slasher is not None:
+            # the slasher's proof batches ride this node's verification
+            # bus, coalescing with gossip/segment/sidecar traffic
+            slasher.bus = self.chain.verification_bus
         if slasher is not None and slasher.set_builder is None:
             # wire slashing-proof verification through this node's
             # device plane (consumer=slasher) and forensic journal; the
@@ -130,6 +134,12 @@ class BeaconNode:
                 "gossip_slashing": self._on_slashing,
             },
             journal=self.chain.journal,
+        )
+        # queue-depth/shedding pressure feeds the verification bus's
+        # flush policy: under load the bus stops holding for co-riders
+        # (big batches form naturally from the backlog)
+        self.chain.verification_bus.pressure_fn = (
+            self.processor.pressure_high
         )
         self.hub = hub
         self.subnets = None

@@ -555,6 +555,56 @@ def attribution_complete(ctx: SimContext) -> list:
     return out
 
 
+def bus_no_starvation(ctx: SimContext) -> list:
+    """The verification bus never starves a submission: every node's
+    bus reports submitted == completed with an empty queue at run end,
+    and every bus-journaled `signature_batch` event's submit-to-verdict
+    wait stayed within its deadline budget plus the batch wall (with a
+    scheduling-slack floor — the bound is about starvation, not
+    scheduler jitter). A submission that timed out of the queue must
+    have been small-batch flushed (a verdict event exists), never
+    silently dropped."""
+    out = []
+    for name in ctx.honest_online():
+        health = ctx.health(name)
+        bus = health.get("overload", {}).get("verification_bus")
+        if bus is None:
+            out.append(
+                f"{name}: health carries no verification_bus section"
+            )
+            continue
+        if bus.get("pending"):
+            out.append(
+                f"{name}: {bus['pending']} submissions still queued "
+                "at run end"
+            )
+        if bus.get("submitted") != bus.get("completed"):
+            out.append(
+                f"{name}: bus submitted {bus.get('submitted')} but "
+                f"completed {bus.get('completed')} — a submission "
+                "never reached a verdict"
+            )
+        for ev in ctx.events(name, kind="signature_batch"):
+            attrs = ev.get("attrs") or {}
+            if "bus_batch" not in attrs:
+                continue
+            wait = attrs.get("wait_s")
+            budget = attrs.get("budget_s")
+            if wait is None or budget is None:
+                out.append(
+                    f"{name}: bus signature_batch event lacks "
+                    "wait_s/budget_s"
+                )
+                continue
+            wall = attrs.get("wall_s") or 0.0
+            if wait > budget + max(1.0, 4 * wall):
+                out.append(
+                    f"{name}: submission waited {wait:.3f}s against a "
+                    f"{budget:.3f}s deadline + {wall:.3f}s batch wall"
+                )
+    return out
+
+
 def finalized(ctx: SimContext) -> list:
     out = []
     for name in ctx.honest_online():
@@ -574,6 +624,7 @@ CHECKS = {
     "spam_priced": spam_priced,
     "faults_fired": faults_fired,
     "attribution_complete": attribution_complete,
+    "bus_no_starvation": bus_no_starvation,
     "finalized": finalized,
     "sheds_bounded": sheds_bounded,
     "overload_reported": overload_reported,

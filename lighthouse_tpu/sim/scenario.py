@@ -42,6 +42,7 @@ INVARIANT_NAMES = (
     "spam_priced",
     "faults_fired",
     "attribution_complete",
+    "bus_no_starvation",
     "finalized",
     "sheds_bounded",
     "overload_reported",

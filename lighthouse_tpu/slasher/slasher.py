@@ -76,7 +76,20 @@ class Slasher:
         self.set_builder = set_builder
         self.backend = backend
         self.journal = journal
+        # verification-bus routing: the node wires its chain's bus so
+        # slasher proof batches coalesce with the other consumers'
+        # traffic; standalone (test) slashers lazily make a private one
+        self.bus = None
         self.rejected_slashings = 0
+
+    def _verification_bus(self):
+        if self.bus is None:
+            from lighthouse_tpu.verification_bus import VerificationBus
+
+            self.bus = VerificationBus(
+                backend=self.backend, journal=self.journal
+            )
+        return self.bus
 
     # ------------------------------------------------------------- queues
 
@@ -167,7 +180,7 @@ class Slasher:
         counted, never published."""
         if self.set_builder is None or not found:
             return found
-        from lighthouse_tpu import bls
+        bus = self._verification_bus()
 
         owners, sets = [], []
         rejected = 0
@@ -186,19 +199,19 @@ class Slasher:
             sets.extend(proof_sets)
         kept = []
         if sets:
-            ok = bls.verify_signature_sets(
+            ok = bus.submit(
                 sets,
-                backend=self.backend,
                 consumer="slasher",
+                backend=self.backend,
                 journal=self.journal,
             )
             if ok:
                 verdicts = [True] * len(owners)
             else:
-                per_set = bls.verify_signature_sets_individually(
+                per_set = bus.submit_individual(
                     sets,
-                    backend=self.backend,
                     consumer="slasher",
+                    backend=self.backend,
                     journal=self.journal,
                 )
                 verdicts, i = [], 0

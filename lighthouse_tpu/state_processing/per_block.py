@@ -68,11 +68,13 @@ class SignatureCollector:
     `consumer`/`journal`/`slot` ride into every `bls.verify_signature_sets`
     call this collector issues, so block-processing batches carry
     device-plane attribution and land as `signature_batch` journal
-    events (common/device_attribution)."""
+    events (common/device_attribution). `bus` (a chain's
+    VerificationBus) routes those calls through the cross-consumer
+    coalescing boundary instead of dispatching alone."""
 
     def __init__(
         self, strategy, backend=None, seed=None, consumer=None,
-        journal=None, slot=None,
+        journal=None, slot=None, bus=None,
     ):
         self.strategy = strategy
         self.backend = backend
@@ -80,7 +82,26 @@ class SignatureCollector:
         self.consumer = consumer
         self.journal = journal
         self.slot = slot
+        self.bus = bus
         self.sets = []
+
+    def _verify(self, sets) -> bool:
+        if self.bus is not None:
+            return self.bus.submit(
+                sets,
+                consumer=self.consumer,
+                backend=self.backend,
+                journal=self.journal,
+                slot=self.slot,
+            )
+        return bls.verify_signature_sets(
+            sets,
+            backend=self.backend,
+            seed=self.seed,
+            consumer=self.consumer,
+            journal=self.journal,
+            slot=self.slot,
+        )
 
     def add(self, make_set):
         """`make_set` is a zero-arg callable returning a SignatureSet (or
@@ -95,13 +116,7 @@ class SignatureCollector:
         if sset is None:
             return
         if self.strategy == BlockSignatureStrategy.VERIFY_INDIVIDUAL:
-            if not bls.verify_signature_sets(
-                [sset],
-                backend=self.backend,
-                consumer=self.consumer,
-                journal=self.journal,
-                slot=self.slot,
-            ):
+            if not self._verify([sset]):
                 raise BlockProcessingError("invalid signature")
         else:
             self.sets.append(sset)
@@ -117,14 +132,7 @@ class SignatureCollector:
             self.strategy == BlockSignatureStrategy.VERIFY_BULK
             and self.sets
         ):
-            if not bls.verify_signature_sets(
-                self.sets,
-                backend=self.backend,
-                seed=self.seed,
-                consumer=self.consumer,
-                journal=self.journal,
-                slot=self.slot,
-            ):
+            if not self._verify(self.sets):
                 raise BlockProcessingError("bulk signature verification failed")
 
 
@@ -147,6 +155,7 @@ def per_block_processing(
     collector: SignatureCollector | None = None,
     consumer=None,
     journal=None,
+    bus=None,
 ):
     """Apply `signed_block` to `state` (which must already be advanced to
     the block's slot via process_slots). Mutates state in place.
@@ -159,9 +168,10 @@ def per_block_processing(
     (block_verification.rs:509 signature_verify_chain_segment semantics),
     not just the proposer signatures.
 
-    `consumer`/`journal` thread device-plane attribution into the
-    internally-built collector's verify call (ignored when an external
-    collector is given — its own attribution applies)."""
+    `consumer`/`journal`/`bus` thread device-plane attribution and the
+    verification-bus routing into the internally-built collector's
+    verify call (ignored when an external collector is given — its own
+    attribution applies)."""
     block = signed_block.message
     fork = spec.fork_name_at_epoch(get_current_epoch(state, spec))
     pubkey_cache.import_new(state)
@@ -169,7 +179,7 @@ def per_block_processing(
     if collector is None:
         collector = SignatureCollector(
             strategy, backend=backend, seed=seed, consumer=consumer,
-            journal=journal, slot=int(block.slot),
+            journal=journal, slot=int(block.slot), bus=bus,
         )
     pk = pubkey_cache.get
 
@@ -691,12 +701,26 @@ def apply_deposit(
             sset = sigsets.deposit_set(deposit_data, spec)
         except bls.BlsError:
             return
-        if not bls.verify_signature_sets(
-            [sset],
-            consumer=getattr(collector, "consumer", None),
-            journal=getattr(collector, "journal", None),
-            slot=getattr(collector, "slot", None),
-        ):
+        bus = getattr(collector, "bus", None)
+        if bus is not None:
+            # deposit checks stay on the DEFAULT backend (spec
+            # semantics) even when the routing bus serves a chain on
+            # another one
+            ok = bus.submit(
+                [sset],
+                consumer=getattr(collector, "consumer", None),
+                journal=getattr(collector, "journal", None),
+                slot=getattr(collector, "slot", None),
+                backend=bls.api.default_backend(),
+            )
+        else:
+            ok = bls.verify_signature_sets(
+                [sset],
+                consumer=getattr(collector, "consumer", None),
+                journal=getattr(collector, "journal", None),
+                slot=getattr(collector, "slot", None),
+            )
+        if not ok:
             return
         _add_validator(state, deposit_data, spec, fork)
     else:

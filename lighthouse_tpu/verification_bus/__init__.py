@@ -1,0 +1,9 @@
+from lighthouse_tpu.verification_bus.bus import (  # noqa: F401
+    DEFAULT_CLASS_BUDGETS,
+    DEFAULT_FILL_TARGET,
+    DEFAULT_TPU_HOLD_MS,
+    VerificationBus,
+)
+from lighthouse_tpu.verification_bus.wall_model import (  # noqa: F401
+    PredictedWallModel,
+)

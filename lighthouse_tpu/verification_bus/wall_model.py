@@ -1,0 +1,144 @@
+"""Predicted batch-wall model: what will this dispatch cost?
+
+The bus flushes when the earliest queued deadline's slack falls below
+the predicted wall of the batch it would form — so the prediction IS
+the scheduling policy. Three information sources, best-first:
+
+  1. **Observed walls** (learned): every bus dispatch feeds the model
+     its (live sets, wall seconds); an EMA per pow2 lane bucket tracks
+     the measured cost of exactly the shapes this process dispatches.
+     The same numbers land in `lighthouse_tpu_device_seconds` — the
+     model is the scheduler-side view of that histogram family.
+  2. **The compile ledger** (cold risk): a lane bucket this process has
+     never dispatched will TRACE + COMPILE on first use
+     (common/compile_ledger). The model asks the ledger whether the
+     bucket's shape class has been seen; unseen buckets add the
+     ledger's observed cold wall (or a conservative default) so a
+     deadline-tight submission is not flushed into a 100x compile
+     stall when a warm smaller bucket would have served it.
+  3. **The measured scaling model** (seed): p50 ~= 90 ms + 97 us/sig
+     (PERF_NOTES round 5, the Pallas scaling fit) — the prior before
+     any observation, and the source of FIXED_DEVICE_COST_MS that the
+     amortization accounting shares.
+
+Host backends (ref/fake) observe their verify walls through the same
+interface, so the model stays meaningful off-hardware: it predicts
+whatever boundary it watched.
+"""
+
+import threading
+
+from lighthouse_tpu.common.device_attribution import FIXED_DEVICE_COST_MS
+
+# the measured per-signature marginal cost (PERF_NOTES: 97 us/sig)
+PER_SET_COST_S = 97e-6
+# EMA smoothing for per-bucket observed walls
+EMA_ALPHA = 0.3
+# cold-compile penalty when the ledger has no cold wall to report yet:
+# conservative seconds added for a never-seen bucket (PR 8 brought the
+# worst verify compile to ~7 s; stay below that but well above warm)
+DEFAULT_COLD_PENALTY_S = 2.0
+
+
+def _bucket(n: int) -> int:
+    """Pow2 lane bucket — the same bucketing the tpu marshal applies,
+    so model buckets and compiled shape classes line up."""
+    b = 1
+    n = max(1, int(n))
+    while b < n:
+        b <<= 1
+    return b
+
+
+class PredictedWallModel:
+    """EMA-per-bucket wall predictor seeded from the measured scaling
+    model, with compile-ledger cold-risk lookup."""
+
+    def __init__(
+        self,
+        fixed_s: float = FIXED_DEVICE_COST_MS / 1e3,
+        per_set_s: float = PER_SET_COST_S,
+    ):
+        self.fixed_s = fixed_s
+        self.per_set_s = per_set_s
+        self._lock = threading.Lock()
+        self._ema: dict[int, float] = {}
+        self._seen: set[int] = set()
+        self.observations = 0
+
+    def observe(self, live: int, wall_s: float):
+        """Feed one completed dispatch's (live sets, wall seconds)."""
+        if wall_s is None or wall_s < 0:
+            return
+        b = _bucket(live)
+        with self._lock:
+            prev = self._ema.get(b)
+            self._ema[b] = (
+                wall_s
+                if prev is None
+                else prev + EMA_ALPHA * (wall_s - prev)
+            )
+            self._seen.add(b)
+            self.observations += 1
+
+    def _cold_penalty(self, bucket: int) -> float:
+        """Extra seconds when `bucket`'s BLS lane shape was never
+        dispatched in this process. The bus's own observations clear it
+        first; otherwise the compile ledger decides: ANY verify-plane
+        entry whose shape bucket matches (cold OR warm — a warm entry
+        proves the graph is compiled, even if it was dispatched outside
+        the bus) clears the penalty, and an unseen bucket is charged
+        the worst cold wall the VERIFY plane has shown — never another
+        plane's compile (a 7 s KZG cold must not make every gossip
+        deadline look unmeetable). No verify evidence at all falls
+        back to the conservative default."""
+        with self._lock:
+            if bucket in self._seen:
+                return 0.0
+        try:
+            from lighthouse_tpu.common.compile_ledger import LEDGER
+
+            entries = LEDGER.entries()
+        # lint: allow(except-swallow): ledger read is advisory — prediction falls back to the default penalty
+        except Exception:
+            entries = []
+        shape_prefix = f"s{bucket}k"
+        colds = []
+        for e in entries:
+            fn = e.get("fn") or ""
+            if not fn.startswith("verify"):
+                continue
+            if (e.get("shape") or "").startswith(shape_prefix):
+                return 0.0
+            if e.get("event") == "cold":
+                colds.append(e.get("duration_s") or 0.0)
+        return max(colds) if colds else DEFAULT_COLD_PENALTY_S
+
+    def predict_s(self, live: int, cold_risk: bool = False) -> float:
+        """Predicted wall seconds for a batch of `live` sets. With
+        `cold_risk` the never-seen-bucket compile penalty is added —
+        the deadline-flush decision uses it, the amortization math
+        never does."""
+        b = _bucket(live)
+        with self._lock:
+            ema = self._ema.get(b)
+        base = (
+            ema
+            if ema is not None
+            else self.fixed_s + self.per_set_s * max(1, int(live))
+        )
+        if cold_risk:
+            base += self._cold_penalty(b)
+        return base
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "observations": self.observations,
+                "buckets": {
+                    str(b): round(v, 6)
+                    for b, v in sorted(self._ema.items())
+                },
+                "seed_fixed_ms": round(self.fixed_s * 1e3, 3),
+                "seed_per_set_us": round(self.per_set_s * 1e6, 3),
+            }

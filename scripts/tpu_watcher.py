@@ -81,6 +81,10 @@ SWEEP = [
     ("xla", 4, "kzg"),
     ("xla", 4096, "kzg"),
     ("xla", 8, "kzgfold"),
+    # --- verification-bus amortization A/B: mixed-consumer replay
+    # through the bus vs direct N=1 dispatch (real fixed-cost numbers
+    # for the PR 12 coalescing claims land here first)
+    ("pallas", 64, "busmix"),
     # --- per-sweep reference point + BASELINE configs
     ("xla", 1024),
     ("pallas", 64, "sync512"),

@@ -1,0 +1,550 @@
+"""Verification bus: deadline-aware cross-consumer batch coalescing.
+
+Covers the PR 12 contracts: passthrough verdict equivalence against
+direct dispatch, the deadline-miss path (an expired submission gets an
+immediate small-batch flush, never a silent drop), mixed-batch failure
+AND exception isolation (one consumer's bad set cannot fail or crash a
+coterminous consumer's verdict), attribution equality through shared
+batches (registry == journal per consumer, the attribution_complete
+contract), flush triggers (fill/bulk/pressure/hold), the learned wall
+model, the bus-submit lint pass, the cli knob parsers, the health
+surface, and the bus_no_starvation sim invariant.
+"""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu import bls
+from lighthouse_tpu.common import device_attribution as attribution
+from lighthouse_tpu.common.events_journal import Journal
+from lighthouse_tpu.verification_bus import (
+    PredictedWallModel,
+    VerificationBus,
+)
+
+
+@pytest.fixture(scope="module")
+def sets():
+    """One valid and one invalid real signature set (ref-verifiable)."""
+    kps = bls.interop_keypairs(2)
+    msg = b"verification-bus-test"
+    good = bls.SignatureSet(kps[0].sk.sign(msg), [kps[0].pk], msg)
+    bad = bls.SignatureSet(kps[0].sk.sign(b"wrong"), [kps[0].pk], msg)
+    return {"good": good, "bad": bad}
+
+
+def _sets_delta(before, after):
+    out = {}
+    for consumer, v in after.items():
+        d = v - before.get(consumer, 0)
+        if d:
+            out[consumer] = d
+    return out
+
+
+# ------------------------------------------------------- verdict contract
+
+
+def test_passthrough_matches_direct_dispatch(sets):
+    j = Journal()
+    bus = VerificationBus(backend="ref", journal=j)
+    assert bus.submit([sets["good"]], consumer="gossip_single") is True
+    assert bus.submit([sets["bad"]], consumer="gossip_single") is False
+    assert bus.submit([], consumer="gossip_single") is False
+    assert bus.submit_individual(
+        [sets["good"], sets["bad"]], consumer="gossip_single"
+    ) == [True, False]
+    # one journal event per batch submission, carrying the bus id
+    evs = j.query(kind="signature_batch")
+    batch_evs = [e for e in evs if "bus_batch" in e["attrs"]]
+    assert len(batch_evs) == 2
+    assert batch_evs[0]["outcome"] == "ok"
+    assert batch_evs[1]["outcome"] == "failed"
+    assert all(
+        e["attrs"]["trigger"] == "passthrough" for e in batch_evs
+    )
+
+
+def test_empty_sets_and_unknown_consumer():
+    bus = VerificationBus(backend="fake")
+    with pytest.raises(ValueError):
+        bus.submit([object()], consumer="not-a-consumer")
+
+
+# ------------------------------------------------------ deadline handling
+
+
+def test_expired_deadline_gets_immediate_small_batch_flush(sets):
+    """A submission whose deadline is already spent is flushed NOW in a
+    small batch — never queued behind the hold, never dropped."""
+    j = Journal()
+    bus = VerificationBus(
+        backend="fake", journal=j, max_hold_ms=2000.0
+    )
+    t0 = time.perf_counter()
+    ok = bus.submit(
+        [sets["good"]], consumer="gossip_single", deadline=0.0
+    )
+    wall = time.perf_counter() - t0
+    assert ok is True
+    assert wall < 1.0  # nowhere near the 2 s hold
+    stats = bus.stats()
+    assert stats["deadline_misses"] >= 1
+    assert stats["pending"] == 0
+    (ev,) = j.query(kind="signature_batch")
+    assert ev["attrs"]["trigger"] == "deadline"
+
+
+def test_deadline_object_and_budget_fn():
+    bus = VerificationBus(backend="fake")
+
+    class _DL:
+        def remaining(self):
+            return 1.25
+
+    assert bus._budget_for("gossip_single", _DL()) == pytest.approx(
+        1.25
+    )
+    assert bus._budget_for("gossip_single", 0.5) == pytest.approx(0.5)
+    bus.budget_fns["gossip_single"] = lambda: 3.5
+    assert bus._budget_for("gossip_single", None) == pytest.approx(3.5)
+    assert bus._budget_for("sync_segment", None) == pytest.approx(
+        bus.class_budgets["sync_segment"]
+    )
+
+
+def test_slot_clock_derives_gossip_budgets():
+    """A chain with a slot clock wires gossip/sidecar budgets from the
+    1/3-slot attestation deadline, not a hand-set constant."""
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    h = Harness(minimal_spec(name="bus-clock"), 4, backend="fake")
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.common.slot_clock import ManualSlotClock
+
+    spec = h.spec
+    clock = ManualSlotClock(h.state.genesis_time, spec.SECONDS_PER_SLOT)
+    chain = BeaconChain(
+        h.state.copy(), spec, backend="fake", slot_clock=clock
+    )
+    bus = chain.verification_bus
+    assert "gossip_single" in bus.budget_fns
+    assert "sidecar_header" in bus.budget_fns
+    # at slot start the remaining window is the 1/3-slot deadline
+    budget = bus.budget_fns["gossip_single"]()
+    assert 0.25 <= budget <= spec.SECONDS_PER_SLOT
+
+
+# -------------------------------------------------- coalescing + triggers
+
+
+def test_concurrent_submissions_coalesce_into_one_batch(sets):
+    j = Journal()
+    bus = VerificationBus(
+        backend="fake", journal=j, max_hold_ms=500.0
+    )
+    results = {}
+
+    def run(name, consumer):
+        results[name] = bus.submit([sets["good"]], consumer=consumer)
+
+    threads = [
+        threading.Thread(target=run, args=(f"g{i}", "gossip_single"))
+        for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results == {"g0": True, "g1": True, "g2": True}
+    evs = j.query(kind="signature_batch")
+    ids = {e["attrs"]["bus_batch"] for e in evs}
+    assert len(evs) == 3 and len(ids) == 1
+    assert all(e["attrs"]["batch_live"] == 3 for e in evs)
+    stats = bus.stats()
+    assert stats["coalesced_batches"] == 1
+    assert stats["mean_live_per_batch"] == pytest.approx(3.0)
+
+
+def test_bulk_submission_flushes_pending_singles(sets):
+    """A bulk-sized submission dispatches immediately AND carries the
+    queued singles with it — sync segments never pay the hold, gossip
+    singles ride their batches for free."""
+    j = Journal()
+    bus = VerificationBus(
+        backend="fake", journal=j, max_hold_ms=5000.0
+    )
+    bus.bulk_flush_live = 8
+    results = {}
+
+    def single():
+        results["single"] = bus.submit(
+            [sets["good"]], consumer="gossip_single"
+        )
+
+    t = threading.Thread(target=single)
+    t0 = time.perf_counter()
+    t.start()
+    time.sleep(0.05)  # let the single queue up into its hold
+    results["segment"] = bus.submit(
+        [sets["good"]] * 8, consumer="sync_segment"
+    )
+    t.join(timeout=30)
+    wall = time.perf_counter() - t0
+    assert results == {"single": True, "segment": True}
+    assert wall < 2.0  # nowhere near the 5 s hold
+    evs = j.query(kind="signature_batch")
+    assert {e["attrs"]["bus_batch"] for e in evs} == {1}
+    assert {e["attrs"]["consumer"] for e in evs} == {
+        "gossip_single",
+        "sync_segment",
+    }
+    assert evs[0]["attrs"]["trigger"] == "bulk"
+
+
+def test_fill_target_flushes_without_hold(sets):
+    bus = VerificationBus(
+        backend="fake", max_hold_ms=5000.0, fill_target=4
+    )
+    bus.bulk_flush_live = 1000  # isolate the fill trigger
+    t0 = time.perf_counter()
+    assert bus.submit(
+        [sets["good"]] * 4, consumer="gossip_single"
+    )
+    assert time.perf_counter() - t0 < 2.0
+    assert bus.stats()["triggers"].get("fill") == 1
+
+
+def test_pressure_signal_flushes_without_hold(sets):
+    bus = VerificationBus(backend="fake", max_hold_ms=5000.0)
+    bus.pressure_fn = lambda: True
+    t0 = time.perf_counter()
+    assert bus.submit([sets["good"]], consumer="gossip_single")
+    assert time.perf_counter() - t0 < 2.0
+    assert bus.stats()["triggers"].get("pressure") == 1
+
+
+# ------------------------------------------------------ failure isolation
+
+
+def test_mixed_batch_failure_isolation(sets):
+    """One consumer's invalid set fails ITS verdict only: the
+    coterminous consumer's submission re-verifies in its own sub-batch
+    and stays True — each caller's error semantics survive
+    coalescing."""
+    j = Journal()
+    bus = VerificationBus(backend="ref", journal=j, max_hold_ms=500.0)
+    sets_before = dict(attribution.consumer_totals())
+    results = {}
+
+    def run(name, consumer, ss):
+        results[name] = bus.submit(ss, consumer=consumer)
+
+    t1 = threading.Thread(
+        target=run, args=("bad", "gossip_single", [sets["bad"]])
+    )
+    t2 = threading.Thread(
+        target=run, args=("good", "sync_segment", [sets["good"]])
+    )
+    t1.start()
+    t2.start()
+    t1.join(timeout=60)
+    t2.join(timeout=60)
+    assert results == {"bad": False, "good": True}
+    stats = bus.stats()
+    assert stats["fallback_batches"] == 2
+    evs = j.query(kind="signature_batch")
+    # 2 events for the failed shared attempt + 2 for the sub-batches
+    assert len(evs) == 4
+    retries = [e for e in evs if e["attrs"].get("mixed_retry")]
+    assert len(retries) == 2
+    finals = {
+        e["attrs"]["consumer"]: e["outcome"]
+        for e in evs
+        if e["attrs"]["trigger"] == "fallback"
+    }
+    assert finals == {
+        "gossip_single": "failed",
+        "sync_segment": "ok",
+    }
+    # attribution equality (the attribution_complete contract): the
+    # registry counted each consumer's sets once for the shared attempt
+    # and once for its fallback sub-batch — exactly what the journal
+    # carries
+    delta = _sets_delta(sets_before, attribution.consumer_totals())
+    journal_totals = {}
+    for e in evs:
+        c = e["attrs"]["consumer"]
+        journal_totals[c] = (
+            journal_totals.get(c, 0) + e["attrs"]["n_sets"]
+        )
+    assert delta == journal_totals
+
+
+def test_exception_isolation(sets):
+    """A submission whose sets CRASH the dispatch re-raises in its own
+    caller; a coterminous good submission still gets its verdict."""
+
+    class _BrokenSet:
+        # quacks enough to reach the ref dispatch, then explodes
+        @property
+        def signature(self):
+            raise RuntimeError("boom")
+
+        pubkeys = []
+        message = b""
+
+    bus = VerificationBus(backend="ref", max_hold_ms=500.0)
+    results = {}
+    errors = {}
+
+    def run_bad():
+        try:
+            results["bad"] = bus.submit(
+                [_BrokenSet()], consumer="gossip_single"
+            )
+        except RuntimeError as e:
+            errors["bad"] = str(e)
+
+    def run_good():
+        results["good"] = bus.submit(
+            [sets["good"]], consumer="sync_segment"
+        )
+
+    t1 = threading.Thread(target=run_bad)
+    t2 = threading.Thread(target=run_good)
+    t1.start()
+    t2.start()
+    t1.join(timeout=60)
+    t2.join(timeout=60)
+    assert errors == {"bad": "boom"}
+    assert results == {"good": True}
+
+
+def test_shared_dispatch_attribution_and_economics(sets):
+    """verify_signature_sets_shared counts each contributor's sets and
+    fans the batch economics out: every contributor shares the batch's
+    amortized fixed cost."""
+    before = dict(attribution.consumer_totals())
+    amort_before = attribution.amortized_totals()
+    ok, record = bls.verify_signature_sets_shared(
+        [
+            ([sets["good"]], "gossip_single"),
+            ([sets["good"]] * 3, "sync_segment"),
+        ],
+        backend="fake",
+    )
+    assert ok is True
+    delta = _sets_delta(before, attribution.consumer_totals())
+    assert delta == {"gossip_single": 1, "sync_segment": 3}
+    assert record["live"] == 4
+    assert record["amortized_fixed_ms"] == pytest.approx(90.0 / 4)
+    amort = attribution.amortized_totals()
+    # gossip paid 1 x 22.5, segment 3 x 22.5 — together one fixed cost
+    g = amort[("gossip_single", "bls")] - amort_before.get(
+        ("gossip_single", "bls"), 0.0
+    )
+    s = amort[("sync_segment", "bls")] - amort_before.get(
+        ("sync_segment", "bls"), 0.0
+    )
+    assert g == pytest.approx(22.5)
+    assert s == pytest.approx(67.5)
+
+
+# ------------------------------------------------------------ wall model
+
+
+def test_wall_model_seed_and_learning():
+    m = PredictedWallModel()
+    # unseeded prediction = the measured scaling model
+    assert m.predict_s(1) == pytest.approx(0.09 + 97e-6)
+    assert m.predict_s(100) == pytest.approx(0.09 + 97e-6 * 100)
+    # observations move the bucket's estimate
+    for _ in range(20):
+        m.observe(4, 0.010)
+    assert m.predict_s(3) == pytest.approx(0.010, rel=0.3)
+    # cold-risk adds a penalty only for never-seen buckets
+    assert m.predict_s(3, cold_risk=True) == m.predict_s(3)
+    assert m.predict_s(4096, cold_risk=True) > m.predict_s(4096)
+    stats = m.stats()
+    assert stats["observations"] == 20 and "4" in stats["buckets"]
+
+
+# ---------------------------------------------------------- control plane
+
+
+def test_cli_knob_parsers():
+    from lighthouse_tpu.cli import (
+        parse_admission_limits,
+        parse_bus_deadlines,
+    )
+
+    assert parse_admission_limits("cheap_read=16:1.5,write=4") == {
+        "cheap_read": (16, 1.5),
+        "write": (4, 5.0),
+    }
+    with pytest.raises(ValueError):
+        parse_admission_limits("nope=1:1")
+    assert parse_bus_deadlines("gossip_single=0.4,slasher=60") == {
+        "gossip_single": 0.4,
+        "slasher": 60.0,
+    }
+    with pytest.raises(ValueError):
+        parse_bus_deadlines("nonsense=1")
+
+
+def test_bus_flags_apply_and_health_surface():
+    import argparse
+
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.cli import (
+        _apply_admission_flags,
+        _apply_bus_flags,
+    )
+    from lighthouse_tpu.harness import Harness
+    from lighthouse_tpu.http_api import BeaconApiServer
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    h = Harness(minimal_spec(name="bus-health"), 4, backend="fake")
+    chain = BeaconChain(h.state.copy(), h.spec, backend="fake")
+    args = argparse.Namespace(
+        bus_max_hold_ms=7.5,
+        bus_fill_target=128,
+        bus_deadlines="slasher=45",
+        admission_limits="expensive_read=2:3.0",
+    )
+    _apply_bus_flags(chain, args)
+    bus = chain.verification_bus
+    assert bus.max_hold_ms == 7.5
+    assert bus.fill_target == 128
+    assert bus.class_budgets["slasher"] == 45.0
+    srv = BeaconApiServer(chain)
+    _apply_admission_flags(srv, args)
+    assert srv.admission.limits["expensive_read"] == (2, 3.0)
+    doc = srv.overload_state()
+    vb = doc["verification_bus"]
+    assert vb["max_hold_ms"] == 7.5
+    assert vb["fill_target"] == 128
+    assert vb["class_budgets"]["slasher"] == 45.0
+    assert doc["http"]["expensive_read"]["limit"] == 2
+
+
+# --------------------------------------------------------------- the lint
+
+
+def test_bus_submit_lint_pass(tmp_path):
+    from lighthouse_tpu.analysis.core import run_passes
+    from lighthouse_tpu.analysis.passes.bus_submit import BusSubmitPass
+
+    bad = (
+        "from lighthouse_tpu import bls\n"
+        "def f(chain, sets):\n"
+        "    return bls.verify_signature_sets(\n"
+        "        sets, consumer='gossip_single')\n"
+    )
+    good = (
+        "def f(chain, sets):\n"
+        "    return chain.verification_bus.submit(\n"
+        "        sets, consumer='gossip_single')\n"
+    )
+    for rel, src in (
+        ("beacon_chain/x.py", bad),
+        ("network/y.py", good),
+        ("bls/z.py", bad),  # crypto plane: exempt
+        ("state_processing/w.py", bad),  # collector library: exempt
+    ):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    findings, _ = run_passes(tmp_path, [BusSubmitPass()])
+    assert len(findings) == 1
+    assert findings[0].path == "beacon_chain/x.py"
+    assert "verify_signature_sets" in findings[0].msg
+
+
+def test_package_is_bus_clean():
+    """Zero-baseline acceptance: no consumer subsystem dispatches the
+    BLS batch boundary directly anymore."""
+    from pathlib import Path
+
+    from lighthouse_tpu.analysis.core import run_passes
+    from lighthouse_tpu.analysis.passes.bus_submit import BusSubmitPass
+
+    pkg = Path(__file__).resolve().parents[1] / "lighthouse_tpu"
+    findings, _ = run_passes(pkg, [BusSubmitPass()])
+    # other rules' allow-comments surface as unknown-rule markers in a
+    # single-pass run; the acceptance claim is about bus-submit only
+    assert [
+        f.format() for f in findings if f.rule == "bus-submit"
+    ] == []
+
+
+# ----------------------------------------------------------- sim invariant
+
+
+def test_bus_no_starvation_invariant_unit():
+    from lighthouse_tpu.sim import invariants as inv
+
+    class _SN:
+        index = 0
+        online = True
+        journal_archives = ()
+
+    bus_doc = {"pending": 0, "submitted": 5, "completed": 5}
+    events = [
+        {
+            "kind": "signature_batch",
+            "attrs": {
+                "consumer": "gossip_single",
+                "n_sets": 1,
+                "bus_batch": 1,
+                "wait_s": 0.01,
+                "budget_s": 2.0,
+                "wall_s": 0.005,
+            },
+        }
+    ]
+    ctx = inv.SimContext(
+        scenario=None,
+        nodes={"n0": _SN()},
+        snapshot_before={},
+        snapshot_after={},
+        blob_blocks={},
+        eclipse_windows={},
+    )
+    ctx.health = lambda name: {
+        "overload": {"verification_bus": dict(bus_doc)}
+    }
+    ctx.events = lambda name, **q: list(events)
+    assert inv.bus_no_starvation(ctx) == []
+    # a stranded submission is a violation
+    bus_doc["completed"] = 4
+    assert any(
+        "never reached a verdict" in v
+        for v in inv.bus_no_starvation(ctx)
+    )
+    bus_doc["completed"] = 5
+    # a wait far past deadline + batch wall is starvation
+    events.append(
+        {
+            "kind": "signature_batch",
+            "attrs": {
+                "consumer": "gossip_single",
+                "n_sets": 1,
+                "bus_batch": 2,
+                "wait_s": 9.0,
+                "budget_s": 2.0,
+                "wall_s": 0.005,
+            },
+        }
+    )
+    assert any("waited" in v for v in inv.bus_no_starvation(ctx))
+    events.pop()
+    # a node whose health lost the bus section is a violation
+    ctx.health = lambda name: {"overload": {}}
+    assert any(
+        "verification_bus" in v for v in inv.bus_no_starvation(ctx)
+    )
