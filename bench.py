@@ -37,6 +37,11 @@ Env knobs:
   BENCH_CONFIG=serve        mixed REST+gossip+RPC load against a live
                             node: per-class p50/p99, hot-read cache,
                             shed counts (BENCH_SERVE_SHED=0 = A/B off)
+  BENCH_CONFIG=lcserve      light-client read flood against one live
+                            node: per-class p50/p99, TTL cache-miss <=
+                            window assertion, streamed-bytes totals
+  BENCH_CONFIG=lcproof      batched device Merkle-proof kernel at
+                            BENCH_NSETS queries (byte-identical fold)
 """
 
 import json
@@ -309,6 +314,18 @@ def _measure(jax, platform):
         from lighthouse_tpu import bench_busmix
 
         return bench_busmix.measure(jax, platform)
+    if config == "lcserve":
+        # light-client read flood against one live node (serving edge
+        # on the fake backend; never a hardware headline)
+        from lighthouse_tpu import bench_lcserve
+
+        return bench_lcserve.measure(jax, platform)
+    if config == "lcproof":
+        # batched device Merkle-proof kernel at BENCH_NSETS queries,
+        # byte-identical to the host oracle every iteration
+        from lighthouse_tpu import bench_lcserve
+
+        return bench_lcserve.measure_proofs(jax, platform)
     return _measure_sigsets(jax, platform)
 
 

@@ -41,6 +41,9 @@ ENTRY_POINTS = {
     "g1_msm_fixed_base_tpu",
     "sharded_verify_signature_sets",
     "sharded_verify_signature_sets_grouped",
+    "batch_merkle_roots",
+    "batch_verify_branches",
+    "batch_extract_proofs",
 }
 
 # raw jit-graph namespace sharing names with the api boundary

@@ -210,6 +210,16 @@ class BeaconChain:
         # hot-read cache invalidation here so a cached head/finalized
         # response can never be served after the head moved
         self.import_hooks: list = []
+        # light-client serving plane: the producer rides the import
+        # hooks, maintaining best-update-per-period, finality/optimistic
+        # updates, and bootstrap documents for recent finalized roots
+        # (cheap no-op on pre-altair chains — one store read per hook)
+        from lighthouse_tpu.light_client.producer import (
+            LightClientUpdateProducer,
+        )
+
+        self.light_client_producer = LightClientUpdateProducer(self)
+        self.import_hooks.append(self.light_client_producer.on_import)
         # (header root, signature) pairs whose proposer signature already
         # verified — gossip redeliveries of a block's sidecars cost one
         # pairing total, not one per sidecar (FIFO-bounded)

@@ -60,6 +60,7 @@ CONSUMERS = frozenset(
         "oppool",          # op-pool / aggregation revalidation
         "kzg",             # KZG proof verification + producer MSMs
         "slasher",         # slashing-proof verification
+        "light_client",    # light-client update production + sim actor
         "bench",           # benchmarks and measurement harnesses
     }
 )

@@ -80,6 +80,13 @@ KINDS = frozenset(
         "peer_quarantine",
         # validator monitor
         "validator_summary",
+        # light-client serving plane (light_client/producer.py +
+        # http_api/server.py): one event per produced/bettered update
+        # document (deterministic protocol claim — part of the sim's
+        # canonical replay projection) and one per served light-client
+        # read (request-timing-attributed, deliberately NOT canonical)
+        "lc_update_produced",
+        "lc_served",
         # network simulator (sim/orchestrator): fault timeline entries —
         # partitions applied/lifted, eclipses, offline windows, spam
         # floods, kv crashes — landed in every affected node's journal so

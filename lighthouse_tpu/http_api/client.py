@@ -284,6 +284,50 @@ class BeaconNodeHttpClient:
         ) as r:
             return r.read().decode()
 
+    # ------------------------------------------------- light-client routes
+    # Typed SSZ consumers of the light-client serving plane — the sim's
+    # light-client actor and the validator client use exactly these.
+    # `t` is a types namespace (types_for(spec)).
+
+    def get_lc_bootstrap(self, t, block_root: bytes):
+        raw = self._get_ssz(
+            "/eth/v1/beacon/light_client/bootstrap/0x"
+            + bytes(block_root).hex()
+        )
+        return t.LightClientBootstrap.decode(raw)
+
+    def get_lc_updates(self, t, start_period: int, count: int) -> list:
+        """Length-prefixed SSZ frames ([uint64 le][update]) -> decoded
+        LightClientUpdates."""
+        raw = self._get_ssz(
+            "/eth/v1/beacon/light_client/updates"
+            f"?start_period={start_period}&count={count}"
+        )
+        out = []
+        pos = 0
+        while pos < len(raw):
+            if pos + 8 > len(raw):
+                raise ApiClientError("truncated lc update frame header")
+            n = int.from_bytes(raw[pos : pos + 8], "little")
+            pos += 8
+            if pos + n > len(raw):
+                raise ApiClientError("truncated lc update frame body")
+            out.append(t.LightClientUpdate.decode(raw[pos : pos + n]))
+            pos += n
+        return out
+
+    def get_lc_finality_update(self, t):
+        raw = self._get_ssz(
+            "/eth/v1/beacon/light_client/finality_update"
+        )
+        return t.LightClientFinalityUpdate.decode(raw)
+
+    def get_lc_optimistic_update(self, t):
+        raw = self._get_ssz(
+            "/eth/v1/beacon/light_client/optimistic_update"
+        )
+        return t.LightClientOptimisticUpdate.decode(raw)
+
 
 def _decode_checkpoint_state(raw_state: bytes, spec):
     """SSZ state bytes -> (state, fork name): try fork classes

@@ -104,6 +104,7 @@ _ROUTE_SEGMENTS = frozenset(
     snapshot compiles
     config validator debug events genesis states headers blocks blinded
     blob_sidecars pool duties liveness register_validator blinded_blocks
+    light_client bootstrap updates finality_update optimistic_update
     aggregate_and_proofs contribution_and_proofs aggregate_attestation
     attestation_data sync_committee_contribution
     beacon_committee_subscriptions attestations sync_committees
@@ -311,6 +312,10 @@ class BeaconApiServer:
         self._hot_caches = {
             "state_reads": TTLCache("state_reads", ttl_s=1.0),
             "blob_sidecars": TTLCache("blob_sidecars", ttl_s=2.0),
+            # light-client read documents change only on import (the
+            # same hook invalidates), so a million-user read flood
+            # costs one producer lookup per TTL window per period
+            "light_client": TTLCache("light_client", ttl_s=1.0),
         }
         api = self
 
@@ -337,6 +342,17 @@ class BeaconApiServer:
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _send_stream(self, stream):
+                """Stream an SszStream response: Content-Length known
+                up front (pure arithmetic), body written chunk by
+                chunk — the handler never held the full encoding."""
+                self.send_response(200)
+                self.send_header("Content-Type", stream.content_type)
+                self.send_header("Content-Length", str(stream.length))
+                self.end_headers()
+                for chunk in stream.chunks():
+                    self.wfile.write(chunk)
 
             def _send_shed(self, e: AdmissionError):
                 """503/429 + Retry-After: the refuse-loud contract."""
@@ -381,7 +397,13 @@ class BeaconApiServer:
                         # self.headers is an HTTPMessage: case-
                         # insensitive get(), as header lookup must be
                         out = api._cached_get(self.path, self.headers)
-                    if isinstance(out, tuple):
+                    from lighthouse_tpu.http_api.streaming import (
+                        SszStream,
+                    )
+
+                    if isinstance(out, SszStream):
+                        self._send_stream(out)
+                    elif isinstance(out, tuple):
                         self._send(200, out[0], content_type=out[1])
                     else:
                         self._send(200, out)
@@ -508,6 +530,8 @@ class BeaconApiServer:
         parts = [p for p in path.split("?")[0].split("/") if p]
         if parts[:4] == ["eth", "v1", "beacon", "blob_sidecars"]:
             return self._hot_caches["blob_sidecars"]
+        if parts[:4] == ["eth", "v1", "beacon", "light_client"]:
+            return self._hot_caches["light_client"]
         if (
             parts[:4] == ["eth", "v1", "beacon", "states"]
             and len(parts) >= 5
@@ -523,16 +547,47 @@ class BeaconApiServer:
         cache = self._cache_for(path)
         if cache is None:
             return self.handle_get(path, headers)
-        hit, value = cache.get(path)
+        key = path
+        is_lc = cache is self._hot_caches["light_client"]
+        if is_lc and headers is not None and (
+            "application/octet-stream" in headers.get("Accept", "")
+        ):
+            # light-client endpoints negotiate JSON vs SSZ — the two
+            # renderings must never share a cache slot
+            key = path + "#ssz"
+        hit, value = cache.get(key)
         if hit:
-            return value
-        # capture the generation BEFORE resolving: if an import
-        # invalidates while we compute, put() discards our (old-head)
-        # response instead of caching it past the invalidation
-        gen = cache.generation
-        out = self.handle_get(path, headers)
-        cache.put(path, out, generation=gen)
+            out = value
+        else:
+            # capture the generation BEFORE resolving: if an import
+            # invalidates while we compute, put() discards our
+            # (old-head) response instead of caching it past the
+            # invalidation
+            gen = cache.generation
+            out = self.handle_get(path, headers)
+            cache.put(key, out, generation=gen)
+        if is_lc:
+            self._account_lc_serve(path, out)
         return out
+
+    def _account_lc_serve(self, path: str, out):
+        """Per-request light-client serving record: one `lc_served`
+        journal event (cache hits included — the count is a function of
+        the request stream, never of TTL timing) plus byte accounting.
+        JSON responses are PRE-RENDERED bytes tuples (the resolver
+        encodes once; cache hits re-serve the same bytes), so counting
+        is a len() — streams count their own bytes at write time."""
+        from lighthouse_tpu.http_api.streaming import (
+            SszStream,
+            count_served_bytes,
+        )
+
+        endpoint = _endpoint_label(path)
+        if isinstance(out, tuple):
+            count_served_bytes(endpoint, len(out[0]))
+        elif not isinstance(out, SszStream):  # pragma: no cover
+            count_served_bytes(endpoint, len(json.dumps(out)))
+        self.chain.journal.emit("lc_served", endpoint=endpoint)
 
     def _invalidate_hot_caches(self, block_root=None):
         """Chain import hook: a new block moves the head and lands new
@@ -716,9 +771,16 @@ class BeaconApiServer:
                 return {"data": heads}
             if parts[3:5] == ["beacon", "states"] and len(parts) == 6:
                 # full state as SSZ (the v2 octet-stream form — the JSON
-                # rendering of a whole BeaconState is not served)
+                # rendering of a whole BeaconState is not served),
+                # STREAMED: the handler never materializes the encoded
+                # state, its peak allocation is one chunk (PR 10's
+                # remaining idea, landed with the light-client plane)
+                from lighthouse_tpu.http_api.streaming import SszStream
+
                 state = self._resolve_state(parts[5])
-                return (state.to_bytes(), "application/octet-stream")
+                return SszStream.for_value(
+                    type(state), state, endpoint="debug_state"
+                )
             if parts[3] == "fork_choice":
                 # snapshot before iterating AND before parent-index
                 # lookups — the import thread appends concurrently
@@ -755,6 +817,8 @@ class BeaconApiServer:
                     "fork_choice_nodes": nodes,
                 }
         if parts[:3] == ["eth", "v1", "beacon"]:
+            if parts[3] == "light_client" and len(parts) >= 5:
+                return self._light_client(parts, path, headers)
             if parts[3] == "genesis":
                 st = chain.head_state
                 return {
@@ -1231,6 +1295,102 @@ class BeaconApiServer:
             if parts[4] == "sync":
                 return self._sync_duties(int(parts[5]), indices)
         raise ApiError(404, f"unknown route {path}")
+
+    # ------------------------------------------------- light-client plane
+
+    # standard beacon-API cap on updates-by-range responses
+    MAX_LC_UPDATES = 16
+
+    def _light_client(self, parts, path: str, headers):
+        """GET /eth/v1/beacon/light_client/{bootstrap/{root} | updates
+        ?start_period=&count= | finality_update | optimistic_update}.
+
+        Served entirely from the producer's retained documents — no
+        state walk, no store replay — behind the cheap_read admission
+        class with a per-import-invalidated TTL cache in front. SSZ
+        responses (Accept: application/octet-stream) STREAM; the
+        updates range streams as length-prefixed frames."""
+        from lighthouse_tpu.http_api.streaming import SszStream
+
+        chain = self.chain
+        producer = getattr(chain, "light_client_producer", None)
+        if producer is None:
+            raise ApiError(404, "light-client serving not enabled")
+        t = chain.t
+        which = parts[4]
+        want_ssz = headers is not None and (
+            "application/octet-stream" in headers.get("Accept", "")
+        )
+        fork = chain.spec.fork_name_at_epoch(
+            chain.spec.slot_to_epoch(chain.head_state.slot)
+        )
+
+        def render_json(payload):
+            # encode ONCE at resolve time: the TTL cache holds rendered
+            # bytes, so a cache hit re-serves without re-serializing
+            # (the byte accounting is then a len(), never a dumps)
+            return (json.dumps(payload).encode(), "application/json")
+
+        def one(doc, cls, endpoint):
+            if doc is None:
+                raise ApiError(404, f"no {endpoint} available")
+            if want_ssz:
+                return SszStream.for_value(cls, doc, endpoint=endpoint)
+            return render_json(
+                {"version": fork, "data": to_json(cls, doc)}
+            )
+
+        if which == "bootstrap" and len(parts) == 6:
+            root = parts[5]
+            try:
+                root_bytes = bytes.fromhex(
+                    root[2:] if root.startswith("0x") else root
+                )
+            except ValueError:
+                raise ApiError(400, "invalid block root") from None
+            doc = producer.bootstrap_for(root_bytes)
+            if doc is None:
+                raise ApiError(
+                    404, "no bootstrap for that block root"
+                )
+            return one(doc, t.LightClientBootstrap, "lc_bootstrap")
+        if which == "updates":
+            q = self._query(path)
+            start = self._int_q(q, "start_period")
+            count = self._int_q(q, "count")
+            if start is None or count is None:
+                raise ApiError(400, "start_period and count required")
+            count = min(count, self.MAX_LC_UPDATES)
+            updates = producer.updates_range(start, count)
+            if want_ssz:
+                return SszStream.framed(
+                    [(t.LightClientUpdate, u) for u in updates],
+                    endpoint="lc_updates",
+                )
+            return render_json(
+                {
+                    "data": [
+                        {
+                            "version": fork,
+                            "data": to_json(t.LightClientUpdate, u),
+                        }
+                        for u in updates
+                    ]
+                }
+            )
+        if which == "finality_update":
+            return one(
+                producer.finality_update,
+                t.LightClientFinalityUpdate,
+                "lc_finality_update",
+            )
+        if which == "optimistic_update":
+            return one(
+                producer.optimistic_update,
+                t.LightClientOptimisticUpdate,
+                "lc_optimistic_update",
+            )
+        raise ApiError(404, f"unknown light_client route {path}")
 
     # ------------------------------------------------------------ helpers
 

@@ -1,0 +1,217 @@
+"""SSZ streaming responses: serve containers without materializing them.
+
+PR 10's remaining idea, landed for the light-client serving plane: a
+million-user read class must never cost a full in-memory encode per
+request — `SszStream` walks the SSZ type tree and yields bounded byte
+pieces (fixed parts + offsets first, then each variable field in turn,
+long element sequences in batches), so the handler's peak allocation is
+one chunk, not one state. Content-Length is known up front via
+`encoded_length` (pure arithmetic over the type tree — no bytes built),
+so the response streams over a plain HTTP/1.1 connection.
+
+Accounting: every streamed chunk and byte is counted per endpoint
+(``lighthouse_tpu_lc_stream_chunks_total`` /
+``lighthouse_tpu_lc_served_bytes_total``) — the "served-bytes bounded"
+sim invariant and the lcserve bench read these families.
+
+Streams are REPLAYABLE: construction takes a zero-arg factory returning
+a fresh piece iterator, so a TTL-cached stream re-serves without
+re-resolving the underlying object.
+"""
+
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.ssz.codec import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    OFFSET_SIZE,
+    Union,
+    Vector,
+)
+
+_STREAM_CHUNKS = REGISTRY.counter_vec(
+    "lighthouse_tpu_lc_stream_chunks_total",
+    "chunks written by SSZ streaming responses, per endpoint",
+    ("endpoint",),
+)
+_SERVED_BYTES = REGISTRY.counter_vec(
+    "lighthouse_tpu_lc_served_bytes_total",
+    "bytes served by light-client/streaming read endpoints",
+    ("endpoint",),
+)
+
+DEFAULT_CHUNK_BYTES = 8192
+# element batch for long fixed-size sequences: bounded encode batches
+_ELEMS_PER_PIECE = 128
+
+
+def _is_container(typ) -> bool:
+    return isinstance(typ, type) and issubclass(typ, Container)
+
+
+def encoded_length(typ, value) -> int:
+    """len(typ.encode(value)) by arithmetic over the type tree — no
+    byte materialization."""
+    if _is_container(typ):
+        total = 0
+        for fname, ftype in typ._fields:
+            if ftype.is_fixed():
+                total += ftype.fixed_size()
+            else:
+                total += OFFSET_SIZE + encoded_length(
+                    ftype, getattr(value, fname)
+                )
+        return total
+    if isinstance(typ, (List, Vector)):
+        elem = typ.elem
+        if elem.is_fixed():
+            return elem.fixed_size() * len(value)
+        return sum(
+            OFFSET_SIZE + encoded_length(elem, v) for v in value
+        )
+    if isinstance(typ, ByteVector):
+        return typ.length
+    if isinstance(typ, ByteList):
+        return len(bytes(value))
+    if isinstance(typ, Bitvector):
+        return typ.fixed_size()
+    if isinstance(typ, Bitlist):
+        return (len(value) + 8) // 8
+    if isinstance(typ, Union):
+        selector, inner = value
+        opt = typ.options[selector]
+        return 1 + (0 if opt is None else encoded_length(opt, inner))
+    return typ.fixed_size()
+
+
+def iter_ssz_pieces(typ, value):
+    """Yield the SSZ encoding of `value` as bounded byte pieces, in
+    wire order. Long fixed-element sequences are emitted in
+    _ELEMS_PER_PIECE batches; variable fields recurse."""
+    if _is_container(typ):
+        # fixed part: literal fixed fields + offsets into the var part
+        fixed_len = sum(
+            t.fixed_size() if t.is_fixed() else OFFSET_SIZE
+            for _, t in typ._fields
+        )
+        head = []
+        offset = fixed_len
+        var_fields = []
+        for fname, ftype in typ._fields:
+            fval = getattr(value, fname)
+            if ftype.is_fixed():
+                head.append(ftype.encode(fval))
+            else:
+                head.append(offset.to_bytes(OFFSET_SIZE, "little"))
+                offset += encoded_length(ftype, fval)
+                var_fields.append((ftype, fval))
+        yield b"".join(head)
+        for ftype, fval in var_fields:
+            yield from iter_ssz_pieces(ftype, fval)
+        return
+    if isinstance(typ, (List, Vector)):
+        elem = typ.elem
+        values = list(value)
+        if elem.is_fixed():
+            for i in range(0, len(values), _ELEMS_PER_PIECE):
+                yield b"".join(
+                    elem.encode(v)
+                    for v in values[i : i + _ELEMS_PER_PIECE]
+                )
+            return
+        offset = OFFSET_SIZE * len(values)
+        head = []
+        for v in values:
+            head.append(offset.to_bytes(OFFSET_SIZE, "little"))
+            offset += encoded_length(elem, v)
+        if head:
+            yield b"".join(head)
+        for v in values:
+            yield from iter_ssz_pieces(elem, v)
+        return
+    # leaf types: one piece (coalesced by the stream re-chunker)
+    yield typ.encode(value)
+
+
+class SszStream:
+    """A streamable SSZ response: known Content-Length, bounded chunks,
+    per-endpoint chunk/byte accounting, replayable from its factory."""
+
+    content_type = "application/octet-stream"
+
+    def __init__(
+        self,
+        factory,
+        length: int,
+        endpoint: str,
+        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    ):
+        self._factory = factory
+        self.length = int(length)
+        self.endpoint = endpoint
+        self.chunk_bytes = int(chunk_bytes)
+
+    @classmethod
+    def for_value(cls, typ, value, endpoint: str, **kw):
+        return cls(
+            lambda: iter_ssz_pieces(typ, value),
+            encoded_length(typ, value),
+            endpoint,
+            **kw,
+        )
+
+    @classmethod
+    def framed(cls, items, endpoint: str, **kw):
+        """Length-prefixed frames ([uint64 le length][ssz bytes] per
+        item) — the multi-object response shape (light_client/updates).
+        `items` is [(typ, value)]."""
+        items = list(items)
+        total = sum(
+            8 + encoded_length(typ, value) for typ, value in items
+        )
+
+        def gen():
+            for typ, value in items:
+                yield encoded_length(typ, value).to_bytes(8, "little")
+                yield from iter_ssz_pieces(typ, value)
+
+        return cls(gen, total, endpoint, **kw)
+
+    def chunks(self):
+        """Re-chunked byte stream: pieces coalesced up to chunk_bytes,
+        oversized pieces split; counts land in the lc stream families."""
+        buf = bytearray()
+        sent = 0
+        for piece in self._factory():
+            buf += piece
+            while len(buf) >= self.chunk_bytes:
+                out = bytes(buf[: self.chunk_bytes])
+                del buf[: self.chunk_bytes]
+                sent += len(out)
+                _STREAM_CHUNKS.labels(self.endpoint).inc()
+                _SERVED_BYTES.labels(self.endpoint).inc(len(out))
+                yield out
+        if buf:
+            out = bytes(buf)
+            sent += len(out)
+            _STREAM_CHUNKS.labels(self.endpoint).inc()
+            _SERVED_BYTES.labels(self.endpoint).inc(len(out))
+            yield out
+        if sent != self.length:
+            raise RuntimeError(
+                f"ssz stream for {self.endpoint}: emitted {sent} bytes, "
+                f"Content-Length promised {self.length}"
+            )
+
+    def to_bytes(self) -> bytes:
+        """Materialize (tests + small cached documents)."""
+        return b"".join(self.chunks())
+
+
+def count_served_bytes(endpoint: str, n: int):
+    """Byte accounting for non-streamed (JSON) light-client responses —
+    same family the invariants read, one registration site."""
+    _SERVED_BYTES.labels(endpoint).inc(n)

@@ -1,0 +1,28 @@
+"""Light-client serving plane: sync-committee update production, the
+client-side verification store, and the proof machinery glue.
+
+Reference layer map: beacon_node/lighthouse_network + http_api dedicate
+a serving surface to sync-committee light clients (LightClientBootstrap
+/Update/FinalityUpdate/OptimisticUpdate, the altair light-client sync
+protocol). Here:
+
+  * `producer.LightClientUpdateProducer` rides `chain.import_hooks`,
+    maintaining the best update per sync-committee period, the current
+    finality/optimistic updates, and bootstrap documents for recent
+    finalized roots — proofs extracted through ssz/gindex against the
+    incremental tree-hash cache;
+  * `store.LightClientStore` is the client half: bootstrap from ONE
+    trusted root, then track the chain through served updates alone —
+    branch verification via the same gindex fold the device plane
+    (ops/merkle_proof) reproduces byte-identically, sync-aggregate
+    checks routed through a pluggable verifier (the sim actor submits
+    them to the verification bus under consumer="light_client").
+"""
+
+from lighthouse_tpu.light_client.producer import (  # noqa: F401
+    LightClientUpdateProducer,
+)
+from lighthouse_tpu.light_client.store import (  # noqa: F401
+    LightClientError,
+    LightClientStore,
+)

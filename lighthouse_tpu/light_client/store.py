@@ -1,0 +1,324 @@
+"""Client-side light-client store: one trusted root, then updates only.
+
+The altair light-client sync protocol's consumer half
+(LightClientStore + process_light_client_update, reduced to the axes
+this repo serves): bootstrap pins a finalized header against ONE
+trusted block root and proves the current sync committee into it; every
+later update must carry
+
+  * a sync aggregate signed by the committee of the signature slot's
+    period (verified through a pluggable `verify` callable — the sim
+    actor routes it onto the verification bus under
+    consumer="light_client", standalone users hit the BLS api
+    directly),
+  * a finality branch proving the finalized header's root into the
+    attested state (gindex fold — the same fold the device proof plane
+    reproduces byte-identically),
+  * a next-sync-committee branch for period advancement.
+
+Finalized-head advancement requires a 2/3 supermajority of committee
+bits (the spec's apply condition); the optimistic head follows any
+non-empty aggregate. Every branch verification lands in
+``lighthouse_tpu_lc_client_proofs_total{outcome}`` and every update in
+``lighthouse_tpu_lc_client_updates_total{outcome}`` — the sim's
+"proofs verify" invariant reads these families, never store internals.
+"""
+
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.ssz.gindex import verify_gindex_branch
+from lighthouse_tpu.types.helpers import (
+    compute_domain,
+    compute_signing_root,
+)
+
+_PROOFS = REGISTRY.counter_vec(
+    "lighthouse_tpu_lc_client_proofs_total",
+    "light-client branch verifications on the client side, by outcome",
+    ("outcome",),
+)
+_UPDATES = REGISTRY.counter_vec(
+    "lighthouse_tpu_lc_client_updates_total",
+    "light-client updates processed on the client side, by outcome "
+    "(applied|rejected)",
+    ("outcome",),
+)
+
+
+class LightClientError(Exception):
+    pass
+
+
+def _header_root(t, header) -> bytes:
+    return t.BeaconBlockHeader.hash_tree_root(header.beacon)
+
+
+class LightClientStore:
+    def __init__(
+        self,
+        spec,
+        types,
+        genesis_validators_root: bytes,
+        trusted_root: bytes,
+        verify=None,
+        backend: str | None = None,
+    ):
+        """`verify([SignatureSet]) -> bool` is the aggregate-signature
+        boundary; None builds a direct BLS-api verifier on `backend`."""
+        self.spec = spec
+        self.t = types
+        self.gvr = bytes(genesis_validators_root)
+        self.trusted_root = bytes(trusted_root)
+        if verify is None:
+            from lighthouse_tpu import bls
+
+            verify = lambda sets: bls.verify_signature_sets(  # noqa: E731
+                sets, backend=backend, consumer="light_client"
+            )
+        self.verify = verify
+        self.finalized_header = None
+        self.optimistic_header = None
+        self.current_sync_committee = None
+        self.next_sync_committee = None
+        self.current_period = None
+
+    # ------------------------------------------------------------ helpers
+
+    def _period_at_slot(self, slot: int) -> int:
+        spec = self.spec
+        return (
+            spec.slot_to_epoch(int(slot))
+            // spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        )
+
+    def _check_branch(self, leaf, branch, gindex, root, what: str):
+        ok = verify_gindex_branch(leaf, branch, gindex, root)
+        _PROOFS.labels("ok" if ok else "fail").inc()
+        if not ok:
+            raise LightClientError(f"invalid {what} branch")
+
+    def _committee_root(self, committee) -> bytes:
+        return self.t.SyncCommittee.hash_tree_root(committee)
+
+    def _verify_aggregate(self, update, committee) -> int:
+        """Participation count after verifying the sync aggregate over
+        the attested header's root; raises on a bad signature."""
+        from lighthouse_tpu import bls
+
+        agg = update.sync_aggregate
+        bits = list(agg.sync_committee_bits)
+        participation = sum(1 for b in bits if b)
+        if participation == 0:
+            raise LightClientError("empty sync aggregate")
+        spec = self.spec
+        prev_slot = max(int(update.signature_slot), 1) - 1
+        domain = compute_domain(
+            spec.DOMAIN_SYNC_COMMITTEE,
+            spec.fork_version_at_epoch(spec.slot_to_epoch(prev_slot)),
+            self.gvr,
+        )
+        signing_root = compute_signing_root(
+            _header_root(self.t, update.attested_header), domain
+        )
+        pubkeys = [
+            bls.PublicKey.from_bytes(bytes(pk))
+            for pk, bit in zip(committee.pubkeys, bits)
+            if bit
+        ]
+        sset = bls.SignatureSet(
+            bls.Signature.from_bytes(
+                bytes(agg.sync_committee_signature)
+            ),
+            pubkeys,
+            signing_root,
+        )
+        if not self.verify([sset]):
+            raise LightClientError("sync aggregate does not verify")
+        return participation
+
+    # ----------------------------------------------------------- protocol
+
+    def process_bootstrap(self, bootstrap):
+        t = self.t
+        root = _header_root(t, bootstrap.header)
+        if root != self.trusted_root:
+            _UPDATES.labels("rejected").inc()
+            raise LightClientError(
+                "bootstrap header does not match the trusted root"
+            )
+        self._check_branch(
+            self._committee_root(bootstrap.current_sync_committee),
+            list(bootstrap.current_sync_committee_branch),
+            t.CURRENT_SYNC_COMMITTEE_GINDEX,
+            bytes(bootstrap.header.beacon.state_root),
+            "current sync committee",
+        )
+        self.finalized_header = bootstrap.header
+        self.optimistic_header = bootstrap.header
+        self.current_sync_committee = bootstrap.current_sync_committee
+        self.next_sync_committee = None
+        self.current_period = self._period_at_slot(
+            bootstrap.header.beacon.slot
+        )
+        _UPDATES.labels("applied").inc()
+
+    def _committee_for_signature(self, signature_slot: int):
+        # the committee current at the SIGNING block's slot (a period-
+        # boundary block's aggregate is already signed by the rotated
+        # committee — its state rotated before the block was signed)
+        sig_period = self._period_at_slot(int(signature_slot))
+        if sig_period == self.current_period:
+            return self.current_sync_committee
+        if (
+            sig_period == self.current_period + 1
+            and self.next_sync_committee is not None
+        ):
+            return self.next_sync_committee
+        raise LightClientError(
+            f"no known committee for signature period {sig_period} "
+            f"(store period {self.current_period})"
+        )
+
+    def process_update(self, update):
+        """Full LightClientUpdate: aggregate + finality branch + next-
+        committee branch; applies finality on supermajority and rotates
+        committees across period boundaries."""
+        if self.current_period is None:
+            raise LightClientError("store not bootstrapped")
+        t = self.t
+        try:
+            committee = self._committee_for_signature(
+                update.signature_slot
+            )
+            participation = self._verify_aggregate(update, committee)
+            attested_root = bytes(
+                update.attested_header.beacon.state_root
+            )
+            attested_period = self._period_at_slot(
+                update.attested_header.beacon.slot
+            )
+            # next-committee branch (period advancement material)
+            self._check_branch(
+                self._committee_root(update.next_sync_committee),
+                list(update.next_sync_committee_branch),
+                t.NEXT_SYNC_COMMITTEE_GINDEX,
+                attested_root,
+                "next sync committee",
+            )
+            has_finality = int(update.finalized_header.beacon.slot) > 0
+            if has_finality:
+                self._check_branch(
+                    _header_root(t, update.finalized_header),
+                    list(update.finality_branch),
+                    t.FINALIZED_ROOT_GINDEX,
+                    attested_root,
+                    "finality",
+                )
+        except LightClientError:
+            _UPDATES.labels("rejected").inc()
+            raise
+        supermajority = 3 * participation >= 2 * len(
+            list(update.sync_aggregate.sync_committee_bits)
+        )
+        # committee adoption is SUPERMAJORITY-gated (the spec's
+        # apply_light_client_update condition): without it, one
+        # colluding committee member could sign a fabricated attested
+        # header whose state commits to an attacker-chosen next
+        # committee and poison the store's rotation
+        if (
+            supermajority
+            and attested_period == self.current_period
+            and self.next_sync_committee is None
+        ):
+            self.next_sync_committee = update.next_sync_committee
+        if has_finality and supermajority:
+            self._apply_finalized(update.finalized_header)
+        self._apply_optimistic(update.attested_header)
+        _UPDATES.labels("applied").inc()
+        return participation
+
+    def process_finality_update(self, update):
+        """LightClientFinalityUpdate (no committee material)."""
+        if self.current_period is None:
+            raise LightClientError("store not bootstrapped")
+        t = self.t
+        try:
+            committee = self._committee_for_signature(
+                update.signature_slot
+            )
+            participation = self._verify_aggregate(update, committee)
+            self._check_branch(
+                _header_root(t, update.finalized_header),
+                list(update.finality_branch),
+                t.FINALIZED_ROOT_GINDEX,
+                bytes(update.attested_header.beacon.state_root),
+                "finality",
+            )
+        except LightClientError:
+            _UPDATES.labels("rejected").inc()
+            raise
+        if 3 * participation >= 2 * len(
+            list(update.sync_aggregate.sync_committee_bits)
+        ):
+            self._apply_finalized(update.finalized_header)
+        self._apply_optimistic(update.attested_header)
+        _UPDATES.labels("applied").inc()
+        return participation
+
+    def process_optimistic_update(self, update):
+        if self.current_period is None:
+            raise LightClientError("store not bootstrapped")
+        try:
+            committee = self._committee_for_signature(
+                update.signature_slot
+            )
+            self._verify_aggregate(update, committee)
+        except LightClientError:
+            _UPDATES.labels("rejected").inc()
+            raise
+        self._apply_optimistic(update.attested_header)
+        _UPDATES.labels("applied").inc()
+
+    # ------------------------------------------------------------- apply
+
+    def _apply_finalized(self, header):
+        if self.finalized_header is not None and int(
+            header.beacon.slot
+        ) <= int(self.finalized_header.beacon.slot):
+            return
+        new_period = self._period_at_slot(header.beacon.slot)
+        while new_period > self.current_period:
+            if self.next_sync_committee is None:
+                raise LightClientError(
+                    "finalized header crossed a period boundary with "
+                    "no next committee known"
+                )
+            self.current_sync_committee = self.next_sync_committee
+            self.next_sync_committee = None
+            self.current_period += 1
+        self.finalized_header = header
+
+    def _apply_optimistic(self, header):
+        if self.optimistic_header is None or int(
+            header.beacon.slot
+        ) > int(self.optimistic_header.beacon.slot):
+            self.optimistic_header = header
+
+    # ------------------------------------------------------------ summary
+
+    def summary(self) -> dict:
+        t = self.t
+
+        def doc(header):
+            if header is None:
+                return None
+            return {
+                "slot": int(header.beacon.slot),
+                "root": "0x" + _header_root(t, header).hex(),
+            }
+
+        return {
+            "finalized": doc(self.finalized_header),
+            "optimistic": doc(self.optimistic_header),
+            "period": self.current_period,
+            "has_next_committee": self.next_sync_committee is not None,
+        }

@@ -9,6 +9,7 @@ analog (multiple nodes, one process, real message flow).
 """
 
 from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.common.slot_clock import ManualSlotClock
 from lighthouse_tpu.network.beacon_processor import BeaconProcessor
 from lighthouse_tpu.network.gossip import (
@@ -30,6 +31,12 @@ from lighthouse_tpu.types.helpers import compute_fork_digest
 # sentinel for a payload the forward gate tried and FAILED to decode:
 # delivery must still score the sender, but never re-decode the junk
 GATE_UNDECODABLE = object()
+
+_LC_GOSSIP = REGISTRY.counter_vec(
+    "lighthouse_tpu_lc_gossip_total",
+    "light-client update gossip frames, by topic and direction",
+    ("topic", "direction"),
+)
 
 
 class BeaconNode:
@@ -143,6 +150,11 @@ class BeaconNode:
         )
         self.hub = hub
         self.subnets = None
+        # light-client gossip: publish fresh finality/optimistic update
+        # documents after the import that bettered them (generation-
+        # diffed against the chain's producer)
+        self._lc_published = {"finality": 0, "optimistic": 0}
+        self.chain.import_hooks.append(self._publish_lc_updates)
         if hub is not None:
             hub.join(node_id, self._deliver)
             for name in self._gossip_topics():
@@ -159,6 +171,10 @@ class BeaconNode:
             "beacon_aggregate_and_proof",
             "voluntary_exit",
             "attester_slashing",
+            # altair light-client p2p topics: full nodes forward them so
+            # light clients anywhere in the mesh hear finality moves
+            "light_client_finality_update",
+            "light_client_optimistic_update",
         ) + tuple(
             blob_sidecar_topic_name(i)
             for i in range(self.spec.BLOB_SIDECAR_SUBNET_COUNT)
@@ -322,6 +338,24 @@ class BeaconNode:
         elif name == "attester_slashing":
             sl = self.chain.t.AttesterSlashing.decode(data)
             self.processor.submit("gossip_slashing", (sl, from_peer))
+        elif name in (
+            "light_client_finality_update",
+            "light_client_optimistic_update",
+        ):
+            # full nodes derive their own updates from imports; gossip
+            # reception is decoded (undecodable spam costs the sender
+            # the invalid-message score) and counted, never imported
+            cls = (
+                self.chain.t.LightClientFinalityUpdate
+                if name == "light_client_finality_update"
+                else self.chain.t.LightClientOptimisticUpdate
+            )
+            try:
+                cls.decode(data)
+            except (ValueError, IndexError):
+                self.hub.report(from_peer, SCORE_INVALID_MESSAGE)
+                return
+            _LC_GOSSIP.labels(name, "recv").inc()
 
     def publish_block(self, signed_block):
         if self.hub is None:
@@ -376,6 +410,43 @@ class BeaconNode:
             topic(self.fork_digest, "beacon_aggregate_and_proof"),
             encode_gossip(sap.to_bytes()),
         )
+
+    def _publish_lc_updates(self, _block_root=None):
+        """Import/head-change hook: gossip the producer's finality and
+        optimistic updates whenever their generation advanced since the
+        last publish (light_client_finality_update/optimistic_update
+        topics, the altair light-client p2p plane)."""
+        if self.hub is None:
+            return
+        prod = getattr(self.chain, "light_client_producer", None)
+        if prod is None:
+            return
+        if (
+            prod.finality_seq > self._lc_published["finality"]
+            and prod.finality_update is not None
+        ):
+            self._lc_published["finality"] = prod.finality_seq
+            self.hub.publish(
+                self.node_id,
+                topic(self.fork_digest, "light_client_finality_update"),
+                encode_gossip(prod.finality_update.to_bytes()),
+            )
+            _LC_GOSSIP.labels("light_client_finality_update", "sent").inc()
+        if (
+            prod.optimistic_seq > self._lc_published["optimistic"]
+            and prod.optimistic_update is not None
+        ):
+            self._lc_published["optimistic"] = prod.optimistic_seq
+            self.hub.publish(
+                self.node_id,
+                topic(
+                    self.fork_digest, "light_client_optimistic_update"
+                ),
+                encode_gossip(prod.optimistic_update.to_bytes()),
+            )
+            _LC_GOSSIP.labels(
+                "light_client_optimistic_update", "sent"
+            ).inc()
 
     # ------------------------------------------------------------ handlers
 

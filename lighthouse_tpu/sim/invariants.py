@@ -37,6 +37,10 @@ class SimContext:
     # orchestrator BEFORE any overload fault fires — the budget the
     # post-flood recovery check holds the node to
     probe_budget: dict = field(default_factory=dict)
+    # light-client actor summary (lc_serve scenarios): DRIVING context
+    # naming what the actor believes — the lc_* checks compare it
+    # against the node's observability plane
+    lc_client: dict | None = None
     _health_cache: dict = field(default_factory=dict)
 
     # --------------------------------------------- plane accessors
@@ -605,6 +609,95 @@ def bus_no_starvation(ctx: SimContext) -> list:
     return out
 
 
+def lc_tracks_finality(ctx: SimContext) -> list:
+    """The light-client actor — bootstrapped from ONE trusted root —
+    ends the run on the serving node's own finalized head, with its
+    optimistic head within the attestation lag of the final slot. The
+    node's side of the comparison comes from the REST surface
+    (/eth/v1/beacon/blocks/finalized/root + /lighthouse/health), the
+    client's from the actor summary the orchestrator recorded."""
+    lc = ctx.lc_client
+    if lc is None:
+        return ["scenario ran no light-client actor"]
+    out = []
+    if not lc.get("bootstrapped"):
+        return ["light client never bootstrapped"]
+    name = ctx.honest_online()[0]
+    fin_epoch = ctx.health(name)["head"]["finalized_epoch"]
+    if fin_epoch < 1:
+        out.append(f"{name}: chain never finalized ({fin_epoch})")
+        return out
+    node_fin = ctx._get(
+        name, "/eth/v1/beacon/blocks/finalized/root"
+    )["data"]["root"]
+    lc_fin = (lc.get("finalized") or {}).get("root")
+    if lc_fin != node_fin:
+        out.append(
+            f"lc finalized head {lc_fin} != node finalized {node_fin}"
+        )
+    head_slot = ctx.health(name)["head"]["slot"]
+    opt_slot = (lc.get("optimistic") or {}).get("slot", -1)
+    if opt_slot < head_slot - 2:
+        out.append(
+            f"lc optimistic head slot {opt_slot} lags the node head "
+            f"{head_slot} beyond the attestation lag"
+        )
+    return out
+
+
+def lc_proofs_verify(ctx: SimContext) -> list:
+    """Every branch the client verified passed, at least one did, and
+    the serving node journaled update production — registry + journal
+    evidence only."""
+    out = []
+    ok = ctx.diff(
+        'lighthouse_tpu_lc_client_proofs_total{outcome="ok"}'
+    )
+    fail = ctx.diff(
+        'lighthouse_tpu_lc_client_proofs_total{outcome="fail"}'
+    )
+    if ok <= 0:
+        out.append("light client verified no branch at all")
+    if fail > 0:
+        out.append(f"{int(fail)} light-client branch proofs FAILED")
+    rejected = ctx.diff(
+        'lighthouse_tpu_lc_client_updates_total{outcome="rejected"}'
+    )
+    if rejected > 0:
+        out.append(
+            f"{int(rejected)} light-client updates were rejected"
+        )
+    for name in ctx.honest_online():
+        if not ctx.events(name, kind="lc_update_produced"):
+            out.append(
+                f"{name}: no lc_update_produced events journaled"
+            )
+    return out
+
+
+def lc_served_bounded(ctx: SimContext) -> list:
+    """The serving plane actually streamed light-client bytes, and the
+    total stayed within a per-request envelope (no handler ever
+    amplified a read into a state-sized response)."""
+    lc = ctx.lc_client
+    if lc is None:
+        return ["scenario ran no light-client actor"]
+    out = []
+    served = ctx.diff_family("lighthouse_tpu_lc_served_bytes_total")
+    if served <= 0:
+        out.append("no light-client bytes were served")
+    requests = max(int(lc.get("requests", 0)), 1)
+    # generous per-request envelope: an updates-by-range response is a
+    # handful of ~2 KB documents; a beacon state is megabytes
+    budget = requests * 64 * 1024
+    if served > budget:
+        out.append(
+            f"{int(served)} lc bytes served exceeds the "
+            f"{budget}-byte envelope for {requests} requests"
+        )
+    return out
+
+
 def finalized(ctx: SimContext) -> list:
     out = []
     for name in ctx.honest_online():
@@ -629,6 +722,9 @@ CHECKS = {
     "sheds_bounded": sheds_bounded,
     "overload_reported": overload_reported,
     "overload_recovery": overload_recovery,
+    "lc_tracks_finality": lc_tracks_finality,
+    "lc_proofs_verify": lc_proofs_verify,
+    "lc_served_bounded": lc_served_bounded,
 }
 
 

@@ -1,0 +1,144 @@
+"""Light-client sim actor: one trusted root in, the honest head out.
+
+The lc_serve scenario's consumer: bootstraps a `LightClientStore` from
+ONE trusted finalized root read off the serving node's REST surface,
+then tracks the chain exclusively through the light-client endpoints —
+updates by range for period advancement, the finality/optimistic
+documents every slot. Its sync-committee aggregate checks ride the
+serving node's verification bus under the ``light_client`` consumer
+label (the actor is in-process; a remote client would carry its own
+BLS plane), so the attribution/bus invariants see the new traffic
+class.
+
+Evidence discipline: the actor's protocol PROGRESS is exported through
+the registry families the store maintains
+(``lighthouse_tpu_lc_client_proofs_total`` / ``_updates_total``); its
+`summary()` is DRIVING context handed to the invariants — they compare
+it against the node's own observability plane, never against node
+internals.
+"""
+
+from lighthouse_tpu.common.logging import get_logger
+from lighthouse_tpu.http_api.client import (
+    ApiClientError,
+    BeaconNodeHttpClient,
+)
+from lighthouse_tpu.light_client.store import (
+    LightClientError,
+    LightClientStore,
+)
+from lighthouse_tpu.types.containers import types_for
+
+_LOG = get_logger("sim.lc_actor")
+
+
+class LightClientActor:
+    def __init__(self, base_url: str, spec, gvr: bytes, bus=None):
+        self.client = BeaconNodeHttpClient(base_url)
+        self.spec = spec
+        self.t = types_for(spec)
+        self.gvr = bytes(gvr)
+        self.bus = bus
+        self.store = None
+        self.requests = 0
+        self.errors = 0
+        self.trusted_root = None
+
+    # ------------------------------------------------------------ plumbing
+
+    def _verify(self, sets) -> bool:
+        if self.bus is not None:
+            return self.bus.submit(sets, consumer="light_client")
+        from lighthouse_tpu import bls
+
+        return bls.verify_signature_sets(
+            sets, consumer="light_client"
+        )
+
+    def _get(self, fn, *args):
+        self.requests += 1
+        try:
+            return fn(self.t, *args)
+        except ApiClientError as e:
+            self.errors += 1
+            _LOG.debug("lc actor request failed: %s", e)
+            return None
+
+    # ------------------------------------------------------------- driving
+
+    def _try_bootstrap(self):
+        """Bootstrap once the provider has finalized: the finalized
+        block root read off the REST surface is the ONE trusted input;
+        everything after is proven."""
+        try:
+            cps = self.client.get_finality_checkpoints("head")
+        except ApiClientError:
+            return
+        if int(cps["finalized"]["epoch"]) < 1:
+            return
+        try:
+            root = self.client.get_block_root("finalized")
+        except ApiClientError:
+            return
+        bootstrap = self._get(self.client.get_lc_bootstrap, root)
+        if bootstrap is None:
+            return
+        store = LightClientStore(
+            self.spec,
+            self.t,
+            self.gvr,
+            root,
+            verify=self._verify,
+        )
+        try:
+            store.process_bootstrap(bootstrap)
+        except LightClientError as e:
+            _LOG.warning("lc bootstrap rejected: %s", e)
+            self.errors += 1
+            return
+        self.store = store
+        self.trusted_root = root
+
+    def poll(self):
+        """One polling round: bootstrap if needed, then advance through
+        range updates + the finality/optimistic documents."""
+        if self.store is None:
+            self._try_bootstrap()
+            if self.store is None:
+                return
+        store = self.store
+        updates = self._get(
+            self.client.get_lc_updates, store.current_period, 4
+        )
+        for update in updates or ():
+            try:
+                store.process_update(update)
+            except LightClientError as e:
+                _LOG.debug("lc update rejected: %s", e)
+        fu = self._get(self.client.get_lc_finality_update)
+        if fu is not None:
+            try:
+                store.process_finality_update(fu)
+            except LightClientError as e:
+                _LOG.debug("lc finality update rejected: %s", e)
+        ou = self._get(self.client.get_lc_optimistic_update)
+        if ou is not None:
+            try:
+                store.process_optimistic_update(ou)
+            except LightClientError as e:
+                _LOG.debug("lc optimistic update rejected: %s", e)
+
+    def summary(self) -> dict:
+        doc = {
+            "bootstrapped": self.store is not None,
+            "trusted_root": (
+                "0x" + self.trusted_root.hex()
+                if self.trusted_root
+                else None
+            ),
+            "requests": self.requests,
+            "errors": self.errors,
+        }
+        if self.store is not None:
+            doc.update(self.store.summary())
+        return doc

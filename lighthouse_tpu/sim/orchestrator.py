@@ -779,6 +779,8 @@ class Simulation:
 
         if self.scenario.kind == "vc_http":
             return self._run_vc_http()
+        if self.scenario.kind == "lc_serve":
+            return self._run_lc_serve()
         snapshot_before = REGISTRY.snapshot()
         self._build()
         if any(
@@ -874,6 +876,104 @@ class Simulation:
         violations = inv.check_all(ctx, self.scenario.invariants)
         report = vd.build_report(self, ctx, violations)
         report["vc_metrics"] = dict(vc.metrics)
+        _RUNS_TOTAL.labels("violations" if violations else "ok").inc()
+        return report
+
+    # -------------------------------------------------------- lc_serve kind
+
+    def _sync_committee_sign(self, sn: SimNode, slot: int):
+        """Every distinct validator in the node's current sync
+        committee signs a SyncCommitteeMessage over the head root; the
+        verified messages aggregate through the naive pool into the
+        contribution pool, so the NEXT block's sync aggregate carries
+        full participation (the in-process stand-in for the sync-
+        committee gossip plane, mirroring _self_aggregate)."""
+        chain = sn.chain
+        state = chain.head_state
+        if not hasattr(state, "current_sync_committee"):
+            return
+        t = chain.t
+        head_root = chain.head_root
+        epoch = self.spec.slot_to_epoch(slot)
+        msgs = []
+        seen = set()
+        for pk in state.current_sync_committee.pubkeys:
+            idx = chain.pubkey_cache.index_of(bytes(pk))
+            if idx is None or idx in seen:
+                continue
+            seen.add(idx)
+            sig = self._sign(
+                self.keypairs[idx],
+                self.spec.DOMAIN_SYNC_COMMITTEE,
+                epoch,
+                head_root,
+            )
+            msgs.append(
+                t.SyncCommitteeMessage(
+                    slot=slot,
+                    beacon_block_root=head_root,
+                    validator_index=idx,
+                    signature=sig,
+                )
+            )
+        if not msgs:
+            return
+        chain.process_sync_messages(msgs)
+        for sub in range(self.spec.SYNC_COMMITTEE_SUBNET_COUNT):
+            c = chain.sync_message_pool.get_contribution(
+                slot, head_root, sub
+            )
+            if c is not None:
+                chain.sync_contribution_pool.insert(c)
+
+    def _run_lc_serve(self) -> dict:
+        """One full node serves a light-client actor that bootstraps
+        from a single trusted finalized root and tracks the honest
+        chain through the light_client endpoints alone; its aggregate
+        checks ride the node's verification bus under the
+        ``light_client`` consumer label. All claims are asserted
+        through /lighthouse/events + /lighthouse/health + registry
+        diffs, and the canonical journal replays byte-identically."""
+        from lighthouse_tpu.sim import invariants as inv
+        from lighthouse_tpu.sim import verdict as vd
+        from lighthouse_tpu.sim.lc_actor import LightClientActor
+
+        snapshot_before = REGISTRY.snapshot()
+        sn = SimNode("node0", 0)
+        self._boot_node(sn, self.genesis.copy())
+        self.nodes.append(sn)
+        actor = LightClientActor(
+            sn.base_url(),
+            self.spec,
+            self.gvr,
+            bus=sn.chain.verification_bus,
+        )
+        for slot in range(1, self.scenario.slots + 1):
+            self._slot = slot
+            _SLOTS_TOTAL.inc()
+            sn.node.on_slot(slot)
+            self._propose(sn, slot)
+            self._drain(sn)
+            self._attest(sn, slot)
+            self._drain(sn)
+            self._self_aggregate(sn, slot)
+            self._sync_committee_sign(sn, slot)
+            actor.poll()
+        # one final poll so the actor hears the last import's documents
+        actor.poll()
+        snapshot_after = REGISTRY.snapshot()
+        ctx = inv.SimContext(
+            scenario=self.scenario,
+            nodes={sn.name: sn},
+            snapshot_before=snapshot_before,
+            snapshot_after=snapshot_after,
+            blob_blocks={},
+            eclipse_windows={},
+            lc_client=actor.summary(),
+        )
+        violations = inv.check_all(ctx, self.scenario.invariants)
+        report = vd.build_report(self, ctx, violations)
+        report["lc_client"] = actor.summary()
         _RUNS_TOTAL.labels("violations" if violations else "ok").inc()
         return report
 

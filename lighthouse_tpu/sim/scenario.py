@@ -30,7 +30,7 @@ FAULT_KINDS = (
                    # bursts against that node's HTTP API, rate threads
 )
 
-SCENARIO_KINDS = ("multi_node", "vc_http")
+SCENARIO_KINDS = ("multi_node", "vc_http", "lc_serve")
 
 INVARIANT_NAMES = (
     "honest_convergence",
@@ -47,6 +47,9 @@ INVARIANT_NAMES = (
     "sheds_bounded",
     "overload_reported",
     "overload_recovery",
+    "lc_tracks_finality",
+    "lc_proofs_verify",
+    "lc_served_bounded",
 )
 
 _CONDITIONER_RATE_KEYS = {

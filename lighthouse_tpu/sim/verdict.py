@@ -37,6 +37,11 @@ CANONICAL_KINDS = (
     # whole point): the lockstep barriers make open/close counts a pure
     # function of the seeded flood volume, so they replay byte-identically
     "shed_window",
+    # light-client update production is a pure function of the import
+    # stream (period, participation, attested/finalized slots) — a
+    # protocol claim that must replay byte-identically. lc_served stays
+    # OUT: request/TTL timing attribution, not protocol behavior.
+    "lc_update_produced",
 )
 
 VOLATILE_FIELDS = ("t", "seq", "duration_s")

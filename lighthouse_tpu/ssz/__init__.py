@@ -36,6 +36,17 @@ from lighthouse_tpu.ssz.codec import (  # noqa: F401
     uint128,
     uint256,
 )
+from lighthouse_tpu.ssz.gindex import (  # noqa: F401
+    compute_merkle_proof,
+    compute_multiproof,
+    concat_gindices,
+    floorlog2,
+    gindex_for_path,
+    get_helper_indices,
+    state_field_chunks,
+    verify_gindex_branch,
+    verify_multiproof,
+)
 from lighthouse_tpu.ssz.hashing import hash32, zero_hash  # noqa: F401
 from lighthouse_tpu.ssz.merkle import (  # noqa: F401
     merkle_proof,

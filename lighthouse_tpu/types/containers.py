@@ -432,6 +432,69 @@ def types_for(spec: Spec) -> SimpleNamespace:
         },
     )
 
+    # ------------------------------------------------- light-client types
+
+    # Generalized indices computed from the SAME state descriptors the
+    # codec merkleizes with (ssz/gindex), so branch depths can never
+    # drift from hash_tree_root. On this Altair shape the classic spec
+    # constants fall out: finalized root 105 (depth 6), current/next
+    # sync committee 54/55 (depth 5) — and the Bellatrix state (25
+    # fields, same 32-chunk pad) shares them, asserted below.
+    from lighthouse_tpu.ssz.gindex import floorlog2, gindex_for_path
+
+    FINALIZED_ROOT_GINDEX = gindex_for_path(
+        BeaconStateAltair, ("finalized_checkpoint", "root")
+    )
+    CURRENT_SYNC_COMMITTEE_GINDEX = gindex_for_path(
+        BeaconStateAltair, ("current_sync_committee",)
+    )
+    NEXT_SYNC_COMMITTEE_GINDEX = gindex_for_path(
+        BeaconStateAltair, ("next_sync_committee",)
+    )
+    assert FINALIZED_ROOT_GINDEX == gindex_for_path(
+        BeaconStateBellatrix, ("finalized_checkpoint", "root")
+    ), "fork state shapes disagree on the finalized-root gindex"
+
+    class LightClientHeader(ssz.Container):
+        """Altair light-client header (capella adds execution fields —
+        the wrapper shape is kept so that extension is additive)."""
+
+        beacon: BeaconBlockHeader
+
+    class LightClientBootstrap(ssz.Container):
+        header: LightClientHeader
+        current_sync_committee: SyncCommittee
+        current_sync_committee_branch: ssz.Vector(
+            ssz.bytes32, floorlog2(CURRENT_SYNC_COMMITTEE_GINDEX)
+        )
+
+    class LightClientUpdate(ssz.Container):
+        attested_header: LightClientHeader
+        next_sync_committee: SyncCommittee
+        next_sync_committee_branch: ssz.Vector(
+            ssz.bytes32, floorlog2(NEXT_SYNC_COMMITTEE_GINDEX)
+        )
+        finalized_header: LightClientHeader
+        finality_branch: ssz.Vector(
+            ssz.bytes32, floorlog2(FINALIZED_ROOT_GINDEX)
+        )
+        sync_aggregate: SyncAggregate
+        signature_slot: Slot
+
+    class LightClientFinalityUpdate(ssz.Container):
+        attested_header: LightClientHeader
+        finalized_header: LightClientHeader
+        finality_branch: ssz.Vector(
+            ssz.bytes32, floorlog2(FINALIZED_ROOT_GINDEX)
+        )
+        sync_aggregate: SyncAggregate
+        signature_slot: Slot
+
+    class LightClientOptimisticUpdate(ssz.Container):
+        attested_header: LightClientHeader
+        sync_aggregate: SyncAggregate
+        signature_slot: Slot
+
     # ------------------------------------------------- gossip/VC envelopes
 
     class AggregateAndProof(ssz.Container):
@@ -513,6 +576,10 @@ def types_for(spec: Spec) -> SimpleNamespace:
     })
     ns.spec = spec
     ns.Blob = Blob
+    # light-client generalized-index constants (state-shape-derived)
+    ns.FINALIZED_ROOT_GINDEX = FINALIZED_ROOT_GINDEX
+    ns.CURRENT_SYNC_COMMITTEE_GINDEX = CURRENT_SYNC_COMMITTEE_GINDEX
+    ns.NEXT_SYNC_COMMITTEE_GINDEX = NEXT_SYNC_COMMITTEE_GINDEX
 
     # fork dispatch tables
     ns.block_body_classes = {

@@ -78,7 +78,9 @@ def non_subgroup_signature() -> bytes:
 def main():
     # rewrite only the runners THIS script owns — tests/vectors/external
     # holds hand-committed RFC/EIP vectors from independent sources
-    for runner in ("bls", "hash_to_curve", "serialization", "kzg"):
+    for runner in (
+        "bls", "hash_to_curve", "serialization", "kzg", "merkle_proof",
+    ):
         shutil.rmtree(os.path.join(VECTOR_ROOT, runner), ignore_errors=True)
 
     # ---- bls/sign -------------------------------------------------------
@@ -533,6 +535,123 @@ def main():
                 "x_im": hex(kzg.dev_setup(kzg_n).tau_g2[0][1]),
             },
             "challenge_dst": kzg.api.CHALLENGE_DST.decode(),
+        },
+    )
+
+    # ---- merkle_proof: committed state-proof vectors ---------------------
+    # Byte-pinned branches out of a deterministic minimal-preset Altair
+    # genesis state: (state root, gindex path, leaf, branch) for the
+    # light-client paths (finalized root / current / next sync
+    # committee) plus corrupted-sibling negatives, and a multiproof
+    # over all three gindices. The batched DEVICE fold
+    # (ops/merkle_proof) is checked byte-identical against the same
+    # files in tests/test_conformance_vectors.py — any drift in the
+    # merkleization, the gindex compiler, or the SHA-256 kernel
+    # changes bytes here and fails the runner.
+    from lighthouse_tpu.ssz import gindex as gx  # noqa: E402
+    from lighthouse_tpu.state_processing.genesis import (  # noqa: E402
+        interop_genesis_state,
+    )
+    from lighthouse_tpu.types.containers import types_for  # noqa: E402
+    from lighthouse_tpu.types.spec import minimal_spec  # noqa: E402
+
+    spec = minimal_spec(ALTAIR_FORK_EPOCH=0)
+    t = types_for(spec)
+    pubkeys = [
+        bls.Keypair(
+            bls.SecretKey.from_bytes((i + 1).to_bytes(32, "big"))
+        ).pk.to_bytes()
+        for i in range(8)
+    ]
+    state = interop_genesis_state(pubkeys, 0, spec)
+    state_cls = type(state)
+    state_root = state_cls.hash_tree_root(state)
+    paths = {
+        "finalized_root": ("finalized_checkpoint", "root"),
+        "current_sync_committee": ("current_sync_committee",),
+        "next_sync_committee": ("next_sync_committee",),
+    }
+    gindices = []
+    for name, path in paths.items():
+        leaf, branch, g = gx.compute_merkle_proof(
+            state_cls, state, path
+        )
+        gindices.append(g)
+        case = {
+            "input": {
+                "path": list(path),
+                "gindex": g,
+                "leaf": hx(leaf),
+                "branch": [hx(b) for b in branch],
+                "state_root": hx(state_root),
+            },
+            "output": True,
+        }
+        write_case("merkle_proof", "state_proof", f"valid_{name}", case)
+        # corrupted sibling: flip one byte of the top sibling
+        bad_branch = [bytes(b) for b in branch]
+        bad = bytearray(bad_branch[-1])
+        bad[0] ^= 0x5A
+        bad_branch[-1] = bytes(bad)
+        write_case(
+            "merkle_proof",
+            "state_proof",
+            f"corrupt_sibling_{name}",
+            {
+                "input": {
+                    "path": list(path),
+                    "gindex": g,
+                    "leaf": hx(leaf),
+                    "branch": [hx(b) for b in bad_branch],
+                    "state_root": hx(state_root),
+                },
+                "output": False,
+            },
+        )
+    leaves, helpers = gx.compute_multiproof(state_cls, state, gindices)
+    write_case(
+        "merkle_proof",
+        "multiproof",
+        "valid_light_client_set",
+        {
+            "input": {
+                "gindices": gindices,
+                "leaves": [hx(n) for n in leaves],
+                "helpers": [hx(n) for n in helpers],
+                "state_root": hx(state_root),
+            },
+            "output": True,
+        },
+    )
+    bad_helpers = [bytes(n) for n in helpers]
+    flipped_h = bytearray(bad_helpers[0])
+    flipped_h[31] ^= 0xA5
+    bad_helpers[0] = bytes(flipped_h)
+    write_case(
+        "merkle_proof",
+        "multiproof",
+        "corrupt_helper",
+        {
+            "input": {
+                "gindices": gindices,
+                "leaves": [hx(n) for n in leaves],
+                "helpers": [hx(n) for n in bad_helpers],
+                "state_root": hx(state_root),
+            },
+            "output": False,
+        },
+    )
+    write_case(
+        "merkle_proof",
+        "meta",
+        "gindices",
+        {
+            "state_class": "BeaconStateAltair",
+            "finalized_root_gindex": t.FINALIZED_ROOT_GINDEX,
+            "current_sync_committee_gindex": (
+                t.CURRENT_SYNC_COMMITTEE_GINDEX
+            ),
+            "next_sync_committee_gindex": t.NEXT_SYNC_COMMITTEE_GINDEX,
         },
     )
 

@@ -85,6 +85,11 @@ SWEEP = [
     # through the bus vs direct N=1 dispatch (real fixed-cost numbers
     # for the PR 12 coalescing claims land here first)
     ("pallas", 64, "busmix"),
+    # --- batched light-client Merkle-proof kernel (PR 15): first real
+    # hardware numbers for the lane-parallel SHA-256 branch fold at
+    # the 1k and 16k query shapes (depth 6, the finality branch)
+    ("xla", 1024, "lcproof"),
+    ("xla", 16384, "lcproof"),
     # --- per-sweep reference point + BASELINE configs
     ("xla", 1024),
     ("pallas", 64, "sync512"),

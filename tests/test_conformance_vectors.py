@@ -384,6 +384,101 @@ def test_kzg_meta_setup():
     assert hex(s.tau_g2[0][1]) == case["tau_g2"]["x_im"]
 
 
+def test_merkle_proof_state_vectors():
+    """merkle_proof runner, host half: committed (state root, gindex
+    path, leaf, branch) vectors verify through the gindex fold — and
+    the corrupted-sibling negatives fail. The path recompiles to the
+    committed gindex, so the gindex compiler cannot drift from the
+    committed branch shapes."""
+    from lighthouse_tpu.ssz import gindex as gx
+    from lighthouse_tpu.types.containers import types_for
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    t = types_for(minimal_spec(ALTAIR_FORK_EPOCH=0))
+    for name, case in _load("merkle_proof", "state_proof"):
+        i = case["input"]
+        assert (
+            gx.gindex_for_path(
+                t.BeaconStateAltair, tuple(i["path"])
+            )
+            == i["gindex"]
+        ), name
+        got = gx.verify_gindex_branch(
+            _unhex(i["leaf"]),
+            [_unhex(b) for b in i["branch"]],
+            i["gindex"],
+            _unhex(i["state_root"]),
+        )
+        assert got is case["output"], name
+
+
+def test_merkle_proof_device_vectors():
+    """merkle_proof runner, device half: the batched fold kernel
+    (ops/merkle_proof) recomputes every committed branch's root
+    BYTE-IDENTICAL to the host oracle — valid vectors land exactly on
+    the committed state root, corrupted-sibling vectors flip the
+    verdict."""
+    from lighthouse_tpu.ops import merkle_proof as mp
+
+    cases = _load("merkle_proof", "state_proof")
+    queries = []
+    roots = []
+    expectations = []
+    for name, case in cases:
+        i = case["input"]
+        queries.append(
+            (
+                _unhex(i["leaf"]),
+                [_unhex(b) for b in i["branch"]],
+                i["gindex"],
+            )
+        )
+        roots.append(_unhex(i["state_root"]))
+        expectations.append((name, case["output"]))
+    computed = mp.batch_merkle_roots(queries, consumer="bench")
+    assert computed == mp.fold_branches_host(queries)
+    verdicts = mp.batch_verify_branches(
+        queries, roots, consumer="bench"
+    )
+    for verdict, (name, expected) in zip(verdicts, expectations):
+        assert verdict is expected, name
+
+
+def test_merkle_multiproof_vectors():
+    """merkle_proof runner: the committed multiproof over the three
+    light-client gindices verifies; a corrupted helper fails."""
+    from lighthouse_tpu.ssz import gindex as gx
+
+    for name, case in _load("merkle_proof", "multiproof"):
+        i = case["input"]
+        got = gx.verify_multiproof(
+            [_unhex(n) for n in i["leaves"]],
+            [_unhex(n) for n in i["helpers"]],
+            i["gindices"],
+            _unhex(i["state_root"]),
+        )
+        assert got is case["output"], name
+
+
+def test_merkle_proof_meta_gindices():
+    """The committed light-client gindices match the type-derived
+    constants (a state-shape change rewrites this file loudly)."""
+    from lighthouse_tpu.types.containers import types_for
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    t = types_for(minimal_spec(ALTAIR_FORK_EPOCH=0))
+    (_, case), = _load("merkle_proof", "meta")
+    assert case["finalized_root_gindex"] == t.FINALIZED_ROOT_GINDEX
+    assert (
+        case["current_sync_committee_gindex"]
+        == t.CURRENT_SYNC_COMMITTEE_GINDEX
+    )
+    assert (
+        case["next_sync_committee_gindex"]
+        == t.NEXT_SYNC_COMMITTEE_GINDEX
+    )
+
+
 def test_zz_all_vector_files_consumed():
     """check_all_files_accessed.py analog (Makefile:105). Named zz_ so it
     runs after every handler in this module."""

@@ -1,0 +1,556 @@
+"""Light-client serving plane: gindex machinery, the batched device
+proof kernel, the update producer, SSZ streaming, admission/TTL wiring,
+the typed client surface, and the lc_serve sim acceptance scenario."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu import ssz
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.harness import Harness
+from lighthouse_tpu.types.containers import types_for
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+def _spec():
+    return minimal_spec(ALTAIR_FORK_EPOCH=0)
+
+
+def _chain(n_validators=8, slots=0):
+    spec = _spec()
+    h = Harness(spec, n_validators, backend="fake")
+    chain = BeaconChain(h.state.copy(), spec, backend="fake")
+    for slot in range(1, slots + 1):
+        block = h.advance_slot_with_block(slot, consumer="bench")
+        chain.set_slot(slot)
+        chain.process_block(block)
+    return h, chain
+
+
+# ----------------------------------------------------------- gindex units
+
+
+def test_gindex_constants_match_spec():
+    """The type-derived light-client gindices reproduce the altair spec
+    constants on this state shape (24 fields -> 32-chunk pad)."""
+    t = types_for(_spec())
+    assert t.FINALIZED_ROOT_GINDEX == 105
+    assert t.CURRENT_SYNC_COMMITTEE_GINDEX == 54
+    assert t.NEXT_SYNC_COMMITTEE_GINDEX == 55
+    assert ssz.floorlog2(t.FINALIZED_ROOT_GINDEX) == 6
+    assert ssz.floorlog2(t.NEXT_SYNC_COMMITTEE_GINDEX) == 5
+
+
+def test_concat_gindices():
+    # root -> left child -> right child == 0b101
+    assert ssz.concat_gindices(2, 3) == 5
+    assert ssz.concat_gindices(1, 9) == 9
+    assert ssz.concat_gindices(5, 1) == 5
+
+
+def test_gindex_paths_and_branches_verify_against_state_root():
+    """Host proofs for every light-client path verify against the full
+    hash_tree_root of a real (interop genesis) state; a flipped sibling
+    fails."""
+    h, _ = _chain()
+    state = h.state
+    cls = type(state)
+    root = cls.hash_tree_root(state)
+    for path in (
+        ("finalized_checkpoint", "root"),
+        ("current_sync_committee",),
+        ("next_sync_committee",),
+        ("fork", "current_version"),
+        ("slot",),
+    ):
+        leaf, branch, g = ssz.compute_merkle_proof(cls, state, path)
+        assert ssz.verify_gindex_branch(leaf, branch, g, root), path
+        bad = [bytes(b) for b in branch]
+        flipped = bytearray(bad[0])
+        flipped[3] ^= 0x41
+        bad[0] = bytes(flipped)
+        assert not ssz.verify_gindex_branch(leaf, bad, g, root), path
+
+
+def test_gindex_list_and_mixin_paths():
+    """The oracle descends through length mix-ins and packed leaves:
+    proving a balances chunk and a list length against the state root."""
+    h, _ = _chain()
+    state = h.state
+    cls = type(state)
+    root = cls.hash_tree_root(state)
+    # chunk 0 of the packed balances list
+    leaf, branch, g = ssz.compute_merkle_proof(
+        cls, state, ("balances", 0)
+    )
+    assert ssz.verify_gindex_branch(leaf, branch, g, root)
+    # the balances length mix-in chunk
+    leaf, branch, g = ssz.compute_merkle_proof(
+        cls, state, ("balances", "__len__")
+    )
+    assert leaf == len(state.balances).to_bytes(32, "little")
+    assert ssz.verify_gindex_branch(leaf, branch, g, root)
+
+
+def test_multiproof_round_trip():
+    h, _ = _chain()
+    state = h.state
+    cls = type(state)
+    t = types_for(_spec())
+    root = cls.hash_tree_root(state)
+    gindices = [
+        t.FINALIZED_ROOT_GINDEX,
+        t.CURRENT_SYNC_COMMITTEE_GINDEX,
+        t.NEXT_SYNC_COMMITTEE_GINDEX,
+    ]
+    leaves, helpers = ssz.compute_multiproof(cls, state, gindices)
+    # the helper set is SMALLER than three separate branches
+    assert len(helpers) < 6 + 5 + 5
+    assert ssz.verify_multiproof(leaves, helpers, gindices, root)
+    # corrupt one NON-ZERO helper: verification must fail
+    bad = [bytes(x) for x in helpers]
+    flipped = bytearray(bad[0])
+    flipped[7] ^= 0x99
+    bad[0] = bytes(flipped)
+    assert not ssz.verify_multiproof(leaves, bad, gindices, root)
+    # wrong leaf order fails too
+    assert not ssz.verify_multiproof(
+        list(reversed(leaves)), helpers, gindices, root
+    )
+
+
+def test_state_field_chunks_uses_tree_cache():
+    """The cache-backed field chunks equal the recomputed ones."""
+    from lighthouse_tpu.ssz.cached_hash import cached_state_root
+
+    h, _ = _chain()
+    state = h.state
+    cached_state_root(state)  # attach + warm the cache
+    cached = ssz.state_field_chunks(state)
+    full = [
+        ftype.hash_tree_root(getattr(state, fname))
+        for fname, ftype in state._fields
+    ]
+    assert cached == full
+
+
+# ------------------------------------------------------- device proof plane
+
+
+def test_device_fold_matches_host_at_small_lanes():
+    """Device-vs-host agreement at sub-bucket lane counts and mixed
+    depths (padding lanes must not contaminate live results)."""
+    import hashlib
+
+    from lighthouse_tpu.ops import merkle_proof as mp
+
+    queries = []
+    for i in range(5):
+        depth = (i % 3) + 4
+        leaf = hashlib.sha256(b"lane%d" % i).digest()
+        branch = [
+            hashlib.sha256(b"lane%d-%d" % (i, d)).digest()
+            for d in range(depth)
+        ]
+        g = (1 << depth) + (i * 13 % (1 << depth))
+        queries.append((leaf, branch, g))
+    host = mp.fold_branches_host(queries)
+    dev = mp.batch_merkle_roots(queries, consumer="bench")
+    assert dev == host
+    verdicts = mp.batch_verify_branches(
+        queries, host, consumer="bench"
+    )
+    assert verdicts == [True] * len(queries)
+
+
+def test_device_extract_proofs_from_states():
+    """batch_extract_proofs gathers sibling paths host-side and the
+    device recomputes every root — equal to the states' real roots."""
+    from lighthouse_tpu.ops import merkle_proof as mp
+
+    h, _ = _chain()
+    t = types_for(_spec())
+    s1 = h.state
+    s2 = h.state.copy()
+    s2.slot = int(s2.slot) + 1
+    cls = type(s1)
+    results = mp.batch_extract_proofs(
+        cls,
+        [s1, s2],
+        [
+            (0, t.FINALIZED_ROOT_GINDEX),
+            (1, t.FINALIZED_ROOT_GINDEX),
+            (0, t.NEXT_SYNC_COMMITTEE_GINDEX),
+        ],
+        consumer="bench",
+    )
+    roots = [cls.hash_tree_root(s1), cls.hash_tree_root(s2)]
+    assert results[0][2] == roots[0]
+    assert results[1][2] == roots[1]
+    assert results[2][2] == roots[0]
+    # the two states differ (slot bumped) — so must their roots
+    assert roots[0] != roots[1]
+
+
+# ------------------------------------------------------------- producer
+
+
+def test_producer_maintains_updates_and_bootstrap(served_node):
+    _h, node, _api = served_node
+    chain = node.chain
+    prod = chain.light_client_producer
+    assert int(chain.finalized_checkpoint.epoch) >= 1
+    fu = prod.finality_update
+    assert fu is not None
+    assert int(fu.finalized_header.beacon.slot) > 0
+    ou = prod.optimistic_update
+    assert int(ou.attested_header.beacon.slot) == 32
+    # bootstrap exists for the current finalized root and its committee
+    # branch verifies against the header's state root
+    fin_root = bytes(chain.finalized_checkpoint.root)
+    bs = prod.bootstrap_for(fin_root)
+    assert bs is not None
+    t = chain.t
+    assert ssz.verify_gindex_branch(
+        t.SyncCommittee.hash_tree_root(bs.current_sync_committee),
+        list(bs.current_sync_committee_branch),
+        t.CURRENT_SYNC_COMMITTEE_GINDEX,
+        bytes(bs.header.beacon.state_root),
+    )
+    # journal carries the production record
+    assert chain.journal.count(kind="lc_update_produced") > 0
+
+
+def test_producer_best_update_selection_across_period_boundary():
+    """Updates land in per-period buckets keyed by the attested slot's
+    period, and the better-update ordering prefers finality then
+    participation."""
+    spec = minimal_spec(
+        ALTAIR_FORK_EPOCH=0, EPOCHS_PER_SYNC_COMMITTEE_PERIOD=1
+    )
+    h = Harness(spec, 8, backend="fake")
+    chain = BeaconChain(h.state.copy(), spec, backend="fake")
+    # period length = 1 epoch = 8 slots: 20 slots span periods 0..2
+    for slot in range(1, 21):
+        block = h.advance_slot_with_block(slot, consumer="bench")
+        chain.set_slot(slot)
+        chain.process_block(block)
+    prod = chain.light_client_producer
+    periods = sorted(prod.best_updates)
+    assert len(periods) >= 2
+    for period, update in prod.best_updates.items():
+        att_epoch = spec.slot_to_epoch(
+            int(update.attested_header.beacon.slot)
+        )
+        assert att_epoch // spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == (
+            period
+        )
+    # ordering unit: finality beats participation, participation breaks
+    # ties, ties keep the incumbent (is_better returns False)
+    from lighthouse_tpu.light_client.producer import (
+        LightClientUpdateProducer,
+    )
+
+    t = chain.t
+
+    def mk(participation, finalized_slot):
+        bits = [i < participation for i in range(spec.SYNC_COMMITTEE_SIZE)]
+        return t.LightClientUpdate(
+            finalized_header=t.LightClientHeader(
+                beacon=t.BeaconBlockHeader(slot=finalized_slot)
+            ),
+            sync_aggregate=t.SyncAggregate(sync_committee_bits=bits),
+        )
+
+    better = LightClientUpdateProducer._is_better
+    assert better(mk(10, 8), mk(32, 0))  # finality beats participation
+    assert better(mk(20, 8), mk(10, 8))  # more participation wins
+    assert not better(mk(10, 8), mk(10, 8))  # tie keeps incumbent
+
+
+# ------------------------------------------------------------- streaming
+
+
+def test_ssz_stream_chunk_accounting():
+    """The stream's bytes equal the monolithic encoding, chunks respect
+    the bound, Content-Length is exact, and the chunk/byte counters
+    advance by exactly the streamed amounts."""
+    from lighthouse_tpu.common.metrics import REGISTRY
+    from lighthouse_tpu.http_api.streaming import (
+        SszStream,
+        encoded_length,
+    )
+
+    h, _ = _chain(slots=2)
+    state = h.state
+    cls = type(state)
+    encoded = cls.encode(state)
+    assert encoded_length(cls, state) == len(encoded)
+    stream = SszStream.for_value(
+        cls, state, endpoint="test_stream", chunk_bytes=1024
+    )
+    fam = REGISTRY.get("lighthouse_tpu_lc_stream_chunks_total")
+    before = {k: c.value for k, c in fam.children().items()}
+    chunks = list(stream.chunks())
+    assert b"".join(chunks) == encoded
+    assert stream.length == len(encoded)
+    assert all(len(c) <= 1024 for c in chunks)
+    # all but the final chunk are full
+    assert all(len(c) == 1024 for c in chunks[:-1])
+    after = {k: c.value for k, c in fam.children().items()}
+    delta = after.get(("test_stream",), 0) - before.get(
+        ("test_stream",), 0
+    )
+    assert delta == len(chunks)
+    # streams replay: a second pass serves identical bytes
+    assert b"".join(stream.chunks()) == encoded
+
+
+def test_ssz_stream_framed_updates_round_trip():
+    from lighthouse_tpu.http_api.streaming import SszStream
+
+    t = types_for(_spec())
+    updates = [
+        t.LightClientUpdate(signature_slot=i) for i in (5, 9)
+    ]
+    stream = SszStream.framed(
+        [(t.LightClientUpdate, u) for u in updates],
+        endpoint="test_framed",
+    )
+    raw = stream.to_bytes()
+    assert len(raw) == stream.length
+    pos = 0
+    decoded = []
+    while pos < len(raw):
+        n = int.from_bytes(raw[pos : pos + 8], "little")
+        pos += 8
+        decoded.append(t.LightClientUpdate.decode(raw[pos : pos + n]))
+        pos += n
+    assert [int(u.signature_slot) for u in decoded] == [5, 9]
+
+
+# ------------------------------------------------- serving + client wiring
+
+
+@pytest.fixture(scope="module")
+def served_node():
+    from lighthouse_tpu.node import BeaconNode
+
+    spec = _spec()
+    h = Harness(spec, 8, backend="fake")
+    node = BeaconNode("lc_t1", h.state, spec, backend="fake")
+    for slot in range(1, 34):
+        block = h.advance_slot_with_block(slot, consumer="bench")
+        node.on_slot(slot)
+        node.chain.process_block(block)
+    api = node.start_http_api()
+    yield h, node, api
+    api.stop()
+
+
+def test_lc_endpoints_classify_cheap_and_cache(served_node):
+    """Light-client reads ride the cheap_read admission class and the
+    per-import-invalidated TTL cache: a repeated hot read is served
+    from cache, and an import hook invalidates it."""
+    from lighthouse_tpu.http_api.admission import classify
+
+    h, node, api = served_node
+    path = "/eth/v1/beacon/light_client/finality_update"
+    assert classify("GET", path) == "cheap_read"
+    cache = api._hot_caches["light_client"]
+    cache.invalidate()
+    hits0, misses0 = cache.hits, cache.misses
+    base = f"http://127.0.0.1:{api.port}"
+    for _ in range(3):
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            doc = json.loads(r.read())
+    assert "data" in doc
+    assert cache.misses == misses0 + 1
+    assert cache.hits >= hits0 + 2
+    # the chain's import hook wipes the cache
+    api._invalidate_hot_caches()
+    assert cache.stats()["entries"] == 0
+    # journal recorded every serve (hits included)
+    assert node.chain.journal.count(kind="lc_served") >= 3
+
+
+def test_lc_ssz_and_json_renderings_do_not_share_cache(served_node):
+    h, node, api = served_node
+    base = f"http://127.0.0.1:{api.port}"
+    path = "/eth/v1/beacon/light_client/optimistic_update"
+    t = node.chain.t
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        doc = json.loads(r.read())
+    req = urllib.request.Request(
+        base + path, headers={"Accept": "application/octet-stream"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.headers.get("Content-Type") == (
+            "application/octet-stream"
+        )
+        raw = r.read()
+        assert int(r.headers["Content-Length"]) == len(raw)
+    update = t.LightClientOptimisticUpdate.decode(raw)
+    assert str(int(update.signature_slot)) == (
+        doc["data"]["signature_slot"]
+    )
+
+
+def test_typed_client_round_trip(served_node):
+    from lighthouse_tpu.http_api.client import BeaconNodeHttpClient
+
+    h, node, api = served_node
+    t = node.chain.t
+    client = BeaconNodeHttpClient(f"http://127.0.0.1:{api.port}")
+    root = client.get_block_root("finalized")
+    bs = client.get_lc_bootstrap(t, root)
+    assert (
+        t.BeaconBlockHeader.hash_tree_root(bs.header.beacon) == root
+    )
+    updates = client.get_lc_updates(t, 0, 4)
+    assert updates, "no best updates served"
+    fu = client.get_lc_finality_update(t)
+    ou = client.get_lc_optimistic_update(t)
+    assert int(fu.finalized_header.beacon.slot) > 0
+    assert int(ou.signature_slot) >= int(fu.signature_slot) - 1
+    # the full client-side protocol over the typed surface
+    from lighthouse_tpu.light_client import LightClientStore
+
+    store = LightClientStore(
+        node.spec,
+        t,
+        bytes(h.state.genesis_validators_root),
+        root,
+        backend="fake",
+    )
+    store.process_bootstrap(bs)
+    for u in updates:
+        store.process_update(u)
+    store.process_finality_update(fu)
+    store.process_optimistic_update(ou)
+    summary = store.summary()
+    assert summary["finalized"]["slot"] > 0
+    assert summary["optimistic"]["slot"] >= summary["finalized"]["slot"]
+
+
+def test_debug_state_streams_ssz(served_node):
+    """The debug state endpoint streams: Content-Length is exact and
+    the bytes decode to the full state."""
+    h, node, api = served_node
+    base = f"http://127.0.0.1:{api.port}"
+    req = urllib.request.Request(
+        base + "/eth/v2/debug/beacon/states/head",
+        headers={"Accept": "application/octet-stream"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        raw = r.read()
+        assert int(r.headers["Content-Length"]) == len(raw)
+    state = type(node.chain.head_state).decode(raw)
+    assert int(state.slot) == int(node.chain.head_state.slot)
+
+
+def test_store_gates_committee_adoption_on_supermajority(served_node):
+    """A minority-participation update (one colluding signer) must NOT
+    plant a next sync committee; a supermajority update must."""
+    from lighthouse_tpu.http_api.client import BeaconNodeHttpClient
+    from lighthouse_tpu.light_client import LightClientStore
+
+    h, node, api = served_node
+    t = node.chain.t
+    client = BeaconNodeHttpClient(f"http://127.0.0.1:{api.port}")
+    root = client.get_block_root("finalized")
+    update = client.get_lc_updates(t, 0, 1)[0]
+    minority = update.copy()
+    bits = list(minority.sync_aggregate.sync_committee_bits)
+    minority.sync_aggregate = t.SyncAggregate(
+        sync_committee_bits=[i == 0 for i in range(len(bits))],
+        sync_committee_signature=bytes(
+            minority.sync_aggregate.sync_committee_signature
+        ),
+    )
+
+    def fresh_store():
+        store = LightClientStore(
+            node.spec,
+            t,
+            bytes(h.state.genesis_validators_root),
+            root,
+            backend="fake",  # signature always passes: isolates the gate
+        )
+        store.process_bootstrap(client.get_lc_bootstrap(t, root))
+        return store
+
+    store = fresh_store()
+    store.process_update(minority)
+    assert store.next_sync_committee is None
+    store.process_update(update)
+    assert store.next_sync_committee is not None
+
+
+def test_store_rejects_tampered_documents(served_node):
+    from lighthouse_tpu.http_api.client import BeaconNodeHttpClient
+    from lighthouse_tpu.light_client import (
+        LightClientError,
+        LightClientStore,
+    )
+
+    h, node, api = served_node
+    t = node.chain.t
+    client = BeaconNodeHttpClient(f"http://127.0.0.1:{api.port}")
+    root = client.get_block_root("finalized")
+    bs = client.get_lc_bootstrap(t, root)
+    store = LightClientStore(
+        node.spec,
+        t,
+        bytes(h.state.genesis_validators_root),
+        root,
+        backend="fake",
+    )
+    # wrong trusted root
+    with pytest.raises(LightClientError):
+        LightClientStore(
+            node.spec,
+            t,
+            bytes(h.state.genesis_validators_root),
+            b"\x42" * 32,
+            backend="fake",
+        ).process_bootstrap(bs)
+    store.process_bootstrap(bs)
+    fu = client.get_lc_finality_update(t)
+    # corrupt the finality branch: the proof check must fire
+    bad = fu.copy()
+    branch = [bytes(b) for b in bad.finality_branch]
+    flipped = bytearray(branch[0])
+    flipped[0] ^= 0xFF
+    branch[0] = bytes(flipped)
+    bad.finality_branch = branch
+    with pytest.raises(LightClientError):
+        store.process_finality_update(bad)
+
+
+# ------------------------------------------------------------ sim scenario
+
+
+def test_lc_serve_scenario_acceptance_and_replay():
+    """The committed lc_serve scenario passes its invariants — the
+    actor reaches the honest finalized head from one trusted root
+    through served updates alone — and two runs with one seed produce
+    byte-identical canonical journals."""
+    from lighthouse_tpu.sim import Simulation, scenario as scenario_mod
+
+    sc = scenario_mod.find_scenario("lc_serve")
+    reports = []
+    for _ in range(2):
+        sim = Simulation(sc)
+        try:
+            reports.append(sim.run())
+        finally:
+            sim.close()
+    for report in reports:
+        assert report["ok"], report["violations"]
+        assert report["lc_client"]["bootstrapped"]
+    assert reports[0]["journals"] == reports[1]["journals"]
+    # the actor crossed a sync-committee period boundary in-protocol
+    assert reports[0]["lc_client"]["period"] >= 1
