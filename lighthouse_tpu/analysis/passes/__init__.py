@@ -15,6 +15,9 @@ from lighthouse_tpu.analysis.passes.device_purity import DevicePurityPass
 from lighthouse_tpu.analysis.passes.exception_hygiene import (
     ExceptionHygienePass,
 )
+from lighthouse_tpu.analysis.passes.guarded_dispatch import (
+    GuardedDispatchPass,
+)
 from lighthouse_tpu.analysis.passes.handler_hygiene import (
     HandlerHygienePass,
 )
@@ -31,6 +34,7 @@ PASS_CLASSES = (
     MetricNamesPass,
     ConsumerLabelPass,
     BusSubmitPass,
+    GuardedDispatchPass,
 )
 
 

@@ -1,0 +1,89 @@
+"""Guarded-dispatch pass: raw device entry points stay behind the
+guarded executor.
+
+The device-plane fault domain (device_plane/executor.py) only holds if
+EVERY host<->device boundary crossing goes through `GUARD.dispatch` —
+one unguarded call site is a dispatch that can hang the caller forever
+(no watchdog), lie undetected (no canary), and keep hitting a wedged
+device the breaker already gave up on. So the rule is mechanical: the
+raw jitted dispatchers (the tpu-backend entry points the guard wraps)
+may only be CALLED from the modules that implement the guarded
+boundary — the bls/kzg api+backend layers and the device_plane package
+itself. Anywhere else, a call of one of these names is a finding; that
+code must reach the device through the guarded api entry points
+(bls.verify_signature_sets*, kzg.verify_blob_kzg_proof_batch, ...)
+whose tpu branches carry the guard.
+
+Tests and benches stay exempt (the framework only walks the package),
+as does ops/ — its kernels are the layer BELOW the dispatchers, and
+its own public batch entry points (ops/merkle_proof) carry their own
+guard.
+"""
+
+import ast
+
+from lighthouse_tpu.analysis.core import LintPass
+
+# the raw device dispatchers the guarded executor wraps — calling one
+# of these outside the guarded boundary bypasses watchdog, canary, and
+# breaker at once
+RAW_DISPATCHERS = {
+    "verify_signature_sets_tpu",
+    "verify_signature_set_batches_tpu",
+    "verify_signature_sets_tpu_individual",
+    "verify_blob_kzg_proof_batch_tpu",
+    "g1_msm_tpu",
+    "g1_msm_fixed_base_tpu",
+}
+
+# package-relative posix paths that implement the guarded boundary:
+# the ONLY modules allowed to call a raw dispatcher
+ALLOWED_MODULES = {
+    "bls/api.py",
+    "bls/tpu_backend.py",
+    "kzg/api.py",
+    "kzg/tpu_backend.py",
+    "device_plane/executor.py",
+    "device_plane/canary.py",
+}
+
+
+class GuardedDispatchPass(LintPass):
+    name = "guarded-dispatch"
+    description = (
+        "raw device dispatchers (verify_*_tpu, g1_msm*) are only "
+        "called from the guarded-boundary modules (bls/kzg api + "
+        "backend, device_plane) — everywhere else must go through "
+        "the guarded entry points"
+    )
+
+    def run(self, modules):
+        findings = []
+        for m in modules:
+            if m.rel in ALLOWED_MODULES:
+                continue
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = self._dispatcher_name(node.func)
+                if name is None:
+                    continue
+                findings.append(
+                    self.finding(
+                        m,
+                        node,
+                        f"raw device dispatcher '{name}' called "
+                        "outside the guarded boundary — route through "
+                        "the guarded api entry point so the dispatch "
+                        "gets watchdog, canary, and breaker coverage",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _dispatcher_name(func):
+        if isinstance(func, ast.Name):
+            return func.id if func.id in RAW_DISPATCHERS else None
+        if isinstance(func, ast.Attribute):
+            return func.attr if func.attr in RAW_DISPATCHERS else None
+        return None

@@ -404,6 +404,7 @@ def verify_signature_sets_shared(
     submissions,
     backend: str | None = None,
     seed: int | None = None,
+    extra_sets=None,
 ) -> tuple:
     """ONE dispatch spanning several consumers' set batches — the
     verification bus's boundary. `submissions` is a list of
@@ -413,6 +414,13 @@ def verify_signature_sets_shared(
     contributor's own sets, and the batch economics (participation,
     proportional device seconds/waste, the SHARED amortized fixed
     cost) distribute via `device_attribution.begin_shared_window`.
+
+    `extra_sets` are ATTRIBUTION-FREE riders — the device-plane canary
+    sentinels the bus splices into guarded batches. They join the
+    device dispatch but appear in NEITHER side of the
+    attribution_complete equality (no `note_sets`, no contribs entry,
+    no journal n_sets), and a batch that is empty apart from riders is
+    still empty (no canary-only dispatches).
 
     Returns `(ok, record)` where `record` is the batch-economics dict
     (lanes/waste/amortized_fixed_ms when the tpu marshal ran) or None.
@@ -430,6 +438,8 @@ def verify_signature_sets_shared(
         flat.extend(sets)
     if not flat:
         return False, None
+    if extra_sets:
+        flat = flat + list(extra_sets)
     backend = backend or _DEFAULT_BACKEND
     # the largest contributor labels the raw backend call; the shared
     # window redistributes the actual accounting over every contributor

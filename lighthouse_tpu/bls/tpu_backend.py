@@ -36,6 +36,7 @@ from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
 from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
+from lighthouse_tpu.device_plane import GUARD, host_device_scope
 from lighthouse_tpu.ops import batch_verify, curve, fieldb as fb, fp2
 
 # jit-compilation observability: "wrapper" events track the python-side
@@ -606,13 +607,35 @@ def verify_signature_sets_tpu(
         )
     t_marshal = time.perf_counter()
 
-    with span(
-        "verify/device",
-        s_bucket=m.s_bucket,
-        grouped=bool(m.grouped),
-        indexed=m.table is not None,
-    ):
-        result = bool(np.asarray(_dispatch(m, rand_bits)))
+    def device_attempt(plan):
+        with span(
+            "verify/device",
+            s_bucket=m.s_bucket,
+            grouped=bool(m.grouped),
+            indexed=m.table is not None,
+        ):
+            return bool(
+                plan.verdict(bool(np.asarray(_dispatch(m, rand_bits))))
+            )
+
+    def xla_host_tier():
+        # same compiled graph, pinned to the host CPU device
+        with host_device_scope(), span(
+            "verify/device", s_bucket=m.s_bucket, failover="xla-host"
+        ):
+            return bool(np.asarray(_dispatch(m, rand_bits)))
+
+    def ref_tier():
+        from lighthouse_tpu.bls.api import _verify_one_ref
+
+        return all(_verify_one_ref(s) for s in sets)
+
+    result = GUARD.dispatch(
+        "bls",
+        _shape_key(m),
+        device_attempt,
+        fallbacks=[("xla-host", xla_host_tier), ("ref", ref_tier)],
+    )
     t_end = time.perf_counter()
     attribution.note_batch(
         consumer,
@@ -699,38 +722,71 @@ def verify_signature_set_batches_tpu(
     Returns one bool per batch (empty batches are False, matching
     verify_signature_sets)."""
     t_wall0 = time.perf_counter()
-    results = [None] * len(batches)
-    pending = None  # (batch_index, unforced device verdict)
-    host_ms = 0.0
-    n_dispatched = 0
-    for bi, sets in enumerate(batches):
-        sets = list(sets)
-        if not sets or any(
-            s.signature.is_infinity() or not s.signature.in_subgroup()
-            for s in sets
-        ):
-            results[bi] = False
-            continue
-        t0 = time.perf_counter()
-        m = _marshal(sets)
-        rand_bits = curve.scalars_to_bits(
-            _rlc_scalars(m.s_bucket, None if seed is None else seed + bi),
-            batch_verify.RAND_BITS,
-        )
-        host_ms += time.perf_counter() - t0
-        ok = _dispatch(m, rand_bits)
-        # per-batch economics; duration omitted — the double-buffered
-        # overlap makes per-batch device time unmeasurable (the whole
-        # call's wall is observed once below)
-        attribution.note_batch(
-            consumer, "bls", lanes=m.s_bucket, live=len(sets)
-        )
-        n_dispatched += 1
+    batches = [list(b) for b in batches]
+    stream = {"host_ms": 0.0, "n_dispatched": 0}
+
+    def stream_attempt(plan):
+        """The whole double-buffered pipeline is ONE guarded crossing:
+        per-force watchdogs would serialize exactly the overlap the
+        stream exists for, so the guard wraps the stream and the
+        failover re-verifies every batch on the host."""
+        results = [None] * len(batches)
+        pending = None  # (batch_index, unforced device verdict)
+        stream["host_ms"] = 0.0
+        stream["n_dispatched"] = 0
+        for bi, sets in enumerate(batches):
+            if not sets or any(
+                s.signature.is_infinity()
+                or not s.signature.in_subgroup()
+                for s in sets
+            ):
+                results[bi] = False
+                continue
+            t0 = time.perf_counter()
+            m = _marshal(sets)
+            rand_bits = curve.scalars_to_bits(
+                _rlc_scalars(
+                    m.s_bucket, None if seed is None else seed + bi
+                ),
+                batch_verify.RAND_BITS,
+            )
+            stream["host_ms"] += time.perf_counter() - t0
+            ok = _dispatch(m, rand_bits)
+            # per-batch economics; duration omitted — the
+            # double-buffered overlap makes per-batch device time
+            # unmeasurable (the whole call's wall is observed once
+            # below)
+            attribution.note_batch(
+                consumer, "bls", lanes=m.s_bucket, live=len(sets)
+            )
+            stream["n_dispatched"] += 1
+            if pending is not None:
+                results[pending[0]] = bool(
+                    plan.verdict(bool(np.asarray(pending[1])))
+                )
+            pending = (bi, ok)
         if pending is not None:
-            results[pending[0]] = bool(np.asarray(pending[1]))
-        pending = (bi, ok)
-    if pending is not None:
-        results[pending[0]] = bool(np.asarray(pending[1]))
+            results[pending[0]] = bool(
+                plan.verdict(bool(np.asarray(pending[1])))
+            )
+        return results
+
+    def ref_tier():
+        from lighthouse_tpu.bls.api import _verify_one_ref
+
+        return [
+            bool(b) and all(_verify_one_ref(s) for s in b)
+            for b in batches
+        ]
+
+    results = GUARD.dispatch(
+        "bls",
+        "stream",
+        stream_attempt,
+        fallbacks=[("ref", ref_tier)],
+    )
+    host_ms = stream["host_ms"]
+    n_dispatched = stream["n_dispatched"]
     wall_ms = (time.perf_counter() - t_wall0) * 1e3
     if n_dispatched:
         attribution.observe_seconds(consumer, "bls", wall_ms / 1e3)
@@ -804,7 +860,8 @@ def verify_signature_sets_tpu_individual(
     plain_fn, indexed_fn = _get_individual_fns()
     CALL_COUNTS["individual"] += 1
     shape = _shape_key(m)
-    with span("verify/device", s_bucket=m.s_bucket, individual=True):
+
+    def run_device():
         t0 = time.perf_counter()
         if m.table is not None:
             tx, ty = m.table.rows()
@@ -823,10 +880,37 @@ def verify_signature_sets_tpu_individual(
                 "verify_individual", plain_fn, shape,
                 time.perf_counter() - t0,
             )
-        ok = np.asarray(ok)
+        return np.asarray(ok)
+
+    def device_attempt(plan):
+        with span(
+            "verify/device", s_bucket=m.s_bucket, individual=True
+        ):
+            return list(
+                plan.verdict([bool(v) for v in run_device()[: len(live)]])
+            )
+
+    def xla_host_tier():
+        with host_device_scope(), span(
+            "verify/device", s_bucket=m.s_bucket, individual=True,
+            failover="xla-host",
+        ):
+            return [bool(v) for v in run_device()[: len(live)]]
+
+    def ref_tier():
+        from lighthouse_tpu.bls.api import _verify_one_ref
+
+        return [_verify_one_ref(sets[i]) for i in live]
+
+    ok_live = GUARD.dispatch(
+        "bls",
+        shape,
+        device_attempt,
+        fallbacks=[("xla-host", xla_host_tier), ("ref", ref_tier)],
+    )
     t_end = time.perf_counter()
     for j, i in enumerate(live):
-        verdicts[i] = bool(ok[j])
+        verdicts[i] = bool(ok_live[j])
     attribution.note_batch(
         consumer,
         "bls",

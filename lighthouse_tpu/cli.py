@@ -135,6 +135,31 @@ def _apply_bus_flags(chain, args) -> None:
         bus.class_budgets.update(parse_bus_deadlines(deadlines))
 
 
+def _apply_breaker_flags(chain, args) -> None:
+    """Device-plane fault-domain knobs: circuit-breaker tuning, canary
+    mode, and the optional boot-time known-answer self-test — applied
+    to the process-global guarded executor (one accelerator, one
+    breaker), mirrored live at /lighthouse/health under
+    `device_plane`."""
+    from lighthouse_tpu.device_plane import GUARD
+
+    kwargs = {}
+    threshold = getattr(args, "device_breaker_threshold", None)
+    if threshold is not None:
+        kwargs["threshold"] = int(threshold)
+    cooldown_ms = getattr(args, "device_breaker_cooldown_ms", None)
+    if cooldown_ms is not None:
+        kwargs["cooldown_s"] = float(cooldown_ms) / 1000.0
+    canary = getattr(args, "device_breaker_canary", None)
+    if canary is not None:
+        kwargs["canary"] = canary
+    selftest = getattr(args, "device_breaker_selftest", "off") == "on"
+    kwargs["selftest"] = selftest
+    GUARD.configure(**kwargs)
+    if selftest:
+        GUARD.self_test(journal=getattr(chain, "journal", None))
+
+
 def _apply_admission_flags(srv, args) -> None:
     """PR 10's hand-set admission constants become a flag: per-class
     concurrency + deadline overrides on the live controller."""
@@ -168,6 +193,7 @@ def _serve_api(chain, args, banner: str) -> int:
     _apply_store_flags(chain, args)
     _apply_journal_flags(chain, args)
     _apply_bus_flags(chain, args)
+    _apply_breaker_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
     )
@@ -304,6 +330,7 @@ def cmd_bn(args):
     _apply_store_flags(chain, args)
     _apply_journal_flags(chain, args)
     _apply_bus_flags(chain, args)
+    _apply_breaker_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
     )
@@ -795,6 +822,36 @@ def build_parser():
         help="verification bus per-class deadline budgets, "
         "'consumer=seconds,...' over the closed consumer vocabulary "
         "(gossip classes default to the slot clock's 1/3-slot window)",
+    )
+    bn.add_argument(
+        "--device-breaker-threshold",
+        type=int,
+        default=None,
+        help="device-plane circuit breaker: consecutive faults on a "
+        "(plane, shape-bucket) that open it (default 3)",
+    )
+    bn.add_argument(
+        "--device-breaker-cooldown-ms",
+        type=float,
+        default=None,
+        help="device-plane circuit breaker: milliseconds an open "
+        "breaker waits before admitting one half-open probe "
+        "(default 30000)",
+    )
+    bn.add_argument(
+        "--device-breaker-canary",
+        choices=["auto", "on", "off"],
+        default=None,
+        help="canary sentinel checks on shared device batches: auto "
+        "(tpu backend or armed fault injection — the default), on, "
+        "or off",
+    )
+    bn.add_argument(
+        "--device-breaker-selftest",
+        choices=["on", "off"],
+        default="off",
+        help="run the per-plane known-answer self-test at boot; a "
+        "failing plane starts quarantined on host tiers (default off)",
     )
     bn.set_defaults(fn=cmd_bn)
 

@@ -93,6 +93,13 @@ KINDS = frozenset(
         # a chaos run's forensic record is self-describing (invariant
         # checks learn fault windows from the journal, not internals)
         "sim_fault",
+        # device-plane fault domain (device_plane/executor): faults
+        # observed at the guarded host<->device boundary, failovers to
+        # host tiers, breaker transitions, and self-test outcomes.
+        # Deliberately NOT part of the sim's canonical replay
+        # projection: like signature_batch, its event sequence depends
+        # on batch-formation timing, not on protocol state
+        "device_fault",
     }
 )
 

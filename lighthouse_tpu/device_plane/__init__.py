@@ -1,0 +1,53 @@
+"""Device-plane fault domain: the guarded executor every host<->device
+dispatch crosses.
+
+The rest of the stack hardened every other edge — req/resp is
+adversarial-safe, both ingress edges shed gracefully, every consumer
+reaches the device through one verification bus — but a single wedged,
+erroring, or silently-corrupting device dispatch still stalled or
+mis-verified the whole node. This package is the missing fault domain,
+the "fail safe back to the host" posture of the FPGA verification-
+engine design (PAPERS.md, arxiv 2112.02229) made TPU-native:
+
+  * ``breaker``   — per-(plane, shape-bucket) closed/open/half-open
+                    circuit breaker with plane-wide quarantine;
+  * ``faults``    — deterministic seeded device-fault injection
+                    (stall / error / flip-verdict / slow-compile), a
+                    pure function of (seed, plane, bucket, ordinal)
+                    mirroring sim/conditioner's purity discipline;
+  * ``executor``  — the guarded executor: watchdog-timed dispatches
+                    abandoned to a reaper thread on timeout, failover
+                    order tpu -> xla-host -> ref, fault/failover
+                    metrics and ``device_fault`` journal events;
+  * ``canary``    — known-answer sentinel material (committed vectors,
+                    tests/vectors/sentinel/) for canary-verified bus
+                    batches and the per-plane startup self-test.
+
+Callers reach everything through the process-global ``GUARD`` (the
+device plane itself is process-global: one set of jit caches, one
+accelerator), configured by ``bn --device-breaker-*`` and surfaced in
+``/lighthouse/health``.
+"""
+
+from lighthouse_tpu.device_plane.breaker import CircuitBreaker
+from lighthouse_tpu.device_plane.executor import (
+    GUARD,
+    CanaryViolation,
+    DeviceFaultError,
+    GuardedExecutor,
+    host_device_scope,
+    pow2_bucket,
+)
+from lighthouse_tpu.device_plane.faults import INJECTOR, FaultInjector
+
+__all__ = [
+    "CircuitBreaker",
+    "GUARD",
+    "CanaryViolation",
+    "DeviceFaultError",
+    "GuardedExecutor",
+    "host_device_scope",
+    "pow2_bucket",
+    "INJECTOR",
+    "FaultInjector",
+]

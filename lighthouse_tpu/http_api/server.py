@@ -650,6 +650,12 @@ class BeaconApiServer:
         bus = getattr(self.chain, "verification_bus", None)
         if bus is not None:
             doc["verification_bus"] = bus.stats()
+        # device-plane fault domain: breaker states per (plane, bucket),
+        # fault/failover/transition counters — what an operator checks
+        # when the node silently degrades to host tiers
+        from lighthouse_tpu.device_plane import GUARD
+
+        doc["device_plane"] = GUARD.stats()
         return doc
 
     # ------------------------------------------------------------ routing

@@ -40,6 +40,11 @@ from lighthouse_tpu.crypto.constants import R
 from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
 from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
 from lighthouse_tpu.crypto.ref_pairing import multi_pairing_is_one
+from lighthouse_tpu.device_plane import (
+    GUARD,
+    host_device_scope,
+    pow2_bucket,
+)
 from lighthouse_tpu.kzg.trusted_setup import TrustedSetup, dev_setup
 
 BYTES_PER_FIELD_ELEMENT = 32
@@ -213,7 +218,31 @@ def _msm_backend(
     if backend == "tpu":
         from lighthouse_tpu.kzg.tpu_backend import g1_msm_fixed_base_tpu
 
-        return g1_msm_fixed_base_tpu(scalars, setup, consumer=consumer)
+        def device_attempt(plan):
+            # an MSM yields a point, not a verdict — flip injection is
+            # a no-op here; stall/error/timeout still fail over
+            return g1_msm_fixed_base_tpu(
+                scalars, setup, consumer=consumer
+            )
+
+        def xla_host_tier():
+            with host_device_scope():
+                return g1_msm_fixed_base_tpu(
+                    scalars, setup, consumer=consumer
+                )
+
+        def ref_tier():
+            return _g1_lincomb(setup.g1_powers[:n], scalars)
+
+        return GUARD.dispatch(
+            "msm",
+            pow2_bucket(n),
+            device_attempt,
+            fallbacks=[
+                ("xla-host", xla_host_tier),
+                ("ref", ref_tier),
+            ],
+        )
     if backend == "fake":
         # fake crypto plane: commitments/proofs are structural bytes
         # only (the fake verifier accepts everything), so the identity
@@ -457,9 +486,41 @@ def verify_blob_kzg_proof_batch(
                 verify_blob_kzg_proof_batch_tpu,
             )
 
-            result = verify_blob_kzg_proof_batch_tpu(
-                blobs, commitments, proofs, setup=setup, seed=seed,
-                consumer=consumer,
+            def device_attempt(plan):
+                return bool(
+                    plan.verdict(
+                        bool(
+                            verify_blob_kzg_proof_batch_tpu(
+                                blobs, commitments, proofs,
+                                setup=setup, seed=seed,
+                                consumer=consumer,
+                            )
+                        )
+                    )
+                )
+
+            def xla_host_tier():
+                with host_device_scope():
+                    return bool(
+                        verify_blob_kzg_proof_batch_tpu(
+                            blobs, commitments, proofs, setup=setup,
+                            seed=seed, consumer=consumer,
+                        )
+                    )
+
+            def ref_tier():
+                return _verify_batch_ref(
+                    blobs, commitments, proofs, setup, seed
+                )
+
+            result = GUARD.dispatch(
+                "kzg",
+                pow2_bucket(len(blobs)),
+                device_attempt,
+                fallbacks=[
+                    ("xla-host", xla_host_tier),
+                    ("ref", ref_tier),
+                ],
             )
         else:
             raise KzgError(f"unknown KZG backend {backend!r}")

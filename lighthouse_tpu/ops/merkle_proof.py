@@ -31,6 +31,7 @@ import time
 import numpy as np
 
 from lighthouse_tpu.common import device_attribution as attribution
+from lighthouse_tpu.device_plane import GUARD, host_device_scope
 
 # one jitted fold kernel per branch depth; jax retraces per lane bucket
 # inside each entry (bounded by the pow2 padding)
@@ -229,8 +230,32 @@ def batch_merkle_roots(queries, consumer=None) -> list:
             for d in range(depth):
                 dirbits[i, d] = (gindex >> d) & 1
         fn = _get_jitted(depth)
+
+        # A fold yields root bytes, not a verdict — flip injection is a
+        # no-op here (like the kzg MSM); stall/error/timeout still fail
+        # over. The host tier is the committed hashlib oracle, so the
+        # byte-identical contract holds on every tier.
+        def device_attempt(plan):
+            return _chunks(np.asarray(fn(leaves, siblings, dirbits))[:n])
+
+        def xla_host_tier():
+            with host_device_scope():
+                return _chunks(
+                    np.asarray(fn(leaves, siblings, dirbits))[:n]
+                )
+
+        def ref_tier():
+            return fold_branches_host(
+                [(leaf, branch, g) for _pos, leaf, branch, g in group]
+            )
+
         t0 = time.perf_counter()
-        roots = np.asarray(fn(leaves, siblings, dirbits))
+        chunks = GUARD.dispatch(
+            "merkle_proof",
+            f"d{depth}x{bucket}",
+            device_attempt,
+            fallbacks=[("xla-host", xla_host_tier), ("ref", ref_tier)],
+        )
         wall = time.perf_counter() - t0
         attribution.note_batch(
             consumer,
@@ -239,7 +264,6 @@ def batch_merkle_roots(queries, consumer=None) -> list:
             live=n,
             duration_s=wall,
         )
-        chunks = _chunks(roots[:n])
         for (pos, _leaf, _branch, _g), root in zip(group, chunks):
             out[pos] = root
     return out

@@ -51,6 +51,7 @@ import numpy as np
 from lighthouse_tpu.common import device_attribution as attribution
 from lighthouse_tpu.common.compile_ledger import LEDGER
 from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.device_plane import GUARD
 from lighthouse_tpu.ops import batch_verify, curve, pairing, tower
 from lighthouse_tpu.ops import window_ladder as wl
 
@@ -155,11 +156,30 @@ def _wrap_attributed(inner, fn_name: str, layout: str, consumer):
     signatures — (..., rand_bits, set_mask[, group_mask])), and lands a
     compile-ledger entry classified cold/warm from the jit trace
     cache. The wrapper does NOT force the device value — callers keep
-    the async-dispatch contract."""
+    the async-dispatch contract.
+
+    Guard coverage here is NARROWER than the other device entry points
+    by design: the dispatch is async (the returned value is unforced),
+    so flip injection cannot be applied without forcing, and the
+    sharded program's inputs are pre-encoded per-mesh field bundles
+    with no host oracle at this boundary — there is no fallback tier.
+    What the guard still buys: stall/error injection, breaker
+    accounting, and fail-fast DeviceFaultError when the `sharded`
+    plane's breaker is open, instead of a hang. The watchdog is opted
+    OUT per-dispatch: the synchronous portion here is dominated by the
+    mesh graphs' legitimate multi-minute cold compiles (the repo's
+    largest), and the device result is an unforced async value — a
+    timeout would abandon healthy compiles while measuring a wall that
+    cannot wedge."""
     def dispatch(*args):
         set_mask = np.asarray(args[5])
         t0 = time.perf_counter()
-        out = inner(*args)
+        out = GUARD.dispatch(
+            "sharded",
+            f"lanes{set_mask.size}",
+            lambda plan: inner(*args),
+            watchdog=False,
+        )
         dt = time.perf_counter() - t0
         LEDGER.note_dispatch(
             fn_name, inner, (layout,), f"lanes{set_mask.size}", dt

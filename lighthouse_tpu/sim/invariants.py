@@ -698,6 +698,147 @@ def lc_served_bounded(ctx: SimContext) -> list:
     return out
 
 
+# injected device-fault kind -> the guard-taxonomy kind its journal
+# evidence must carry. flip is caught AS a canary violation (that is
+# the canary contract); slow_compile only delays, the guard absorbs it
+# without a fault event, so it needs no evidence here.
+_DEVICE_FAULT_EVIDENCE = {
+    "stall": "stall",
+    "error": "error",
+    "flip": "canary",
+}
+
+
+def _device_fault_events(ctx: SimContext) -> list:
+    out = []
+    for name in ctx.honest_online():
+        for ev in ctx.events(name, kind="device_fault"):
+            out.append((name, ev))
+    return out
+
+
+def device_faults_caught(ctx: SimContext) -> list:
+    """Every armed device-fault kind left journal evidence that the
+    guard CAUGHT it (a `device_fault` event of the expected taxonomy
+    kind on the targeted plane) and that callers were answered by host
+    failover — never left hanging on a faulted device."""
+    out = []
+    specs = [
+        f for f in ctx.scenario.faults if f.kind.startswith("device_")
+    ]
+    if not specs:
+        return ["scenario armed no device_* faults"]
+    events = _device_fault_events(ctx)
+    faults = [
+        (n, e) for n, e in events if e.get("outcome") == "fault"
+    ]
+    failovers = [
+        (n, e) for n, e in events if e.get("outcome") == "failover"
+    ]
+    for f in specs:
+        kind = f.kind[len("device_"):]
+        expected = _DEVICE_FAULT_EVIDENCE.get(kind)
+        if expected is None:
+            continue
+        hits = [
+            (n, e)
+            for n, e in faults
+            if (e.get("attrs") or {}).get("fault") == expected
+            and (e.get("attrs") or {}).get("plane") == f.plane
+        ]
+        if not hits:
+            out.append(
+                f"no journaled {expected!r} fault on plane "
+                f"{f.plane!r} — the {f.kind} injection was never caught"
+            )
+    if not failovers:
+        out.append(
+            "faults were injected but no failover was journaled — "
+            "callers' verdicts are unaccounted for"
+        )
+    if ctx.diff_family("lighthouse_tpu_device_faults_total") <= 0:
+        out.append("registry counted no device fault")
+    if ctx.diff_family("lighthouse_tpu_device_failovers_total") <= 0:
+        out.append("registry counted no device failover")
+    return out
+
+
+def device_no_wrong_verdicts(ctx: SimContext) -> list:
+    """A lying device must never reach a caller: under flip injection
+    every flipped verdict is caught by the canary pair (journaled as a
+    `canary` fault) and re-verified on host, so NO node journals a
+    non-ok signature_batch verdict anywhere in the run — honest sim
+    traffic is all-valid, so any failed batch IS a wrong verdict."""
+    out = []
+    for name in ctx.honest_online():
+        bad = [
+            ev
+            for ev in ctx.events(name, kind="signature_batch")
+            if ev.get("outcome") != "ok"
+        ]
+        if bad:
+            out.append(
+                f"{name}: {len(bad)} signature_batch verdicts were "
+                f"not ok (first: {bad[0].get('outcome')!r}) — a "
+                "flipped verdict escaped the canary"
+            )
+    if any(f.kind == "device_flip" for f in ctx.scenario.faults):
+        canary = [
+            (n, e)
+            for n, e in _device_fault_events(ctx)
+            if e.get("outcome") == "fault"
+            and (e.get("attrs") or {}).get("fault") == "canary"
+        ]
+        if not canary:
+            out.append(
+                "flip injection armed but the canary never fired"
+            )
+    return out
+
+
+def device_breaker_balanced(ctx: SimContext) -> list:
+    """The breaker cycled AND healed: at least one open and one close
+    transition journaled (exact counts are not required to match —
+    zero-cooldown half-open probes legitimately re-trip several times
+    per recovery), and every plane-wide QUARANTINE key shows closed in
+    health at run end. Shape-bucket keys MAY end open: a bucket whose
+    batch shape never recurs after its fault window has no probe
+    opportunity, and an open bucket key costs nothing but a skip to
+    failover when (if ever) that shape returns — that is the breaker's
+    keying design, not a stuck plane."""
+    out = []
+    events = _device_fault_events(ctx)
+    opens = sum(
+        1 for _n, e in events if e.get("outcome") == "breaker_open"
+    )
+    closes = sum(
+        1 for _n, e in events if e.get("outcome") == "breaker_closed"
+    )
+    if opens < 1:
+        out.append("breaker never opened under injected faults")
+    if closes < 1:
+        out.append(
+            "breaker never closed again after the fault windows"
+        )
+    for name in ctx.honest_online():
+        dp = ctx.health(name).get("overload", {}).get("device_plane")
+        if not dp:
+            out.append(f"{name}: health has no device_plane section")
+            continue
+        state = (dp.get("breaker") or {}).get("state") or {}
+        stuck = {
+            k: v
+            for k, v in state.items()
+            if k.endswith("/*") and v != "closed"
+        }
+        if stuck:
+            out.append(
+                f"{name}: plane quarantine not healed at run end: "
+                f"{stuck}"
+            )
+    return out
+
+
 def finalized(ctx: SimContext) -> list:
     out = []
     for name in ctx.honest_online():
@@ -725,6 +866,9 @@ CHECKS = {
     "lc_tracks_finality": lc_tracks_finality,
     "lc_proofs_verify": lc_proofs_verify,
     "lc_served_bounded": lc_served_bounded,
+    "device_faults_caught": device_faults_caught,
+    "device_no_wrong_verdicts": device_no_wrong_verdicts,
+    "device_breaker_balanced": device_breaker_balanced,
 }
 
 

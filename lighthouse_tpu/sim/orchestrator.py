@@ -127,6 +127,13 @@ class Simulation:
         self.eclipse_windows: dict = {}  # name -> (at, until)
         self.probe_budget: dict = {}  # name -> pre-flood probe median
         self._slot = 0
+        # the device plane is process-global (one accelerator, one
+        # breaker, one injector) — start every run from a clean slate
+        # so back-to-back sims and replay runs see identical dynamics
+        from lighthouse_tpu.device_plane import GUARD, INJECTOR
+
+        GUARD.reset()
+        INJECTOR.reset()
 
     # ------------------------------------------------------------- build
 
@@ -491,6 +498,8 @@ class Simulation:
             self._take_offline(sc.node_name(f.node), slot)
         elif f.kind == "kv_crash":
             self._kv_crash(sc.node_name(f.node), slot)
+        elif f.kind.startswith("device_"):
+            self._arm_device_fault(f, slot)
         # spam_flood / rpc_flood are windowed actions, driven per slot
 
     def _end_fault(self, f, slot: int):
@@ -514,6 +523,39 @@ class Simulation:
                 sn.node.sync.run_range_sync()
         elif f.kind == "offline":
             self._restart(sc.node_name(f.node), slot)
+        elif f.kind.startswith("device_"):
+            self._disarm_device_fault(f, slot)
+
+    def _arm_device_fault(self, f, slot: int):
+        """Window edge: arm the deterministic device-fault injector for
+        this fault's plane and tighten the guarded executor for fast,
+        replay-stable breaker dynamics — threshold 2 (two faulted
+        dispatches open it), zero cooldown (the first post-disarm
+        dispatch probes and closes), canary forced on so flipped
+        verdicts are caught on the host backend too."""
+        from lighthouse_tpu.device_plane import GUARD, INJECTOR
+
+        kind = f.kind[len("device_"):]
+        INJECTOR.arm(
+            kind, f.plane, rate=1.0, seed=self.scenario.seed
+        )
+        GUARD.configure(threshold=2, cooldown_s=0.0, canary="on")
+        self._emit_all(
+            slot, "device_fault_armed",
+            node=self.scenario.node_name(f.node),
+            fault=kind, plane=f.plane,
+        )
+
+    def _disarm_device_fault(self, f, slot: int):
+        from lighthouse_tpu.device_plane import INJECTOR
+
+        kind = f.kind[len("device_"):]
+        INJECTOR.disarm(kind=kind, plane=f.plane)
+        self._emit_all(
+            slot, "device_fault_disarmed",
+            node=self.scenario.node_name(f.node),
+            fault=kind, plane=f.plane,
+        )
 
     def _by_name(self, name: str) -> SimNode:
         return next(sn for sn in self.nodes if sn.name == name)

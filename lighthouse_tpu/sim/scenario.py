@@ -28,7 +28,19 @@ FAULT_KINDS = (
                    # rate/slot (drives the processor shed plane)
     "rest_flood",  # node: TARGET name/index — concurrent REST read
                    # bursts against that node's HTTP API, rate threads
+    # device-plane fault injection (device_plane/faults.FaultInjector,
+    # armed/disarmed on the window edges; `plane` picks the target,
+    # default "bls"). Every guarded dispatch in the window faults —
+    # injection is deterministic, so replay is byte-identical.
+    "device_stall",         # dispatches hang -> watchdog + failover
+    "device_error",         # dispatches raise -> breaker + failover
+    "device_flip",          # device LIES -> canary catches, quarantine
+    "device_slow_compile",  # injected compile delay (bounded)
 )
+
+# guarded device planes a device_* fault may target (executor dispatch
+# plane labels)
+DEVICE_PLANES = ("bls", "kzg", "merkle_proof", "msm", "sharded")
 
 SCENARIO_KINDS = ("multi_node", "vc_http", "lc_serve")
 
@@ -50,6 +62,9 @@ INVARIANT_NAMES = (
     "lc_tracks_finality",
     "lc_proofs_verify",
     "lc_served_bounded",
+    "device_faults_caught",
+    "device_no_wrong_verdicts",
+    "device_breaker_balanced",
 )
 
 _CONDITIONER_RATE_KEYS = {
@@ -71,7 +86,7 @@ _TOP_KEYS = {
 }
 
 _FAULT_KEYS = {
-    "kind", "at_slot", "until_slot", "node", "groups", "rate",
+    "kind", "at_slot", "until_slot", "node", "groups", "rate", "plane",
 }
 
 
@@ -87,6 +102,7 @@ class FaultSpec:
     node: object = None       # node index (int) or adversary name (str)
     groups: list | None = None
     rate: int = 4
+    plane: str = "bls"        # device_* faults: guarded plane to hit
 
     def active(self, slot: int) -> bool:
         if slot < self.at_slot:
@@ -249,6 +265,31 @@ def validate(doc: dict) -> Scenario:
                 )
             if fkind in ("eclipse", "offline") and until is None:
                 _err(name, f"fault #{i}: {fkind} needs 'until_slot'")
+        if fkind.startswith("device_"):
+            # the injector is deterministic and window-scoped: every
+            # guarded dispatch in the window faults, so 'rate' has no
+            # meaning here — reject it rather than let it silently
+            # test nothing (the closed-schema rule)
+            if "rate" in f:
+                _err(
+                    name,
+                    f"fault #{i}: {fkind} takes no 'rate' (injection "
+                    "is deterministic over the window)",
+                )
+            if until is None:
+                _err(name, f"fault #{i}: {fkind} needs 'until_slot'")
+            plane = f.get("plane", "bls")
+            if plane not in DEVICE_PLANES:
+                _err(
+                    name,
+                    f"fault #{i}: unknown plane {plane!r} "
+                    f"(one of {DEVICE_PLANES})",
+                )
+        elif "plane" in f:
+            _err(
+                name,
+                f"fault #{i}: 'plane' only applies to device_* faults",
+            )
         rate = f.get("rate", 4)
         if not isinstance(rate, int) or rate < 1:
             _err(name, f"fault #{i}: 'rate' must be a positive integer")
@@ -256,6 +297,7 @@ def validate(doc: dict) -> Scenario:
             FaultSpec(
                 kind=fkind, at_slot=at, until_slot=until,
                 node=node_ref, groups=f.get("groups"), rate=rate,
+                plane=f.get("plane", "bls"),
             )
         )
 

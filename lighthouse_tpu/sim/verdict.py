@@ -42,6 +42,11 @@ CANONICAL_KINDS = (
     # protocol claim that must replay byte-identically. lc_served stays
     # OUT: request/TTL timing attribution, not protocol behavior.
     "lc_update_produced",
+    # device_fault stays OUT (like signature_batch): fault/failover
+    # events attach to device BATCHES, whose formation timing varies
+    # with thread interleaving inside one lockstep step. The device
+    # invariants read the raw journal instead; the window edges
+    # (device_fault_armed/disarmed) ride the canonical sim_fault kind.
 )
 
 VOLATILE_FIELDS = ("t", "seq", "duration_s")

@@ -212,18 +212,27 @@ class VerificationBus:
         backend: str | None = None,
     ) -> bool:
         """Verify `sets` as one unit (the `verify_signature_sets`
-        contract: True iff every set verifies; empty input is False),
-        possibly coalesced with other consumers' concurrent
-        submissions. Blocks until the verdict; never drops — a
-        submission whose deadline expires while queued gets an
-        immediate small-batch flush.
+        contract: True iff every set verifies), possibly coalesced with
+        other consumers' concurrent submissions. Blocks until the
+        verdict; never drops — a submission whose deadline expires
+        while queued gets an immediate small-batch flush.
+
+        An EMPTY submission is vacuously true and returns immediately:
+        it must never occupy a coalescing slot or join a device batch
+        (it would distort live/batch stats and could hold a flush
+        decision open for zero work). Callers that need the raw
+        `verify_signature_sets` empty-is-False semantics check
+        emptiness themselves before submitting.
 
         `deadline` is a PR 10 Deadline (anything with `.remaining()`)
         or a float budget in seconds; None derives the class budget
         (slot-clock-wired for gossip classes when available)."""
         sets = list(sets)
         if not sets:
-            return False
+            # still validate the label — a typo'd consumer must fail
+            # loudly here like it would on the non-empty path
+            attribution.normalize(consumer)
+            return True
         consumer = attribution.normalize(consumer)
         _SUBMITTED.labels(consumer).inc()
         budget_s = self._budget_for(consumer, deadline)
@@ -399,9 +408,90 @@ class VerificationBus:
                 with self._lock:
                     self._completed += len(stragglers)
 
-    def _dispatch_group_inner(self, subs, backend, trigger: str):
+    def _guarded_shared_verify(self, subs, backend):
+        """The shared dispatch, routed through the device-plane guard
+        (`device_plane.GUARD`): watchdog + circuit breaker + host
+        failover (tpu -> xla-host -> ref) around the device backend,
+        deterministic fault injection on EVERY backend (the sim arms
+        faults against host backends to exercise the whole guard with
+        zero compiles), and — when the canary is active — the
+        known-answer sentinel contract: the valid sentinel rides the
+        batch as an attribution-free extra set, and the (valid,
+        invalid) pair is checked per-set BEFORE the batch verify inside
+        the same guarded attempt. Ordering matters twice over: a lying
+        verdict plane is caught before it can mis-verify the batch, and
+        the registry side of attribution_complete is still untouched
+        when the violation raises, so the host failover re-counts each
+        contributor exactly once."""
         from lighthouse_tpu import bls
+        from lighthouse_tpu.device_plane import (
+            GUARD,
+            DeviceFaultError,
+            canary,
+            host_device_scope,
+            pow2_bucket,
+        )
 
+        submissions = [(s.sets, s.consumer) for s in subs]
+        effective = backend or bls.default_backend()
+        total_live = sum(len(s.sets) for s in subs)
+        journal = next(
+            (s.journal for s in subs if s.journal is not None), None
+        )
+        slot = next((s.slot for s in subs if s.slot is not None), None)
+        canary_on = GUARD.canary_active(effective)
+        extra = [canary.bls_sentinels()[0]] if canary_on else None
+
+        def attempt(plan):
+            if canary_on:
+                canary.check_pair(effective, plan)
+            ok, record = bls.verify_signature_sets_shared(
+                submissions, backend=backend, seed=self.seed,
+                extra_sets=extra,
+            )
+            return plan.verdict(bool(ok)), record
+
+        def host_tier(tier_backend, scoped=False):
+            def run():
+                if scoped:
+                    with host_device_scope():
+                        return bls.verify_signature_sets_shared(
+                            submissions, backend=tier_backend,
+                            seed=self.seed,
+                        )
+                return bls.verify_signature_sets_shared(
+                    submissions, backend=tier_backend, seed=self.seed,
+                )
+
+            return run
+
+        if effective == "tpu":
+            fallbacks = [
+                ("xla-host", host_tier("tpu", scoped=True)),
+                ("ref", host_tier("ref")),
+            ]
+            fault_types = None  # any escape from a device dispatch
+        else:
+            fallbacks = [("ref", host_tier("ref"))]
+            # host backends cross no device boundary: only the guard's
+            # own fault taxonomy (injected faults, canary violations)
+            # fails over — data-dependent exceptions keep their
+            # caller-visible semantics
+            fault_types = (DeviceFaultError,)
+        return GUARD.dispatch(
+            "bls",
+            pow2_bucket(total_live),
+            attempt,
+            fallbacks=fallbacks,
+            journal=journal,
+            slot=slot,
+            predicted_s=self.wall_model.predict_s(
+                total_live, cold_risk=effective == "tpu"
+            ),
+            fault_types=fault_types,
+        )
+
+    def _dispatch_group_inner(self, subs, backend, trigger: str):
         with self._lock:
             self._batch_seq += 1
             batch_id = self._batch_seq
@@ -420,11 +510,7 @@ class VerificationBus:
         exc = None
         record = None
         try:
-            ok, record = bls.verify_signature_sets_shared(
-                [(s.sets, s.consumer) for s in subs],
-                backend=backend,
-                seed=self.seed,
-            )
+            ok, record = self._guarded_shared_verify(subs, backend)
         except Exception as e:
             ok = False
             exc = e
@@ -464,9 +550,8 @@ class VerificationBus:
             sub_exc = None
             sub_record = None
             try:
-                ok_i, sub_record = bls.verify_signature_sets_shared(
-                    [(s.sets, s.consumer)], backend=backend,
-                    seed=self.seed,
+                ok_i, sub_record = self._guarded_shared_verify(
+                    [s], backend
                 )
             except Exception as e:
                 ok_i = False

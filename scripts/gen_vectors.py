@@ -80,6 +80,7 @@ def main():
     # holds hand-committed RFC/EIP vectors from independent sources
     for runner in (
         "bls", "hash_to_curve", "serialization", "kzg", "merkle_proof",
+        "sentinel",
     ):
         shutil.rmtree(os.path.join(VECTOR_ROOT, runner), ignore_errors=True)
 
@@ -654,6 +655,21 @@ def main():
             "next_sync_committee_gindex": t.NEXT_SYNC_COMMITTEE_GINDEX,
         },
     )
+
+    # ---- sentinel: device-plane canary known-answer material -------------
+    # One valid + one invalid case per guarded plane (bls, kzg,
+    # merkle_proof), generated by the SAME function the runtime loads
+    # them through (device_plane/canary.build_sentinel_vectors) so the
+    # generator and the canary contract cannot drift apart. The valid
+    # bls sentinel rides every canaried shared batch; the pair is the
+    # per-dispatch lie detector and the boot self-test oracle.
+    from lighthouse_tpu.device_plane.canary import (  # noqa: E402
+        build_sentinel_vectors,
+    )
+
+    for plane, cases in sorted(build_sentinel_vectors().items()):
+        for name, obj in sorted(cases.items()):
+            write_case("sentinel", plane, name, obj)
 
     n = sum(len(fs) for _, _, fs in os.walk(VECTOR_ROOT))
     print(f"wrote {n} vector files under {VECTOR_ROOT}")

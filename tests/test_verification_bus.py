@@ -52,7 +52,8 @@ def test_passthrough_matches_direct_dispatch(sets):
     bus = VerificationBus(backend="ref", journal=j)
     assert bus.submit([sets["good"]], consumer="gossip_single") is True
     assert bus.submit([sets["bad"]], consumer="gossip_single") is False
-    assert bus.submit([], consumer="gossip_single") is False
+    # empty submission: vacuously true, never forms or joins a batch
+    assert bus.submit([], consumer="gossip_single") is True
     assert bus.submit_individual(
         [sets["good"], sets["bad"]], consumer="gossip_single"
     ) == [True, False]
@@ -71,6 +72,23 @@ def test_empty_sets_and_unknown_consumer():
     bus = VerificationBus(backend="fake")
     with pytest.raises(ValueError):
         bus.submit([object()], consumer="not-a-consumer")
+    # the label is validated even on the empty short-circuit
+    with pytest.raises(ValueError):
+        bus.submit([], consumer="not-a-consumer")
+
+
+def test_empty_submission_skips_batch_formation(sets):
+    """An n=0 submission must not occupy a coalescing slot, form a
+    batch, or touch the live/batch counters."""
+    bus = VerificationBus(backend="ref")
+    assert bus.submit([], consumer="sync_segment") is True
+    st = bus.stats()
+    assert st["submitted"] == 0
+    assert st["batches_formed"] == 0
+    assert st["pending"] == 0
+    # and a real submission afterwards is unaffected
+    assert bus.submit([sets["good"]], consumer="sync_segment") is True
+    assert bus.stats()["batches_formed"] == 1
 
 
 # ------------------------------------------------------ deadline handling
