@@ -42,6 +42,12 @@ Env knobs:
                             window assertion, streamed-bytes totals
   BENCH_CONFIG=lcproof      batched device Merkle-proof kernel at
                             BENCH_NSETS queries (byte-identical fold)
+  BENCH_CONFIG=slotpath     per-import critical-path decomposition
+                            from the slot-budget recorder over
+                            BENCH_NSETS imports: stage medians, wall
+                            p50/p99 vs the 200 ms budget, serial
+                            dispatches, fusable gap (perf_gate.py
+                            diffs this against its committed baseline)
 """
 
 import json
@@ -156,6 +162,7 @@ def _active_metric():
         "ladder": "ladder_unified_speedup",
         "serve": "serve_mixed_traffic_throughput",
         "busmix": "bus_amortization_speedup",
+        "slotpath": "slotpath_wall_p50_ms",
     }.get(cfg, "verify_signature_sets_throughput")
 
 
@@ -314,6 +321,13 @@ def _measure(jax, platform):
         from lighthouse_tpu import bench_busmix
 
         return bench_busmix.measure(jax, platform)
+    if config == "slotpath":
+        # full-import critical-path decomposition from the slot-budget
+        # recorder (fake-backend CPU proxy off hardware; perf_gate.py
+        # diffs the line against its committed baseline)
+        from lighthouse_tpu import bench_slotpath
+
+        return bench_slotpath.measure(jax, platform)
     if config == "lcserve":
         # light-client read flood against one live node (serving edge
         # on the fake backend; never a hardware headline)

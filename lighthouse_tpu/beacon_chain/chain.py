@@ -32,6 +32,8 @@ from lighthouse_tpu.beacon_chain.operation_pool import OperationPool
 from lighthouse_tpu.common.events_journal import Journal
 from lighthouse_tpu.common.logging import get_logger
 from lighthouse_tpu.common.metrics import RegistryBackedMetrics
+from lighthouse_tpu.common.slot_budget import SlotBudgetRecorder
+from lighthouse_tpu.common.slot_budget import stage as budget_stage
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.fork_choice import ForkChoice
 from lighthouse_tpu.ssz.cached_hash import (
@@ -125,6 +127,12 @@ class BeaconChain:
         self.verification_bus = VerificationBus(
             backend=backend, journal=self.journal
         )
+        # slot-budget profiler: per-import critical-path waterfalls,
+        # overlap accounting, and the serial-dispatch/fusable-gap
+        # ledger (common/slot_budget.py) — the measurement substrate
+        # the one-dispatch executor work consumes. One per chain like
+        # the journal it emits into.
+        self.slot_budget = SlotBudgetRecorder(journal=self.journal)
         if slot_clock is not None:
             # gossip-class deadlines are the slot clock's 1/3-slot
             # attestation deadline, not a hand-set constant: budget =
@@ -441,20 +449,35 @@ class BeaconChain:
         slot = int(signed_block.message.slot)
         t0 = time.perf_counter()
         head_before = self.head_root
+        # open the slot-budget record alongside the journal timing: the
+        # two share one terminal vocabulary, and the budget_complete
+        # invariant pairs their events 1:1 by (root, outcome)
+        budget_rec = self.slot_budget.begin(
+            block_root, slot, path=extra.get("path", "gossip")
+        )
         try:
             result = inner()
         except BlockError as e:
             msg = str(e)
+            outcome = self._import_outcome(msg)
+            self.slot_budget.finish(budget_rec, outcome=outcome)
             self.journal.emit(
                 "block_import",
                 root=block_root,
                 slot=slot,
-                outcome=self._import_outcome(msg),
+                outcome=outcome,
                 duration_s=time.perf_counter() - t0,
                 reason=msg,
                 **extra,
             )
             raise
+        except BaseException:
+            # non-BlockError escape: no block_import event will be
+            # emitted, so drop the record unemitted too — the 1:1
+            # pairing the budget_complete invariant asserts survives
+            self.slot_budget.discard(budget_rec)
+            raise
+        self.slot_budget.finish(budget_rec, outcome="imported")
         self.journal.emit(
             "block_import",
             root=block_root,
@@ -506,7 +529,10 @@ class BeaconChain:
         )
 
         try:
-            missing = self.da_checker.put_block(block_root, signed_block)
+            with budget_stage("kzg_settle"):
+                missing = self.da_checker.put_block(
+                    block_root, signed_block
+                )
         except DataAvailabilityError as e:
             # structurally invalid on the DA axis (e.g. more commitments
             # than MAX_BLOBS_PER_BLOCK) — a hard reject, not a hold
@@ -524,26 +550,30 @@ class BeaconChain:
         if self.fork_choice.current_slot < block.slot:
             self.fork_choice.set_slot(block.slot)
 
-        parent_state = self._snapshots.get(parent_root)
-        if parent_state is None:
-            stored = self.store.get_block(parent_root)
-            if stored is None:
-                raise BlockError("unknown parent")
-            parent_state = self.store.state_at_slot(stored.message.slot)
+        with budget_stage("structural"):
+            parent_state = self._snapshots.get(parent_root)
             if parent_state is None:
-                raise BlockError("parent state unavailable")
+                stored = self.store.get_block(parent_root)
+                if stored is None:
+                    raise BlockError("unknown parent")
+                parent_state = self.store.state_at_slot(
+                    stored.message.slot
+                )
+                if parent_state is None:
+                    raise BlockError("parent state unavailable")
 
-        # proposer observation AFTER parent resolution (the reference's
-        # gossip verification order): an unknown-parent block must stay
-        # retriable once the parent-lookup recovery fetches its parent —
-        # observing it here would make the retry a false "duplicate"
-        outcome = self.observed_block_producers.observe(
-            block.slot, block.proposer_index, block_root
-        )
-        if outcome == "equivocation":
-            raise BlockError("proposer equivocation")
-        if outcome == "duplicate":
-            raise BlockError("block already observed")
+            # proposer observation AFTER parent resolution (the
+            # reference's gossip verification order): an unknown-parent
+            # block must stay retriable once the parent-lookup recovery
+            # fetches its parent — observing it here would make the
+            # retry a false "duplicate"
+            outcome = self.observed_block_producers.observe(
+                block.slot, block.proposer_index, block_root
+            )
+            if outcome == "equivocation":
+                raise BlockError("proposer equivocation")
+            if outcome == "duplicate":
+                raise BlockError("block already observed")
 
         # pre-slot state advance (state_advance_timer.rs:89,321): if the
         # timer already advanced the head state across this slot's (or
@@ -560,11 +590,15 @@ class BeaconChain:
 
         state = self._copy_state(parent_state)
         t0 = time.perf_counter()
-        with span("import/slots", slot=int(block.slot)):
+        with span("import/slots", slot=int(block.slot)), budget_stage(
+            "slots"
+        ):
             state = process_slots(state, block.slot, spec)
         engine = _EngineAdapter(self.execution_layer)
         try:
-            with span("import/block_processing"):
+            with span("import/block_processing"), budget_stage(
+                "block_processing"
+            ):
                 per_block_processing(
                     state,
                     signed_block,
@@ -579,7 +613,7 @@ class BeaconChain:
                 )
         except BlockProcessingError as e:
             raise BlockError(str(e)) from e
-        with span("import/state_root"):
+        with span("import/state_root"), budget_stage("state_root"):
             post_root = cached_state_root(state)
         if bytes(block.state_root) != post_root:
             raise BlockError("state root mismatch")
@@ -599,7 +633,9 @@ class BeaconChain:
         # must happen before the first store mutation — a block the
         # canonical index serves while fork choice never saw it would
         # make the detected corruption worse, not better
-        with span("import/store_fork_choice"):
+        with span("import/store_fork_choice"), budget_stage(
+            "store_write"
+        ):
             justified = self._fc_checkpoint(
                 state.current_justified_checkpoint
             )
@@ -663,7 +699,7 @@ class BeaconChain:
             block, indexed_atts, spec
         )
         old_finalized = self.finalized_checkpoint.epoch
-        with span("import/head_update"):
+        with span("import/head_update"), budget_stage("head_update"):
             self.recompute_head()
         self.events.publish(
             "block",
@@ -916,7 +952,10 @@ class BeaconChain:
         # import). A still-incomplete segment is rejected rather than
         # imported unavailable — the sync manager requeues it.
         try:
-            missing = self.da_checker.put_block(block_root, signed_block)
+            with budget_stage("kzg_settle"):
+                missing = self.da_checker.put_block(
+                    block_root, signed_block
+                )
         except DataAvailabilityError as e:
             raise BlockError(str(e)) from e
         if missing:
@@ -924,57 +963,66 @@ class BeaconChain:
                 f"segment block data unavailable: missing blob "
                 f"sidecars {sorted(missing)}"
             )
-        parent_state = self._snapshots.get(parent_root)
-        if parent_state is None:
-            raise BlockError("unknown parent")
-        state = process_slots(
-            self._copy_state(parent_state), block.slot, spec
-        )
+        with budget_stage("structural"):
+            parent_state = self._snapshots.get(parent_root)
+            if parent_state is None:
+                raise BlockError("unknown parent")
+        with budget_stage("slots"):
+            state = process_slots(
+                self._copy_state(parent_state), block.slot, spec
+            )
         engine = _EngineAdapter(self.execution_layer)
         # NO_VERIFICATION skips the batch-checked signatures, but
         # deposit signatures still verify individually — keep them
         # attributed and journaled on the sync path
-        per_block_processing(
-            state,
-            signed_block,
-            spec,
-            BlockSignatureStrategy.NO_VERIFICATION,
-            self.pubkey_cache,
-            execution_engine=engine,
-            consumer="sync_segment",
-            journal=self.journal,
-            bus=self.verification_bus,
-        )
-        if bytes(block.state_root) != cached_state_root(state):
+        with budget_stage("block_processing"):
+            per_block_processing(
+                state,
+                signed_block,
+                spec,
+                BlockSignatureStrategy.NO_VERIFICATION,
+                self.pubkey_cache,
+                execution_engine=engine,
+                consumer="sync_segment",
+                journal=self.journal,
+                bus=self.verification_bus,
+            )
+        with budget_stage("state_root"):
+            post_root = cached_state_root(state)
+        if bytes(block.state_root) != post_root:
             raise BlockError("state root mismatch")
         # checkpoints resolve BEFORE the store writes (same atomicity
         # contract as the gossip path: a _fc_checkpoint abort must not
         # leave the canonical index pointing at a block fork choice
         # never saw)
-        justified = self._fc_checkpoint(
-            state.current_justified_checkpoint
-        )
-        finalized = self._fc_checkpoint(state.finalized_checkpoint)
-        self.store.put_block(block_root, signed_block)
-        for sc in self.da_checker.verified_sidecars(block_root):
-            self.store.put_blob_sidecar(block_root, sc)
-        self.store.put_hot_state(state)
-        self.store.set_canonical_block_root(block.slot, block_root)
-        if self.fork_choice.current_slot < block.slot:
-            self.fork_choice.set_slot(block.slot)
-        exec_status, exec_hash = self._execution_verdict(block, engine)
-        self.fork_choice.on_block(
-            block.slot,
-            block_root,
-            parent_root,
-            justified,
-            finalized,
-            execution_status=exec_status,
-            execution_block_hash=exec_hash,
-        )
+        with budget_stage("store_write"):
+            justified = self._fc_checkpoint(
+                state.current_justified_checkpoint
+            )
+            finalized = self._fc_checkpoint(state.finalized_checkpoint)
+            self.store.put_block(block_root, signed_block)
+            for sc in self.da_checker.verified_sidecars(block_root):
+                self.store.put_blob_sidecar(block_root, sc)
+            self.store.put_hot_state(state)
+            self.store.set_canonical_block_root(block.slot, block_root)
+            if self.fork_choice.current_slot < block.slot:
+                self.fork_choice.set_slot(block.slot)
+            exec_status, exec_hash = self._execution_verdict(
+                block, engine
+            )
+            self.fork_choice.on_block(
+                block.slot,
+                block_root,
+                parent_root,
+                justified,
+                finalized,
+                execution_status=exec_status,
+                execution_block_hash=exec_hash,
+            )
         self._cache_snapshot(block_root, state)
         self.metrics["blocks_imported"] += 1
-        self.recompute_head()
+        with budget_stage("head_update"):
+            self.recompute_head()
 
     def _execution_verdict(self, block, engine):
         """Map the engine verdict recorded during block processing onto a

@@ -160,6 +160,20 @@ def _apply_breaker_flags(chain, args) -> None:
         GUARD.self_test(journal=getattr(chain, "journal", None))
 
 
+def _apply_slot_budget_flags(chain, args) -> None:
+    """Slot-budget profiler knobs: the enable switch and the recent-
+    imports ring size behind GET /lighthouse/slot_budget."""
+    recorder = getattr(chain, "slot_budget", None)
+    if recorder is None:
+        return
+    enabled = getattr(args, "slot_budget", None)
+    ring = getattr(args, "slot_budget_ring", None)
+    recorder.configure(
+        enabled=None if enabled is None else enabled == "on",
+        ring=ring,
+    )
+
+
 def _apply_admission_flags(srv, args) -> None:
     """PR 10's hand-set admission constants become a flag: per-class
     concurrency + deadline overrides on the live controller."""
@@ -194,6 +208,7 @@ def _serve_api(chain, args, banner: str) -> int:
     _apply_journal_flags(chain, args)
     _apply_bus_flags(chain, args)
     _apply_breaker_flags(chain, args)
+    _apply_slot_budget_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
     )
@@ -331,6 +346,7 @@ def cmd_bn(args):
     _apply_journal_flags(chain, args)
     _apply_bus_flags(chain, args)
     _apply_breaker_flags(chain, args)
+    _apply_slot_budget_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
     )
@@ -845,6 +861,21 @@ def build_parser():
         help="canary sentinel checks on shared device batches: auto "
         "(tpu backend or armed fault injection — the default), on, "
         "or off",
+    )
+    bn.add_argument(
+        "--slot-budget",
+        choices=["on", "off"],
+        default=None,
+        help="slot-budget profiler: per-import critical-path recording "
+        "behind GET /lighthouse/slot_budget (default on; off skips "
+        "even the per-import begin/finish bookkeeping)",
+    )
+    bn.add_argument(
+        "--slot-budget-ring",
+        type=int,
+        default=None,
+        help="recent-import waterfalls kept for /lighthouse/slot_budget "
+        "(default 128)",
     )
     bn.add_argument(
         "--device-breaker-selftest",

@@ -100,6 +100,13 @@ KINDS = frozenset(
         # projection: like signature_batch, its event sequence depends
         # on batch-formation timing, not on protocol state
         "device_fault",
+        # slot-budget profiler (common/slot_budget): one event per
+        # import attempt carrying the critical-path stage decomposition,
+        # overlap accounting, and the serial-dispatch/fusable-gap
+        # ledger. Pure timing content — stays OUT of the canonical
+        # replay projection like signature_batch; the budget_complete
+        # sim invariant reads the raw journal instead
+        "slot_budget",
     }
 )
 

@@ -1,0 +1,152 @@
+"""Hardware-measurement staleness: make "tunnel down since …, N
+sweeps unmeasured" scrape-able.
+
+`scripts/tpu_watcher.py` holds a sweep queue (the SWEEP list: every
+hardware claim a PR staged while the TPU tunnel was down) and appends
+to `TPU_MEASUREMENTS.jsonl` — real measurements when the tunnel is up,
+typed skip entries when a sweep preflight found it down. Whether those
+queued claims have gone stale was tribal knowledge in PERF_NOTES;
+this module turns it into data:
+
+  * `status()` — sweep-queue length (parsed statically from the
+    watcher's SWEEP literal: no import, no side effects), the last
+    hardware measurement's timestamp, the age of the oldest queued
+    entry (time since hardware last answered — every queued entry is
+    re-attempted in full each sweep, so the whole queue is as old as
+    the outage), skip entries since, and the tunnel-down-since stamp.
+  * Two gauges refreshed on each `status()` call (the health endpoint
+    is the scrape path): `lighthouse_tpu_hw_sweep_queue_length` and
+    `lighthouse_tpu_hw_sweep_oldest_age_seconds`.
+
+Served as the `hardware_measurements` field of `/lighthouse/health`.
+"""
+
+import ast
+import datetime
+import json
+import os
+
+from lighthouse_tpu.common.metrics import REGISTRY
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+WATCHER_PATH = os.path.join(_REPO, "scripts", "tpu_watcher.py")
+MEASUREMENTS_PATH = os.path.join(_REPO, "TPU_MEASUREMENTS.jsonl")
+
+_QUEUE_LENGTH = REGISTRY.gauge(
+    "lighthouse_tpu_hw_sweep_queue_length",
+    "hardware-measurement sweep configs queued in scripts/tpu_watcher "
+    "(every entry re-attempted each sweep until the tunnel returns)",
+)
+_OLDEST_AGE = REGISTRY.gauge(
+    "lighthouse_tpu_hw_sweep_oldest_age_seconds",
+    "age of the oldest queued sweep entry: seconds since the last "
+    "successful hardware measurement (0 when hardware answered and "
+    "nothing is stale)",
+)
+
+# hardware platforms a measurement line counts as real hardware under
+# (the watcher's own sweep() acceptance filter)
+_HW_PLATFORMS = ("tpu", "axon")
+
+
+def sweep_queue_length(watcher_path: str | None = None) -> int:
+    """Length of the watcher's SWEEP list, read by parsing the script's
+    AST — importing the watcher would drag in its daemon machinery and
+    couple the node to a script. Returns 0 when the script is missing
+    or has no SWEEP literal (a trimmed deployment)."""
+    path = watcher_path or WATCHER_PATH
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return 0
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id == "SWEEP"
+                and isinstance(node.value, (ast.List, ast.Tuple))
+            ):
+                return len(node.value.elts)
+    return 0
+
+
+def _parse_ts(s):
+    try:
+        return datetime.datetime.fromisoformat(s)
+    except (TypeError, ValueError):
+        return None
+
+
+def _iter_measurements(path):
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        return
+
+
+def status(
+    measurements_path: str | None = None,
+    watcher_path: str | None = None,
+    now=None,
+) -> dict:
+    """The scrape-able staleness document (and gauge refresh). `now` is
+    injectable (an aware datetime) for tests."""
+    if now is None:
+        now = datetime.datetime.now(datetime.timezone.utc)
+    queue_len = sweep_queue_length(watcher_path)
+    last_hw = None
+    skips_since = 0
+    down_since = None
+    for rec in _iter_measurements(
+        measurements_path or MEASUREMENTS_PATH
+    ):
+        ts = _parse_ts(rec.get("recorded_at"))
+        if rec.get("type") == "skip" or rec.get("skipped"):
+            skips_since += 1
+            if down_since is None:
+                down_since = ts
+            continue
+        if (
+            rec.get("platform") in _HW_PLATFORMS
+            and (rec.get("value") or 0) > 0
+        ):
+            last_hw = ts
+            skips_since = 0
+            down_since = None
+    age_s = None
+    if last_hw is not None:
+        if last_hw.tzinfo is None:
+            last_hw = last_hw.replace(tzinfo=datetime.timezone.utc)
+        age_s = max(0.0, (now - last_hw).total_seconds())
+    _QUEUE_LENGTH.set(queue_len)
+    _OLDEST_AGE.set(age_s if age_s is not None else 0.0)
+    return {
+        "sweep_queue_length": queue_len,
+        "last_hardware_measurement": (
+            last_hw.isoformat(timespec="seconds")
+            if last_hw is not None
+            else None
+        ),
+        "oldest_queued_age_seconds": (
+            round(age_s, 1) if age_s is not None else None
+        ),
+        "skips_since_last_measurement": skips_since,
+        "tunnel_down_since": (
+            down_since.isoformat(timespec="seconds")
+            if down_since is not None
+            else None
+        ),
+    }

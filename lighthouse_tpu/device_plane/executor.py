@@ -41,6 +41,7 @@ import threading
 import time
 from contextlib import contextmanager
 
+from lighthouse_tpu.common import slot_budget
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.device_plane.breaker import CircuitBreaker
 from lighthouse_tpu.device_plane.faults import (
@@ -346,6 +347,12 @@ class GuardedExecutor:
         if not self.enabled or getattr(self._tls, "active", False):
             return device_fn(NULL_PLAN)
         bucket = str(bucket)
+        # slot-budget dispatch ledger: the outermost guard crossing IS
+        # one host<->device round trip of whatever import is being
+        # profiled on this thread (tree-hash folds, KZG settles — the
+        # bus's own caller-side interval suppresses this one for
+        # dispatches its flush runs on the submitting thread)
+        _budget_tok = slot_budget.open_dispatch(plane)
         self._tls.transitions = []
         try:
             with self._lock:
@@ -384,6 +391,7 @@ class GuardedExecutor:
             self._drain_transitions(journal, slot)
             return result
         finally:
+            slot_budget.close_dispatch(_budget_tok)
             self._tls.transitions = None
 
     def _run_marked(self, device_fn, plan):

@@ -1039,6 +1039,18 @@ class BeaconApiServer:
                     "completed_roots": TRACER.completed_roots,
                 },
             }
+        if parts[:2] == ["lighthouse", "slot_budget"]:
+            # per-import critical-path waterfalls + stage quantiles from
+            # the slot-budget recorder; ?limit=N bounds the waterfall list
+            q = self._query(path)
+            limit = self._int_q(q, "limit")
+            recorder = chain.slot_budget
+            return {
+                "data": {
+                    **recorder.summary(),
+                    "recent": recorder.recent(limit),
+                }
+            }
         if parts[:3] == ["lighthouse", "tpu", "stats"]:
             # lighthouse namespace analog: process + chain internals
             return {
@@ -1221,13 +1233,19 @@ class BeaconApiServer:
                 ]
             }
         if path == "/eth/v1/beacon/blocks":
-            doc = json.loads(body)
-            slot = int(doc["message"]["slot"])
-            fork = chain.spec.fork_name_at_epoch(
-                chain.spec.slot_to_epoch(slot)
-            )
-            cls = chain.t.signed_block_classes[fork]
-            block = from_json(cls, doc)
+            # decode happens on the SAME thread that imports: stash it
+            # as a slot-budget pre-stage so the import's waterfall
+            # starts at the bytes, not at the decoded object
+            from lighthouse_tpu.common import slot_budget
+
+            with slot_budget.pre_stage("decode"):
+                doc = json.loads(body)
+                slot = int(doc["message"]["slot"])
+                fork = chain.spec.fork_name_at_epoch(
+                    chain.spec.slot_to_epoch(slot)
+                )
+                cls = chain.t.signed_block_classes[fork]
+                block = from_json(cls, doc)
             chain.process_block(block)
             return {}
         if path == "/eth/v1/beacon/blinded_blocks":
@@ -1550,6 +1568,16 @@ class BeaconApiServer:
             ),
             "metrics": chain.metrics.snapshot(),
         }
+        # hardware-measurement staleness: sweep-queue depth and how long
+        # the TPU tunnel has been unanswered. Best-effort — a trimmed
+        # deployment may ship without the watcher script or ledger.
+        try:
+            from lighthouse_tpu.common import hw_staleness
+
+            doc["hardware_measurements"] = hw_staleness.status()
+        # lint: allow(except-swallow): best-effort field — health must never 500 over a missing watcher ledger
+        except Exception:
+            doc["hardware_measurements"] = None
         node = getattr(self, "node", None)
         processor = getattr(node, "processor", None)
         if processor is not None:

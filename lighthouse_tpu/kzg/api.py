@@ -34,6 +34,7 @@ from lighthouse_tpu.bls.point_serde import (
     g1_decompress,
 )
 from lighthouse_tpu.common import device_attribution as attribution
+from lighthouse_tpu.common import slot_budget
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.common.tracing import span
 from lighthouse_tpu.crypto.constants import R
@@ -472,6 +473,33 @@ def verify_blob_kzg_proof_batch(
         return True
     _BATCH_SIZE.observe(len(blobs))
     t0 = time.perf_counter()
+    # slot-budget dispatch mark for EVERY backend: the fake/ref tiers
+    # stand in for the device plane exactly as they do for attribution
+    # (note_batch below), so the import's causal round-trip structure —
+    # how many settles, and the gap to the signature fold — measures
+    # the same off hardware. On the tpu branch GUARD's own crossing is
+    # the nested open and is depth-suppressed; this interval owns it.
+    _budget_tok = slot_budget.open_dispatch("kzg", kind="kzg")
+    try:
+        result = _verify_blob_batch_inner(
+            blobs, commitments, proofs, backend, setup, seed, consumer
+        )
+    finally:
+        slot_budget.close_dispatch(_budget_tok)
+    if backend != "tpu":
+        attribution.note_batch(
+            consumer, "kzg", lanes=None, live=len(blobs),
+            duration_s=time.perf_counter() - t0,
+        )
+    _BATCHES.labels(backend, "ok" if result else "fail").inc()
+    if result:
+        _PROOFS.inc(len(blobs))
+    return result
+
+
+def _verify_blob_batch_inner(
+    blobs, commitments, proofs, backend, setup, seed, consumer
+) -> bool:
     with _VERIFY_SECONDS.labels(backend).time(), span(
         "kzg/verify_batch", n=len(blobs), backend=backend
     ):
@@ -524,12 +552,4 @@ def verify_blob_kzg_proof_batch(
             )
         else:
             raise KzgError(f"unknown KZG backend {backend!r}")
-    if backend != "tpu":
-        attribution.note_batch(
-            consumer, "kzg", lanes=None, live=len(blobs),
-            duration_s=time.perf_counter() - t0,
-        )
-    _BATCHES.labels(backend, "ok" if result else "fail").inc()
-    if result:
-        _PROOFS.inc(len(blobs))
     return result

@@ -41,6 +41,9 @@ class Notifier:
             extra["vm_hits"] = summary["hits"]
             extra["vm_misses"] = summary["misses"]
             extra["vm_missed_proposals"] = summary["missed_proposals"]
+        budget = self.budget_headline()
+        if budget:
+            extra["budget"] = budget
         top = self.consumer_throughput()
         if top:
             # who is paying the device plane right now, next to the
@@ -78,6 +81,26 @@ class Notifier:
         if mark is None or now <= mark[1]:
             return 0.0
         return round((total - mark[0]) / (now - mark[1]), 1)
+
+    def budget_headline(self) -> str | None:
+        """Slot-budget headline for the tick line: recent import wall
+        p50 against the 200 ms slot budget plus the stage with the
+        largest share of it — None until something has been imported
+        (or on chains without the recorder)."""
+        recorder = getattr(self.chain, "slot_budget", None)
+        headline = getattr(recorder, "headline", None)
+        if headline is None:
+            return None
+        head = headline()
+        if head is None:
+            return None
+        wall_p50_ms, top_stage, top_share = head
+        from lighthouse_tpu.common.slot_budget import SLOT_BUDGET_MS
+
+        return (
+            f"p50 {wall_p50_ms:g}ms/{SLOT_BUDGET_MS:g}ms "
+            f"top={top_stage}:{int(round(top_share * 100))}%"
+        )
 
     def consumer_throughput(self, top: int = 3) -> list:
         """[(consumer, sets/sec)] for the top-`top` device-plane

@@ -559,6 +559,130 @@ def attribution_complete(ctx: SimContext) -> list:
     return out
 
 
+def budget_complete(ctx: SimContext) -> list:
+    """The slot-budget profiler's accounting closes: every
+    `block_import` journal event has exactly one `slot_budget` partner
+    with the same (root, outcome) — both directions, archives plus live
+    events per node LIFE like `attribution_complete` — and every
+    slot_budget event's arithmetic is self-consistent: stage union plus
+    unattributed time equals wall (the recorder's defining identity),
+    overlap and unattributed are non-negative, the fusable gap fits in
+    the wall, the dispatch-label ledger matches the serial-dispatch
+    count, and the per-stage durations sum to sum_stages. Then the
+    registry must agree with the journals EXACTLY: the fusable-gap
+    histogram counted one observation per slot_budget event, the
+    serial-dispatch histogram's sum equals the journals' summed
+    serial_dispatches, and the stage family counted one observation per
+    journaled stage. Scenarios using this invariant must end with their
+    nodes ONLINE (attribution_complete's archive caveat)."""
+    out = []
+    n_events = 0
+    serial_total = 0
+    stage_obs_total = 0
+    # rounded-to-6dp fields: identity slack is rounding, not tolerance
+    eps = 1e-3
+    for name, sn in sorted(ctx.nodes.items()):
+        docs = []
+        for archive in getattr(sn, "journal_archives", ()):
+            docs.extend(archive)
+        if sn.online:
+            dropped = ctx.health(name)["journal"]["dropped"]
+            if dropped:
+                out.append(
+                    f"{name}: journal evicted {dropped} events — "
+                    "budget pairing cannot be asserted (size "
+                    "journal_capacity to the run)"
+                )
+            docs.extend(ctx.events(name, kind="block_import"))
+            docs.extend(ctx.events(name, kind="slot_budget"))
+        imports: dict = {}
+        budgets: dict = {}
+        for ev in docs:
+            key = (ev.get("root"), ev.get("outcome"))
+            if ev.get("kind") == "block_import":
+                imports[key] = imports.get(key, 0) + 1
+                continue
+            if ev.get("kind") != "slot_budget":
+                continue
+            budgets[key] = budgets.get(key, 0) + 1
+            n_events += 1
+            a = ev.get("attrs") or {}
+            wall = a.get("wall_s")
+            union = a.get("union_s")
+            unattr = a.get("unattributed_s")
+            if None in (wall, union, unattr):
+                out.append(
+                    f"{name}: slot_budget event for {key} lacks the "
+                    "accounting fields"
+                )
+                continue
+            if abs(union + unattr - wall) > eps:
+                out.append(
+                    f"{name}: {key}: union {union} + unattributed "
+                    f"{unattr} != wall {wall}"
+                )
+            if a.get("overlap_s", 0) < 0 or unattr < 0:
+                out.append(
+                    f"{name}: {key}: negative overlap/unattributed"
+                )
+            if a.get("fusable_gap_s", 0) > wall + eps:
+                out.append(
+                    f"{name}: {key}: fusable gap "
+                    f"{a.get('fusable_gap_s')} exceeds wall {wall}"
+                )
+            serial = int(a.get("serial_dispatches", 0))
+            labels = a.get("dispatch_labels") or []
+            if len(labels) != serial:
+                out.append(
+                    f"{name}: {key}: {len(labels)} dispatch labels "
+                    f"vs serial_dispatches={serial}"
+                )
+            stages = a.get("stages") or {}
+            if abs(
+                sum(stages.values()) - a.get("sum_stages_s", 0)
+            ) > eps:
+                out.append(
+                    f"{name}: {key}: stage durations do not sum to "
+                    "sum_stages_s"
+                )
+            serial_total += serial
+            stage_obs_total += int(a.get("n_stages", len(stages)))
+        for key in set(imports) | set(budgets):
+            if imports.get(key, 0) != budgets.get(key, 0):
+                out.append(
+                    f"{name}: {key}: {imports.get(key, 0)} "
+                    f"block_import events vs {budgets.get(key, 0)} "
+                    "slot_budget events — the profiler lost (or "
+                    "invented) an import"
+                )
+    if not n_events:
+        out.append(
+            "no slot_budget events journaled — the profiler went dark"
+        )
+    reg_count = ctx.diff("lighthouse_tpu_slot_fusable_gap_seconds_count")
+    if int(reg_count) != n_events:
+        out.append(
+            f"registry observed {int(reg_count)} imports but the "
+            f"journals carry {n_events} slot_budget events"
+        )
+    reg_serial = ctx.diff("lighthouse_tpu_slot_serial_dispatches_sum")
+    if int(round(reg_serial)) != serial_total:
+        out.append(
+            f"registry summed {int(round(reg_serial))} serial "
+            f"dispatches but the journals carry {serial_total}"
+        )
+    reg_stages = 0.0
+    for key in set(ctx.snapshot_after) | set(ctx.snapshot_before):
+        if key.startswith("lighthouse_tpu_slot_stage_seconds_count{"):
+            reg_stages += ctx.diff(key)
+    if int(round(reg_stages)) != stage_obs_total:
+        out.append(
+            f"registry counted {int(round(reg_stages))} stage "
+            f"observations but the journals carry {stage_obs_total}"
+        )
+    return out
+
+
 def bus_no_starvation(ctx: SimContext) -> list:
     """The verification bus never starves a submission: every node's
     bus reports submitted == completed with an empty queue at run end,
@@ -858,6 +982,7 @@ CHECKS = {
     "spam_priced": spam_priced,
     "faults_fired": faults_fired,
     "attribution_complete": attribution_complete,
+    "budget_complete": budget_complete,
     "bus_no_starvation": bus_no_starvation,
     "finalized": finalized,
     "sheds_bounded": sheds_bounded,

@@ -54,6 +54,7 @@ INVARIANT_NAMES = (
     "spam_priced",
     "faults_fired",
     "attribution_complete",
+    "budget_complete",
     "bus_no_starvation",
     "finalized",
     "sheds_bounded",

@@ -42,6 +42,10 @@ CANONICAL_KINDS = (
     # protocol claim that must replay byte-identically. lc_served stays
     # OUT: request/TTL timing attribution, not protocol behavior.
     "lc_update_produced",
+    # slot_budget stays OUT (like signature_batch): its content is
+    # per-import wall/stage/dispatch timing, which varies run to run
+    # even under lockstep — budget_complete reads the raw journal and
+    # pairs it 1:1 with the canonical block_import stream instead.
     # device_fault stays OUT (like signature_batch): fault/failover
     # events attach to device BATCHES, whose formation timing varies
     # with thread interleaving inside one lockstep step. The device

@@ -53,6 +53,7 @@ import threading
 import time
 
 from lighthouse_tpu.common import device_attribution as attribution
+from lighthouse_tpu.common import slot_budget
 from lighthouse_tpu.common.metrics import REGISTRY
 from lighthouse_tpu.verification_bus.wall_model import PredictedWallModel
 
@@ -131,7 +132,7 @@ class _Submission:
     __slots__ = (
         "sets", "consumer", "journal", "slot", "attrs", "backend",
         "budget_s", "submitted_at", "expires_at", "event", "result",
-        "exc", "done", "claimed",
+        "exc", "done", "claimed", "dispatch_t0",
     )
 
     def __init__(
@@ -151,6 +152,10 @@ class _Submission:
         self.exc = None
         self.done = False
         self.claimed = False
+        # monotonic timestamp stamped when a flush claims this
+        # submission into a dispatch group — the slot-budget recorder's
+        # queue-wait/dispatch split on the submitter side
+        self.dispatch_t0 = None
 
 
 class VerificationBus:
@@ -252,35 +257,52 @@ class VerificationBus:
         # verification to the beacon processor's hottest locks.
         # Evaluated OUTSIDE the bus lock (it takes the processor's own).
         pressure = hold_s > 0 and self._pressure()
-        with self._lock:
-            self._pending.append(sub)
-            self._submitted += 1
-            trigger = self._flush_trigger_locked(pressure)
-        if trigger:
-            self._flush(trigger)
-        while not sub.done:
-            if sub.claimed:
-                # another thread's flush took this submission; its
-                # _dispatch_group completes every claimed submission
-                # even on an escaping BaseException (finally), so this
-                # wait always terminates
-                sub.event.wait(1.0)
-                continue
-            now = time.monotonic()
-            pred = self.wall_model.predict_s(
-                len(sub.sets), cold_risk=sub.backend == "tpu"
+        # caller-side slot-budget interval: the submit-to-verdict span
+        # IS the import's causal device round trip (the flush may run
+        # on another submitter's thread — this thread still blocks for
+        # exactly that long). The queue-wait/dispatch split comes from
+        # the flush's dispatch_t0 stamp at close.
+        _budget_tok = slot_budget.open_dispatch(consumer, kind="bus")
+        try:
+            with self._lock:
+                self._pending.append(sub)
+                self._submitted += 1
+                trigger = self._flush_trigger_locked(pressure)
+            if trigger:
+                self._flush(trigger)
+            while not sub.done:
+                if sub.claimed:
+                    # another thread's flush took this submission; its
+                    # _dispatch_group completes every claimed submission
+                    # even on an escaping BaseException (finally), so
+                    # this wait always terminates
+                    sub.event.wait(1.0)
+                    continue
+                now = time.monotonic()
+                pred = self.wall_model.predict_s(
+                    len(sub.sets), cold_risk=sub.backend == "tpu"
+                )
+                wake = min(
+                    sub.submitted_at + hold_s, sub.expires_at - pred
+                )
+                timeout = wake - now
+                if timeout > 0:
+                    sub.event.wait(timeout)
+                    continue
+                reason = (
+                    "deadline" if now >= sub.expires_at - pred
+                    else "hold"
+                )
+                self._flush(reason)
+        finally:
+            slot_budget.close_dispatch(
+                _budget_tok,
+                queue_wait_s=(
+                    max(0.0, sub.dispatch_t0 - sub.submitted_at)
+                    if sub.dispatch_t0 is not None
+                    else None
+                ),
             )
-            wake = min(
-                sub.submitted_at + hold_s, sub.expires_at - pred
-            )
-            timeout = wake - now
-            if timeout > 0:
-                sub.event.wait(timeout)
-                continue
-            reason = (
-                "deadline" if now >= sub.expires_at - pred else "hold"
-            )
-            self._flush(reason)
         if sub.exc is not None:
             raise sub.exc
         return bool(sub.result)
@@ -392,6 +414,9 @@ class VerificationBus:
         dispatch (operator interrupt mid-compile, thread kill) must not
         strand the other submitters in their wait loops — the finally
         fails any straggler loudly instead."""
+        now = time.monotonic()
+        for s in subs:
+            s.dispatch_t0 = now
         try:
             self._dispatch_group_inner(subs, backend, trigger)
         finally:

@@ -30,6 +30,7 @@ Importable pieces (used by tests and bench tooling):
   parse_histograms(text)   -> {(name, labels): {"buckets", "sum", "count"}}
   bucket_quantile(buckets, count, q) -> float | None
   render_report(text, family_filter=None) -> str
+  render_slot_budget(doc, waterfalls=6) -> str   (--slot-budget mode)
   build_timelines({node: [event, ...]}) -> {root: timeline}
   timeline_population_stats(timelines) -> dict
   render_timeline_report({node: [event, ...]}) -> str
@@ -180,6 +181,117 @@ def render_report(text: str, family_filter: str | None = None) -> str:
         lines.append(
             f"{series:<{width}}  {count:>8}  {_fmt(mean):>9}  "
             f"{_fmt(p50):>9}  {_fmt(p99):>9}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------- slot-budget waterfalls
+
+
+def fetch_slot_budget(base_url: str) -> dict:
+    """The slot-budget document from a live node. Accepts the node base
+    URL, its /metrics scrape URL, or the endpoint itself."""
+    from urllib.request import urlopen
+
+    url = base_url.rstrip("/")
+    if url.endswith("/metrics"):
+        url = url[: -len("/metrics")]
+    if not url.endswith("/lighthouse/slot_budget"):
+        url += "/lighthouse/slot_budget"
+    with urlopen(url, timeout=10) as r:
+        doc = json.loads(r.read())
+    return doc.get("data", doc)
+
+
+def _bar(start_s, end_s, wall_s, width, ch="#") -> str:
+    """One proportional interval bar on a `width`-char canvas."""
+    if wall_s <= 0:
+        return " " * width
+    a = int(round(start_s / wall_s * width))
+    b = int(round(end_s / wall_s * width))
+    a = max(0, min(width - 1, a))
+    b = max(a + 1, min(width, b))
+    return " " * a + ch * (b - a) + " " * (width - b)
+
+
+def render_slot_budget(doc: dict, waterfalls: int = 6,
+                       width: int = 48) -> str:
+    """The /lighthouse/slot_budget document as text: the per-stage
+    quantile table, then proportional per-import waterfalls — stage
+    bars (#) over the import wall with the device round trips (=) and
+    the accounting line beneath each."""
+    lines = []
+    lines.append(
+        "slot budget: {n} recent imports (of {total} recorded), "
+        "budget {budget:g}ms, wall p50={p50} p99={p99}, "
+        "fusable gap p50={gap}, serial dispatches p50={sd} "
+        "max={sdmax}".format(
+            n=doc.get("imports", 0),
+            total=doc.get("recorded_total", 0),
+            budget=doc.get("budget_ms", 0.0),
+            p50=_fmt(doc.get("wall_p50_s")),
+            p99=_fmt(doc.get("wall_p99_s")),
+            gap=_fmt(doc.get("fusable_gap_p50_s")),
+            sd=doc.get("serial_dispatches_p50"),
+            sdmax=doc.get("serial_dispatches_max"),
+        )
+    )
+    stages = doc.get("stages") or {}
+    if stages:
+        name_w = max(len(n) for n in stages)
+        lines.append("")
+        lines.append(
+            f"{'stage':<{name_w}}  {'count':>6}  {'p50':>9}  {'p99':>9}"
+        )
+        for name, s in stages.items():
+            lines.append(
+                f"{name:<{name_w}}  {s['count']:>6}  "
+                f"{_fmt(s['p50_s']):>9}  {_fmt(s['p99_s']):>9}"
+            )
+    recent = (doc.get("recent") or [])[-waterfalls:]
+    for r in recent:
+        wall = r.get("wall_s") or 0.0
+        lines.append("")
+        lines.append(
+            "import {root}… slot={slot} path={path} {outcome} "
+            "wall={wall} serial={sd} gap={gap}".format(
+                root=(r.get("root") or "?")[:18],
+                slot=r.get("slot"),
+                path=r.get("path"),
+                outcome=r.get("outcome"),
+                wall=_fmt(wall),
+                sd=r.get("serial_dispatches"),
+                gap=_fmt(r.get("fusable_gap_s")),
+            )
+        )
+        rows = [
+            (name, s, e, "#")
+            for name, s, e in (r.get("stages") or [])
+        ] + [
+            (
+                f"dev:{d.get('label')}",
+                d.get("start_s", 0.0),
+                d.get("end_s", 0.0),
+                "=",
+            )
+            for d in (r.get("dispatches") or [])
+        ]
+        if rows:
+            name_w = max(len(n) for n, *_ in rows)
+            for name, s, e, ch in rows:
+                lines.append(
+                    f"  {name:<{name_w}} |{_bar(s, e, wall, width, ch)}|"
+                    f" {_fmt(max(0.0, e - s)):>9}"
+                )
+        lines.append(
+            "  accounted: stages(union)={u} overlap={o} "
+            "unattributed={ua} bus_wait={bw} device={dv}".format(
+                u=_fmt(r.get("union_s")),
+                o=_fmt(r.get("overlap_s")),
+                ua=_fmt(r.get("unattributed_s")),
+                bw=_fmt(r.get("bus_wait_s")),
+                dv=_fmt(r.get("device_s")),
+            )
         )
     return "\n".join(lines) + "\n"
 
@@ -396,6 +508,13 @@ def main(argv=None) -> int:
         "(e.g. stage_seconds, http_request)",
     )
     ap.add_argument(
+        "--slot-budget",
+        action="store_true",
+        help="render per-import critical-path waterfalls + stage "
+        "quantiles from /lighthouse/slot_budget (--url = node base "
+        "URL; --file = a saved response document)",
+    )
+    ap.add_argument(
         "--timeline",
         action="store_true",
         help="multi-node mode: merge per-node journals into per-block "
@@ -416,6 +535,18 @@ def main(argv=None) -> int:
         "(repeatable; node name taken from the file name)",
     )
     args = ap.parse_args(argv)
+    if args.slot_budget:
+        if args.url:
+            doc = fetch_slot_budget(args.url)
+        elif args.file:
+            with open(args.file) as f:
+                doc = json.load(f)
+            doc = doc.get("data", doc)
+        else:
+            doc = json.loads(sys.stdin.read())
+            doc = doc.get("data", doc)
+        sys.stdout.write(render_slot_budget(doc))
+        return 0
     if args.timeline:
         import os
 

@@ -1,0 +1,272 @@
+#!/usr/bin/env python3
+"""Perf regression gate over the slot-budget decomposition.
+
+Runs `BENCH_CONFIG=slotpath` (the full-import critical path on the
+fake-backend CPU proxy) and diffs the line against the committed
+baseline `scripts/perf_gate_baseline.json`. Two classes of check, kept
+deliberately separate:
+
+  * STRUCTURE (exact, no timing in them — these never flake): the
+    expected stage set is present, the accounting identity closed on
+    every import, the blob shape paid its >= 2 serial dispatches, and
+    the import count matches the request. A structure failure means
+    the instrument (or the import pipeline) broke, not that the
+    machine was slow.
+  * TIMING (tolerance-banded): wall p50 and each stage median must
+    stay within `1 + rel_tolerance` of the baseline, with an absolute
+    floor so sub-millisecond stages can't fail on scheduler noise.
+    CPU-proxy medians over 16 imports are stable to ~tens of percent;
+    the default band (+100%, 2 ms floor) only trips on structural
+    slowdowns (an accidental resync, a lost cache), which is the
+    gate's job — kernel-level wins/losses are measured on hardware.
+
+Baseline lifecycle:
+  perf_gate.py                      run bench, compare, exit 0/1
+  perf_gate.py --input line.json    compare an existing bench line
+  perf_gate.py --update-baseline    re-measure and rewrite the baseline
+  perf_gate.py --stamp-hardware     copy the newest hardware slotpath
+                                    line from TPU_MEASUREMENTS.jsonl
+                                    into the baseline's `hardware`
+                                    block (the watcher calls
+                                    `stamp_hardware(rec)` directly on
+                                    tunnel return)
+
+Exit codes: 0 green, 1 regression/structure failure, 2 usage or the
+bench itself failed.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO, "scripts", "perf_gate_baseline.json")
+MEASUREMENTS_PATH = os.path.join(REPO, "TPU_MEASUREMENTS.jsonl")
+
+# stages every healthy import decomposes into on the bench chain (the
+# decode stage only appears on the HTTP publish path, so it is not
+# required here)
+EXPECTED_STAGES = (
+    "structural",
+    "kzg_settle",
+    "slots",
+    "block_processing",
+    "state_root",
+    "store_write",
+    "head_update",
+)
+
+REL_TOLERANCE = 1.0   # timing may grow to (1 + this) x baseline
+ABS_FLOOR_MS = 2.0    # ... or by this many ms, whichever is larger
+
+
+def run_bench(n_imports: int = 16) -> dict:
+    """One slotpath bench line from a subprocess pinned to the CPU
+    proxy (the gate must produce the same decomposition on every
+    machine; hardware numbers arrive via --stamp-hardware instead)."""
+    env = dict(
+        os.environ,
+        BENCH_INNER="1",
+        BENCH_CONFIG="slotpath",
+        BENCH_NSETS=str(n_imports),
+        JAX_PLATFORMS="cpu",
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True,
+        timeout=600,
+        env=env,
+    )
+    lines = [
+        ln
+        for ln in r.stdout.decode(errors="replace").splitlines()
+        if ln.startswith("{")
+    ]
+    if r.returncode != 0 or not lines:
+        sys.stderr.write(r.stderr.decode(errors="replace"))
+        raise RuntimeError(f"bench failed (rc={r.returncode})")
+    return json.loads(lines[-1])
+
+
+def check_structure(line: dict) -> list:
+    """Exact assertions with no timing content — exempt from the
+    tolerance band and expected to hold on any machine."""
+    out = []
+    stages = line.get("stages_p50_ms") or {}
+    for name in EXPECTED_STAGES:
+        if name not in stages:
+            out.append(f"stage {name!r} missing from the decomposition")
+    for name in stages:
+        if name not in EXPECTED_STAGES and name != "decode":
+            out.append(f"unexpected stage {name!r} in the decomposition")
+    if not line.get("accounting_complete"):
+        out.append(
+            "accounting identity broken: union + unattributed != wall "
+            "on at least one import"
+        )
+    if (line.get("serial_dispatches_max") or 0) < 2:
+        out.append(
+            "no import paid >= 2 serial dispatches — the blob settle "
+            "round trip went missing from the dispatch ledger"
+        )
+    if (line.get("multi_dispatch_imports") or 0) < 1:
+        out.append("no multi-dispatch import in the run")
+    if (line.get("serial_dispatches_p50") or 0) < 1:
+        out.append("median import paid no device dispatch at all")
+    return out
+
+
+def check_timing(line: dict, baseline: dict,
+                 rel=REL_TOLERANCE, abs_floor_ms=ABS_FLOOR_MS) -> list:
+    """Tolerance-banded comparisons of the CPU-proxy medians."""
+    out = []
+
+    def band(name, got, base):
+        if base is None or got is None:
+            return
+        limit = max(base * (1.0 + rel), base + abs_floor_ms)
+        if got > limit:
+            out.append(
+                f"{name}: {got:.3f} ms exceeds the gate "
+                f"({base:.3f} ms baseline, limit {limit:.3f} ms)"
+            )
+
+    band("wall_p50", line.get("value"), baseline.get("value"))
+    base_stages = baseline.get("stages_p50_ms") or {}
+    for name, got in (line.get("stages_p50_ms") or {}).items():
+        band(f"stage {name}", got, base_stages.get(name))
+    band(
+        "fusable_gap_multi_dispatch_p50",
+        line.get("fusable_gap_multi_dispatch_p50_ms"),
+        baseline.get("fusable_gap_multi_dispatch_p50_ms"),
+    )
+    return out
+
+
+def latest_hardware_line(path: str = MEASUREMENTS_PATH) -> dict | None:
+    """Newest headline-eligible slotpath measurement from the watcher's
+    ledger (None when hardware has not answered for this config)."""
+    best = None
+    try:
+        with open(path) as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    rec = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                if (
+                    rec.get("metric") == "slotpath_wall_p50_ms"
+                    and rec.get("platform") in ("tpu", "axon")
+                    and (rec.get("value") or 0) > 0
+                ):
+                    best = rec
+    except OSError:
+        return None
+    return best
+
+
+def stamp_hardware(rec: dict, baseline_path: str = BASELINE_PATH) -> bool:
+    """Write a hardware slotpath line into the baseline's `hardware`
+    block (tpu_watcher calls this on tunnel return so the committed
+    gate file carries real-chip numbers next to the CPU-proxy bands).
+    Returns False when no baseline exists to stamp."""
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    baseline["hardware"] = {
+        k: rec.get(k)
+        for k in (
+            "value", "wall_p99_ms", "stages_p50_ms",
+            "fusable_gap_p50_ms", "fusable_gap_multi_dispatch_p50_ms",
+            "serial_dispatches_p50", "serial_dispatches_max",
+            "platform", "impl", "n_sets", "recorded_at", "source",
+        )
+    }
+    with open(baseline_path, "w") as f:
+        json.dump(baseline, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--input", help="compare an existing bench JSON line")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--stamp-hardware", action="store_true")
+    ap.add_argument("--n-imports", type=int, default=16)
+    ap.add_argument("--rel-tolerance", type=float, default=REL_TOLERANCE)
+    args = ap.parse_args(argv)
+
+    if args.stamp_hardware:
+        rec = latest_hardware_line()
+        if rec is None:
+            print("perf_gate: no hardware slotpath measurement recorded")
+            return 2
+        if not stamp_hardware(rec, args.baseline):
+            print(f"perf_gate: no baseline at {args.baseline} to stamp")
+            return 2
+        print(f"perf_gate: stamped hardware block ({rec['value']} ms)")
+        return 0
+
+    if args.input:
+        with open(args.input) as f:
+            line = json.load(f)
+    else:
+        try:
+            line = run_bench(args.n_imports)
+        except (RuntimeError, subprocess.TimeoutExpired) as e:
+            print(f"perf_gate: {e}")
+            return 2
+
+    problems = check_structure(line)
+    if args.update_baseline:
+        if problems:
+            for p in problems:
+                print(f"perf_gate: STRUCTURE {p}")
+            print("perf_gate: refusing to commit a broken baseline")
+            return 1
+        keep = dict(line)
+        try:
+            with open(args.baseline) as f:
+                keep_hw = json.load(f).get("hardware")
+        except (OSError, json.JSONDecodeError):
+            keep_hw = None
+        if keep_hw is not None:
+            keep["hardware"] = keep_hw
+        with open(args.baseline, "w") as f:
+            json.dump(keep, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"perf_gate: baseline updated ({line['value']} ms wall p50)")
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"perf_gate: cannot read baseline {args.baseline}: {e}")
+        return 2
+    problems += check_timing(line, baseline, rel=args.rel_tolerance)
+    for p in problems:
+        print(f"perf_gate: FAIL {p}")
+    if problems:
+        return 1
+    print(
+        f"perf_gate: OK wall p50 {line['value']} ms "
+        f"(baseline {baseline['value']} ms, "
+        f"+{int(args.rel_tolerance * 100)}% band), "
+        f"{len(line.get('stages_p50_ms') or {})} stages, "
+        f"accounting complete"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

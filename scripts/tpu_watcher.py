@@ -90,6 +90,10 @@ SWEEP = [
     # the 1k and 16k query shapes (depth 6, the finality branch)
     ("xla", 1024, "lcproof"),
     ("xla", 16384, "lcproof"),
+    # --- slot-budget decomposition on real kernels: stage medians,
+    # serial dispatches and the fusable gap for a full block import
+    # (stamped into scripts/perf_gate_baseline.json's hardware block)
+    ("pallas", 16, "slotpath"),
     # --- per-sweep reference point + BASELINE configs
     ("xla", 1024),
     ("pallas", 64, "sync512"),
@@ -252,6 +256,20 @@ def _git_head() -> str:
         return "unknown"
 
 
+def _stamp_perf_gate(rec: dict) -> None:
+    """A successful hardware slotpath measurement updates the perf
+    gate's committed baseline in place (its `hardware` block only — the
+    CPU-proxy tolerance bands are untouched), so the gate file carries
+    real-chip stage numbers the moment the tunnel answers."""
+    try:
+        from scripts.perf_gate import stamp_hardware
+
+        if stamp_hardware(rec):
+            log("  slotpath: stamped perf_gate baseline hardware block")
+    except Exception as e:
+        log(f"  slotpath: perf_gate stamp failed ({e!r})")
+
+
 def sweep() -> int:
     """Run the full A/B sweep; returns number of successful measurements.
 
@@ -284,6 +302,8 @@ def sweep() -> int:
             if rec is not None and rec.get("platform") in ("tpu", "axon"):
                 append_measurement(rec)
                 n_ok += 1
+                if config == "slotpath":
+                    _stamp_perf_gate(rec)
             else:
                 n_fail += 1
     finally:

@@ -362,8 +362,12 @@ def test_events_endpoint_forensics(chain_env):
     spec, chain, srv, imported, orphan_root = chain_env
     root_hex = "0x" + imported[1].hex()
     doc = _get(srv, f"/lighthouse/events?root={root_hex}")
-    assert [e["kind"] for e in doc["data"]] == ["block_import"]
-    assert doc["data"][0]["outcome"] == "imported"
+    # every import now lands two events under its root: the slot-budget
+    # record and the block_import verdict, in emission order
+    assert [e["kind"] for e in doc["data"]] == [
+        "slot_budget", "block_import",
+    ]
+    assert all(e["outcome"] == "imported" for e in doc["data"])
     assert doc["meta"]["enabled"] is True
     # outcome + kind filters and limit
     doc = _get(

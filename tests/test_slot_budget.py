@@ -1,0 +1,254 @@
+"""Slot-budget profiler: accounting completeness on synthetic
+timelines, the dispatch-gap ledger, thread-locality, and the PR 6
+overhead discipline (disabled ~0, enabled single-digit µs)."""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.common import slot_budget
+from lighthouse_tpu.common.events_journal import Journal
+from lighthouse_tpu.common.slot_budget import (
+    SLOT_BUDGET_MS,
+    SlotBudgetRecorder,
+    _union_s,
+    close_dispatch,
+    open_dispatch,
+    pre_stage,
+    stage,
+)
+
+ROOT = b"\x42" * 32
+
+
+def _busy(seconds: float):
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        pass
+
+
+# ------------------------------------------------ accounting (synthetic)
+
+
+def test_union_of_intervals():
+    assert _union_s([]) == 0.0
+    assert _union_s([(0.0, 1.0)]) == 1.0
+    # overlapping + disjoint + contained + empty
+    assert _union_s(
+        [(0.0, 1.0), (0.5, 2.0), (3.0, 4.0), (3.2, 3.4), (5.0, 5.0)]
+    ) == pytest.approx(3.0)
+
+
+def test_identity_stages_union_plus_unattributed_equals_wall():
+    """The recorder's defining identity on a real (timed) record:
+    union + unattributed == wall exactly, overlap = sum - union."""
+    rec_obj = SlotBudgetRecorder()
+    rec = rec_obj.begin(ROOT, 3)
+    with stage("slots"):
+        _busy(0.002)
+    with stage("block_processing"):
+        with stage("state_root"):  # deliberately overlapping
+            _busy(0.002)
+    _busy(0.001)  # unattributed tail
+    entry = rec_obj.finish(rec)
+    assert entry["union_s"] + entry["unattributed_s"] == pytest.approx(
+        entry["wall_s"], abs=2e-6
+    )
+    assert entry["overlap_s"] == pytest.approx(
+        entry["sum_stages_s"] - entry["union_s"], abs=2e-6
+    )
+    # nested state_root sat entirely inside block_processing
+    assert entry["overlap_s"] > 0
+    assert entry["unattributed_s"] > 0
+    names = {s[0] for s in entry["stages"]}
+    assert names == {"slots", "block_processing", "state_root"}
+
+
+def test_dispatch_gap_ledger():
+    """Two serial device round trips with host work between them: the
+    fusable gap is the host time between the first close and the
+    second open; queue wait splits out of the bus interval."""
+    rec_obj = SlotBudgetRecorder()
+    rec = rec_obj.begin(ROOT, 4)
+    tok = open_dispatch("attestation", kind="bus")
+    _busy(0.003)
+    close_dispatch(tok, queue_wait_s=0.001)
+    _busy(0.002)  # the fusable gap
+    tok = open_dispatch("kzg", kind="kzg")
+    _busy(0.001)
+    close_dispatch(tok)
+    entry = rec_obj.finish(rec)
+    assert entry["serial_dispatches"] == 2
+    assert [d["label"] for d in entry["dispatches"]] == [
+        "attestation", "kzg",
+    ]
+    assert entry["fusable_gap_s"] == pytest.approx(0.002, abs=1e-3)
+    assert entry["bus_wait_s"] == pytest.approx(0.001, abs=1e-4)
+    # device wall excludes the queue wait
+    assert entry["device_s"] == pytest.approx(0.003, abs=1.5e-3)
+
+
+def test_nested_dispatch_suppressed():
+    """A guarded dispatch running inside the bus's caller-side interval
+    (same thread) must not double-count: one interval per causal round
+    trip, and the depth unwind leaves the record reusable."""
+    rec_obj = SlotBudgetRecorder()
+    rec = rec_obj.begin(ROOT, 5)
+    outer = open_dispatch("proposal", kind="bus")
+    inner = open_dispatch("bls")  # the flush's GUARD crossing
+    close_dispatch(inner)
+    close_dispatch(outer)
+    tok = open_dispatch("kzg")  # depth unwound — records again
+    close_dispatch(tok)
+    entry = rec_obj.finish(rec)
+    assert entry["serial_dispatches"] == 2
+    assert [d["label"] for d in entry["dispatches"]] == [
+        "proposal", "kzg",
+    ]
+
+
+def test_marks_are_noops_without_record():
+    """Stage and dispatch marks outside any import cost one TLS read
+    and record nothing (cross-cutting planes run on non-import threads
+    all the time)."""
+    with stage("slots"):
+        pass
+    assert open_dispatch("bls") is None
+    close_dispatch(None)  # must not raise
+
+
+def test_pre_stage_adoption_shifts_wall():
+    """A decode measured before the record exists (HTTP publish path)
+    is adopted by the next begin() on the thread, shifting t0 back so
+    wall covers it; a second import must not re-adopt it."""
+    rec_obj = SlotBudgetRecorder()
+    with pre_stage("decode"):
+        _busy(0.002)
+    rec = rec_obj.begin(ROOT, 6)
+    entry = rec_obj.finish(rec)
+    assert [s[0] for s in entry["stages"]] == ["decode"]
+    assert entry["wall_s"] >= 0.002
+    rec2 = rec_obj.begin(ROOT, 7)
+    entry2 = rec_obj.finish(rec2)
+    assert entry2["stages"] == []
+
+
+def test_records_are_thread_local():
+    """An import on another thread must not attach its stages to this
+    thread's record."""
+    rec_obj = SlotBudgetRecorder()
+    rec = rec_obj.begin(ROOT, 8)
+
+    def other():
+        with stage("slots"):
+            pass
+        assert open_dispatch("bls") is None
+
+    th = threading.Thread(target=other)
+    th.start()
+    th.join()
+    entry = rec_obj.finish(rec)
+    assert entry["stages"] == []
+    assert entry["serial_dispatches"] == 0
+
+
+def test_discard_removes_without_emitting():
+    j = Journal(capacity=64)
+    rec_obj = SlotBudgetRecorder(journal=j)
+    rec = rec_obj.begin(ROOT, 9)
+    rec_obj.discard(rec)
+    assert rec_obj.recorded == 0
+    assert not j.query(kind="slot_budget")
+    # and the TLS stack is clean: marks are no-ops again
+    assert open_dispatch("bls") is None
+
+
+def test_journal_event_and_ring_agree():
+    j = Journal(capacity=64)
+    rec_obj = SlotBudgetRecorder(journal=j)
+    rec = rec_obj.begin(ROOT, 11, path="rpc")
+    with stage("slots"):
+        _busy(0.001)
+    tok = open_dispatch("attestation", kind="bus")
+    close_dispatch(tok)
+    rec_obj.finish(rec, outcome="imported")
+    (ev,) = j.query(kind="slot_budget")
+    assert ev["outcome"] == "imported"
+    attrs = ev["attrs"]
+    assert attrs["path"] == "rpc"
+    assert attrs["n_stages"] == 1
+    assert attrs["serial_dispatches"] == 1
+    assert attrs["dispatch_labels"] == ["attestation"]
+    assert attrs["union_s"] + attrs["unattributed_s"] == pytest.approx(
+        attrs["wall_s"], abs=2e-6
+    )
+    (ring_entry,) = rec_obj.recent()
+    assert ring_entry["wall_s"] == attrs["wall_s"]
+    assert ring_entry["slot"] == 11
+
+
+def test_summary_and_headline():
+    rec_obj = SlotBudgetRecorder()
+    for slot in range(4):
+        rec = rec_obj.begin(ROOT, slot)
+        with stage("block_processing"):
+            _busy(0.002)
+        with stage("slots"):
+            _busy(0.0005)
+        rec_obj.finish(rec)
+    s = rec_obj.summary()
+    assert s["imports"] == 4
+    assert s["budget_ms"] == SLOT_BUDGET_MS
+    assert set(s["stages"]) == {"block_processing", "slots"}
+    wall_ms, top, share = rec_obj.headline()
+    assert top == "block_processing"
+    assert 0 < share <= 1.0
+    assert wall_ms >= 2.0
+
+
+def test_ring_bound_and_configure():
+    rec_obj = SlotBudgetRecorder(ring=8)
+    for slot in range(20):
+        rec_obj.finish(rec_obj.begin(ROOT, slot))
+    assert len(rec_obj.recent()) == 8
+    assert rec_obj.recorded == 20
+    assert rec_obj.recent(limit=3)[-1]["slot"] == 19
+    rec_obj.configure(enabled=False)
+    assert rec_obj.begin(ROOT, 99) is None
+    assert rec_obj.finish(None) is None
+
+
+# ---------------------------------------------------- overhead (PR 6 A/B)
+
+
+def test_profiler_overhead_bounds():
+    """The PR 6 discipline: disabled, begin() is one attribute check
+    (~0); enabled, the full begin + marks + finish cycle stays
+    single-digit-to-low-tens of µs — noise against a multi-ms import."""
+    n = 2000
+    disabled = SlotBudgetRecorder(enabled=False)
+    t0 = time.perf_counter()
+    for i in range(n):
+        disabled.finish(disabled.begin(ROOT, i))
+    per_disabled = (time.perf_counter() - t0) / n
+
+    enabled = SlotBudgetRecorder()
+    t0 = time.perf_counter()
+    for i in range(n):
+        rec = enabled.begin(ROOT, i)
+        with stage("slots"):
+            pass
+        with stage("block_processing"):
+            pass
+        tok = open_dispatch("attestation", kind="bus")
+        close_dispatch(tok, queue_wait_s=0.0)
+        enabled.finish(rec)
+    per_enabled = (time.perf_counter() - t0) / n
+
+    # disabled: one attribute check + None plumbing
+    assert per_disabled < 5e-6
+    # enabled: full record + finalize, generous CI band (measured
+    # ~10-20 µs locally; an import is milliseconds)
+    assert per_enabled < 200e-6
+    assert 0.001 * SLOT_BUDGET_MS > per_enabled * 1000.0  # << the budget
