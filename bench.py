@@ -42,6 +42,11 @@ Env knobs:
                             window assertion, streamed-bytes totals
   BENCH_CONFIG=lcproof      batched device Merkle-proof kernel at
                             BENCH_NSETS queries (byte-identical fold)
+  BENCH_CONFIG=das          DA sampling plane: Reed-Solomon blob
+                            extension + batched cell-multiproof fold
+                            over the guarded device plane at
+                            BENCH_NSETS blobs, byte-identical to the
+                            host oracle (corrupt batch must reject)
   BENCH_CONFIG=slotpath     per-import critical-path decomposition
                             from the slot-budget recorder over
                             BENCH_NSETS imports: stage medians, wall
@@ -163,6 +168,7 @@ def _active_metric():
         "serve": "serve_mixed_traffic_throughput",
         "busmix": "bus_amortization_speedup",
         "slotpath": "slotpath_wall_p50_ms",
+        "das": "das_cell_verify_throughput",
     }.get(cfg, "verify_signature_sets_throughput")
 
 
@@ -328,6 +334,12 @@ def _measure(jax, platform):
         from lighthouse_tpu import bench_slotpath
 
         return bench_slotpath.measure(jax, platform)
+    if config == "das":
+        # DA sampling plane: device RS extension + cell-multiproof
+        # fold, host-oracle-checked every iteration
+        from lighthouse_tpu import bench_das
+
+        return bench_das.measure(jax, platform)
     if config == "lcserve":
         # light-client read flood against one live node (serving edge
         # on the fake backend; never a hardware headline)

@@ -44,6 +44,13 @@ ENTRY_POINTS = {
     "batch_merkle_roots",
     "batch_verify_branches",
     "batch_extract_proofs",
+    # DA sampling plane (da/erasure.py, da/cells.py, da/tpu_backend.py)
+    "extend_blobs",
+    "compute_cells",
+    "compute_cells_and_kzg_proofs",
+    "verify_cell_proof_batch",
+    "rs_extend_tpu",
+    "verify_cell_proof_batch_tpu",
 }
 
 # raw jit-graph namespace sharing names with the api boundary

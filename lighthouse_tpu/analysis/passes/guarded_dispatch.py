@@ -34,6 +34,8 @@ RAW_DISPATCHERS = {
     "verify_blob_kzg_proof_batch_tpu",
     "g1_msm_tpu",
     "g1_msm_fixed_base_tpu",
+    "rs_extend_tpu",
+    "verify_cell_proof_batch_tpu",
 }
 
 # package-relative posix paths that implement the guarded boundary:
@@ -43,6 +45,9 @@ ALLOWED_MODULES = {
     "bls/tpu_backend.py",
     "kzg/api.py",
     "kzg/tpu_backend.py",
+    "da/erasure.py",
+    "da/cells.py",
+    "da/tpu_backend.py",
     "device_plane/executor.py",
     "device_plane/canary.py",
 }

@@ -105,11 +105,16 @@ class BeaconChain:
         backend: str = "ref",
         slot_clock=None,
         execution_layer=None,
+        column_mode: bool = False,
     ):
         self.spec = spec
         self.execution_layer = execution_layer
         self.t = types_for(spec)
         self.backend = backend
+        # column_mode swaps the blob DA checker for the PeerDAS-shaped
+        # column checker: blocks gate on >=50% of DataColumnSidecars
+        # instead of every BlobSidecar (beacon_chain/column_checker.py)
+        self.column_mode = bool(column_mode)
         # per-node lifecycle event journal: every subsystem this chain
         # assembles (DA checker, sync manager, beacon processor, HTTP
         # API) emits into THIS instance, so multi-node simulations keep
@@ -201,12 +206,29 @@ class BeaconChain:
             DataAvailabilityChecker,
         )
 
-        self.da_checker = DataAvailabilityChecker(
-            spec,
-            backend=backend,
-            current_slot_fn=self.current_slot,
-            journal=self.journal,
-        )
+        if self.column_mode:
+            # PeerDAS column sampling: the block gate is >=50% of
+            # verified DataColumnSidecars (reconstruction fills the
+            # rest); cell-proof batches ride THIS chain's verification
+            # bus under the "da_cells" consumer label
+            from lighthouse_tpu.beacon_chain.column_checker import (
+                ColumnAvailabilityChecker,
+            )
+
+            self.da_checker = ColumnAvailabilityChecker(
+                spec,
+                backend=backend,
+                current_slot_fn=self.current_slot,
+                journal=self.journal,
+                bus=self.verification_bus,
+            )
+        else:
+            self.da_checker = DataAvailabilityChecker(
+                spec,
+                backend=backend,
+                current_slot_fn=self.current_slot,
+                journal=self.journal,
+            )
         # a released block that fails import for NON-DA reasons (e.g.
         # unknown parent) is handed here; the node wires in its
         # parent-lookup recovery so the block is not silently lost
@@ -922,6 +944,55 @@ class BeaconChain:
                 # reasons (the sidecars themselves were valid) — hand
                 # it to the recovery hook so e.g. an unknown parent
                 # triggers the node's lookup instead of silent loss
+                if self.da_release_failure_handler is not None:
+                    self.da_release_failure_handler(blk, e)
+        return imported
+
+    def process_data_column_sidecar(self, sidecar, verify_header=True):
+        """Gossip column-sidecar entry point (column_mode nodes):
+        verify + record through the column checker, then import any
+        block the column's arrival pushed past the 50% threshold. The
+        proposer-signature gate is the SAME signed-header check the
+        blob plane runs (`verify_blob_sidecar_header` — the container
+        binds to the block identically), so redeliveries of one block's
+        columns cost one pairing total."""
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            DataAvailabilityError,
+        )
+
+        if not self.column_mode:
+            raise DataAvailabilityError(
+                "node is not in column-sampling mode"
+            )
+        precomputed = None
+        if verify_header:
+            precomputed = self.da_checker.precheck_column(sidecar)
+            if not self.verify_blob_sidecar_header(sidecar):
+                self.metrics["sidecar_header_sig_failures"] = (
+                    self.metrics.get("sidecar_header_sig_failures", 0)
+                    + 1
+                )
+                self.journal.emit(
+                    "column_sidecar",
+                    root=precomputed[0],
+                    slot=int(sidecar.signed_block_header.message.slot),
+                    outcome="header_sig_invalid",
+                    index=int(sidecar.index),
+                )
+                raise DataAvailabilityError(
+                    "column sidecar proposer signature invalid"
+                )
+        released = self.da_checker.put_column(
+            sidecar, precomputed=precomputed
+        )
+        self.metrics["column_sidecars_processed"] = (
+            self.metrics.get("column_sidecars_processed", 0) + 1
+        )
+        imported = []
+        for blk in released:
+            try:
+                imported.append(self.process_block(blk))
+            except BlockError as e:
                 if self.da_release_failure_handler is not None:
                     self.da_release_failure_handler(blk, e)
         return imported

@@ -1,0 +1,665 @@
+"""Column availability checker: gate block import on sampled DA columns.
+
+The PeerDAS-shaped sibling of `data_availability_checker.py`
+(reference: beacon_node/beacon_chain/src/data_availability_checker/ in
+column custody mode): a block whose body commits to blobs imports once
+AT LEAST HALF of the extended blob matrix's columns have arrived as
+`DataColumnSidecar`s whose cell proofs verify. The Reed-Solomon
+extension (da/erasure.py) makes any 50% of columns sufficient — the
+checker then RECONSTRUCTS the missing half, regenerates every column's
+cells and proofs deterministically (so every honest node rebuilds
+byte-identical sidecars), and holds the full set for re-serving.
+
+Verification discipline mirrors the blob checker:
+
+  * column BEFORE block — cached as an UNVERIFIED candidate keyed by
+    content digest, with NO pairing work; candidates per (root, index)
+    are capped and the chain verifies the signed block header before
+    anything enters this cache.
+  * block arrival — body-matching candidates verify in ONE RLC-folded
+    cell-proof batch (`verification_bus.submit_cells` under the
+    "da_cells" consumer label when a bus is wired, else the direct
+    `da.cells.verify_cell_proof_batch`); a failed fold falls back to
+    per-column verdicts so honest columns still land.
+  * column AFTER the block — cross-checked against the body and
+    verified immediately.
+
+The 50% threshold is `geometry.num_cells // 2` columns (each column
+carries `cell_elements` of every blob's 2n extended evaluations, so
+half the columns is exactly the n evaluations interpolation needs).
+Fewer than that can NEVER release the block — the withholding-adversary
+scenario (sim/scenarios/das_withhold.json) drives both sides of the
+boundary.
+"""
+
+import hashlib
+import time
+
+from lighthouse_tpu.common.events_journal import JOURNAL
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.tracing import span
+from lighthouse_tpu.da import geometry_for_spec
+from lighthouse_tpu.da.domain import DaError
+
+_COLUMNS = REGISTRY.counter_vec(
+    "lighthouse_tpu_da_columns_total",
+    "data-column sidecars processed, by outcome",
+    ("outcome",),
+)
+_RECONSTRUCTIONS = REGISTRY.counter(
+    "lighthouse_tpu_da_column_reconstructions_total",
+    "blocks whose missing columns were reconstructed from a >=50% subset",
+)
+_PENDING_COLUMN_BLOCKS = REGISTRY.gauge(
+    "lighthouse_tpu_da_column_pending_blocks",
+    "blocks held awaiting data-column sidecars",
+)
+_COLUMN_BLOCKS_RELEASED = REGISTRY.counter(
+    "lighthouse_tpu_da_column_blocks_released_total",
+    "held blocks released after their column set crossed 50%",
+)
+
+
+class _PendingColumns:
+    """One block root's in-flight pieces: the held block (if it arrived
+    first), VERIFIED columns by index, and unverified pre-block
+    candidates by (index, content digest)."""
+
+    __slots__ = (
+        "block", "columns", "candidates", "commitments", "t_held",
+        "reconstructed",
+    )
+
+    def __init__(self):
+        self.block = None
+        self.columns: dict[int, object] = {}  # index -> verified sidecar
+        self.candidates: dict[int, dict] = {}  # index -> {digest: sc}
+        self.commitments = None  # list[bytes] once the block is known
+        self.t_held = None
+        self.reconstructed = False
+
+
+class ColumnAvailabilityChecker:
+    """Duck-types the chain-facing surface of DataAvailabilityChecker
+    (put_block / verified_sidecars / missing_indices / prune / stats)
+    so `BeaconChain` swaps it in whole when column sampling is on;
+    blob-sidecar entry points reject loudly — a column-mode node must
+    never silently accept the blob plane's full sidecars."""
+
+    MAX_PENDING_ENTRIES = 512
+    MAX_CANDIDATES_PER_INDEX = 2
+
+    def __init__(
+        self,
+        spec,
+        backend: str = "ref",
+        current_slot_fn=None,
+        journal=None,
+        bus=None,
+        setup=None,
+    ):
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            ObservedBlobSidecars,
+        )
+
+        self.spec = spec
+        self.geo = geometry_for_spec(spec)
+        self.backend = backend if backend in ("ref", "tpu", "fake") else "ref"
+        self.current_slot_fn = current_slot_fn
+        self.journal = journal if journal is not None else JOURNAL
+        # cell batches ride the node's verification bus (consumer
+        # "da_cells") when wired; None falls through to the direct
+        # da.cells entry point (same tier walk, no coalescing)
+        self.bus = bus
+        self.setup = setup
+        # same (root, index, digest) first-seen filter — columns and
+        # blobs never share a checker instance, so reusing the class is
+        # safe
+        self.observed = ObservedBlobSidecars()
+        self._pending: dict[bytes, _PendingColumns] = {}
+
+    # ------------------------------------------------------------ plumbing
+
+    def _note_column(
+        self, outcome: str, root=None, index=None, slot=None, n: int = 1
+    ):
+        _COLUMNS.labels(outcome).inc(n)
+        self.journal.emit(
+            "column_sidecar",
+            root=root,
+            slot=slot,
+            outcome=outcome,
+            index=index,
+            **({"n": n} if n != 1 else {}),
+        )
+
+    def _required(self) -> int:
+        """Columns needed before reconstruction can run (exactly 50%)."""
+        return self.geo.num_cells // 2
+
+    def stats(self) -> dict:
+        entries = list(self._pending.values())
+        candidates = 0
+        verified = 0
+        held = 0
+        reconstructed = 0
+        for e in entries:
+            candidates += sum(len(c) for c in list(e.candidates.values()))
+            verified += len(e.columns)
+            if e.block is not None:
+                held += 1
+            if e.reconstructed:
+                reconstructed += 1
+        return {
+            "mode": "column",
+            "columns_required": self._required(),
+            "columns_per_block": self.geo.num_cells,
+            "pending_entries": len(entries),
+            "held_blocks": held,
+            "cached_candidates": candidates,
+            "verified_columns": verified,
+            "reconstructed_entries": reconstructed,
+        }
+
+    def _drop_entry(self, block_root: bytes):
+        entry = self._pending.pop(block_root, None)
+        if entry is None:
+            return
+        for index, cands in entry.candidates.items():
+            for digest, sc in cands.items():
+                self.observed.forget(
+                    int(sc.signed_block_header.message.slot),
+                    block_root,
+                    index,
+                    digest,
+                )
+        for index, sc in entry.columns.items():
+            self.observed.forget(
+                int(sc.signed_block_header.message.slot),
+                block_root,
+                index,
+                hashlib.sha256(sc.to_bytes()).digest(),
+            )
+        _PENDING_COLUMN_BLOCKS.set(len(self.pending_block_roots()))
+
+    def _entry(self, block_root: bytes) -> _PendingColumns:
+        e = self._pending.get(block_root)
+        if e is None:
+            if len(self._pending) >= self.MAX_PENDING_ENTRIES:
+                victim = next(
+                    (
+                        r
+                        for r, v in self._pending.items()
+                        if v.block is None and not v.columns
+                    ),
+                    next(iter(self._pending)),
+                )
+                self._drop_entry(victim)
+            e = self._pending[block_root] = _PendingColumns()
+        return e
+
+    def _slot_in_horizon(self, slot: int) -> bool:
+        if self.current_slot_fn is None:
+            return True
+        return slot <= self.current_slot_fn() + self.spec.SLOTS_PER_EPOCH
+
+    # ------------------------------------------------------- verification
+
+    def _column_items(self, sidecar):
+        """One column sidecar -> cell-batch items (one per blob): the
+        4-tuple shape `da.cells.verify_cell_proof_batch` folds."""
+        k = int(sidecar.index)
+        return [
+            (bytes(c), k, bytes(cell), bytes(p))
+            for c, cell, p in zip(
+                sidecar.kzg_commitments,
+                sidecar.column,
+                sidecar.kzg_proofs,
+                strict=True,
+            )
+        ]
+
+    def _verify_columns(self, sidecars, slot=None) -> bool:
+        """ONE folded cell-proof batch over every (blob, column) cell of
+        the given sidecars."""
+        items = [it for sc in sidecars for it in self._column_items(sc)]
+        if not items:
+            return True
+        if self.bus is not None:
+            return self.bus.submit_cells(
+                items,
+                self.geo,
+                backend=self.backend,
+                setup=self.setup,
+                journal=self.journal,
+                slot=slot,
+            )
+        from lighthouse_tpu.da import cells as da_cells
+
+        return da_cells.verify_cell_proof_batch(
+            items,
+            self.geo,
+            backend=self.backend,
+            setup=self.setup,
+            consumer="da_cells",
+        )
+
+    # ------------------------------------------------------------- queries
+
+    @staticmethod
+    def block_commitments(signed_block) -> list:
+        return [
+            bytes(c)
+            for c in getattr(
+                signed_block.message.body, "blob_kzg_commitments", []
+            )
+        ]
+
+    def missing_indices(self, block_root: bytes, signed_block) -> set:
+        """Column indices still needed before the 50% threshold. Empty
+        iff the block is available (any further columns are a bonus, so
+        once the threshold is crossed nothing is 'missing')."""
+        commitments = self.block_commitments(signed_block)
+        if not commitments:
+            return set()
+        entry = self._pending.get(block_root)
+        have = set(entry.columns) if entry is not None else set()
+        if len(have) >= self._required():
+            return set()
+        return {
+            i for i in range(self.geo.num_cells) if i not in have
+        }
+
+    def is_available(self, block_root: bytes, signed_block) -> bool:
+        return not self.missing_indices(block_root, signed_block)
+
+    def pending_block_roots(self) -> list:
+        return [r for r, e in self._pending.items() if e.block is not None]
+
+    def verified_sidecars(self, block_root: bytes) -> list:
+        """Blob-sidecar persistence shim: column mode persists no full
+        blobs (re-serving works from the column set; `columns_for`)."""
+        return []
+
+    def columns_for(self, block_root: bytes) -> list:
+        """Verified column sidecars for a root, ordered by index — after
+        reconstruction this is the FULL set, which the node re-serves
+        (the REST /lighthouse/da/columns surface samplers poll)."""
+        entry = self._pending.get(block_root)
+        if entry is None:
+            return []
+        return [entry.columns[i] for i in sorted(entry.columns)]
+
+    # -------------------------------------------------------------- blocks
+
+    def put_block(self, block_root: bytes, signed_block) -> set:
+        """Register an arrived block; returns the missing column indices
+        (empty = available now). Pre-block candidates matching the body
+        settle here in one folded cell batch; crossing the 50% threshold
+        triggers reconstruction."""
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            DataAvailabilityError,
+        )
+
+        commitments = self.block_commitments(signed_block)
+        if not commitments:
+            return set()
+        if len(commitments) > self.spec.MAX_BLOBS_PER_BLOCK:
+            raise DataAvailabilityError(
+                f"block commits to {len(commitments)} blobs, max is "
+                f"{self.spec.MAX_BLOBS_PER_BLOCK}"
+            )
+        entry = self._entry(block_root)
+        entry.commitments = commitments
+        self._settle_candidates(block_root, entry)
+        self._maybe_reconstruct(block_root, entry)
+        missing = self.missing_indices(block_root, signed_block)
+        if missing:
+            if entry.block is None and self._slot_in_horizon(
+                int(signed_block.message.slot)
+            ):
+                entry.block = signed_block
+                entry.t_held = time.monotonic()
+                _PENDING_COLUMN_BLOCKS.set(
+                    len(self.pending_block_roots())
+                )
+            if not entry.columns and entry.block is None:
+                self._drop_entry(block_root)
+        else:
+            self._finish(block_root, entry)
+        return missing
+
+    def _settle_candidates(self, block_root: bytes, entry):
+        """Pre-block candidates -> verified columns: body-matching
+        candidates verify in one folded cell batch; a failed fold falls
+        back to per-column verdicts. Non-accepted candidates have their
+        observed digests forgotten (redelivery is judged fresh)."""
+        matching, discarded = [], []
+        for i, cands in entry.candidates.items():
+            usable = i not in entry.columns and i < self.geo.num_cells
+            for digest, sc in cands.items():
+                if usable and self._matches_body(sc, entry.commitments):
+                    matching.append((i, digest, sc))
+                else:
+                    discarded.append((i, digest, sc))
+        entry.candidates.clear()
+        if discarded:
+            self._note_column(
+                "mismatched_commitment", root=block_root, n=len(discarded)
+            )
+        if matching:
+            def _verify_singly():
+                out = []
+                for item in matching:
+                    try:
+                        if self._verify_columns([item[2]]):
+                            out.append(item)
+                    except DaError:
+                        pass
+                return out
+
+            with span("da/settle_columns", n=len(matching)):
+                try:
+                    if self._verify_columns(
+                        [sc for _, _, sc in matching]
+                    ):
+                        accepted = matching
+                    else:
+                        accepted = _verify_singly()
+                except DaError:
+                    accepted = _verify_singly()
+            if len(accepted) < len(matching):
+                self._note_column(
+                    "invalid_proof",
+                    root=block_root,
+                    n=len(matching) - len(accepted),
+                )
+            accepted_set = {id(item[2]) for item in accepted}
+            discarded.extend(
+                item
+                for item in matching
+                if id(item[2]) not in accepted_set
+            )
+            for i, digest, sc in accepted:
+                if i in entry.columns:
+                    continue
+                self._note_column(
+                    "verified",
+                    root=block_root,
+                    index=i,
+                    slot=int(sc.signed_block_header.message.slot),
+                )
+                entry.columns[i] = sc
+        for i, digest, sc in discarded:
+            self.observed.forget(
+                int(sc.signed_block_header.message.slot),
+                block_root,
+                i,
+                digest,
+            )
+
+    def _matches_body(self, sidecar, commitments) -> bool:
+        return [bytes(c) for c in sidecar.kzg_commitments] == list(
+            commitments
+        ) and len(sidecar.column) == len(commitments) and len(
+            sidecar.kzg_proofs
+        ) == len(commitments)
+
+    def _maybe_reconstruct(self, block_root: bytes, entry):
+        """>=50% of columns verified and some still missing: rebuild
+        every blob from the verified columns (da.erasure), regenerate
+        ALL columns + proofs deterministically, and hold the full set.
+        Every honest node runs the same pure function over the same
+        inputs, so reconstructed sidecars are byte-identical across the
+        network — re-serving them cannot fragment the DA view."""
+        if (
+            entry.commitments is None
+            or entry.reconstructed
+            or len(entry.columns) >= self.geo.num_cells
+            or len(entry.columns) < self._required()
+        ):
+            return
+        from lighthouse_tpu.da import cells as da_cells
+        from lighthouse_tpu.da import erasure
+
+        n_blobs = len(entry.commitments)
+        template = next(iter(entry.columns.values()))
+        header = template.signed_block_header
+        t_cls = type(template)
+        with span(
+            "da/reconstruct",
+            n_columns=len(entry.columns),
+            n_blobs=n_blobs,
+        ):
+            per_blob_cells, per_blob_proofs = [], []
+            for b in range(n_blobs):
+                cells = {
+                    k: bytes(sc.column[b])
+                    for k, sc in entry.columns.items()
+                }
+                blob = erasure.reconstruct_blob(cells, self.geo)
+                full_cells, proofs = da_cells.compute_cells_and_kzg_proofs(
+                    blob,
+                    self.geo,
+                    setup=self.setup,
+                    backend=self.backend,
+                    consumer="da_cells",
+                )
+                per_blob_cells.append(full_cells)
+                per_blob_proofs.append(proofs)
+            rebuilt = {}
+            for k in range(self.geo.num_cells):
+                rebuilt[k] = t_cls(
+                    index=k,
+                    column=[
+                        bytes(per_blob_cells[b][k])
+                        for b in range(n_blobs)
+                    ],
+                    kzg_commitments=list(entry.commitments),
+                    kzg_proofs=[
+                        bytes(per_blob_proofs[b][k])
+                        for b in range(n_blobs)
+                    ],
+                    signed_block_header=header,
+                )
+        entry.columns = rebuilt
+        entry.reconstructed = True
+        _RECONSTRUCTIONS.inc()
+        self._note_column(
+            "reconstructed",
+            root=block_root,
+            slot=int(header.message.slot),
+            n=self.geo.num_cells,
+        )
+
+    # ------------------------------------------------------------- columns
+
+    def _structural_gate(self, sidecar, precomputed=None):
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            DataAvailabilityError,
+        )
+
+        header = sidecar.signed_block_header.message
+        index = int(sidecar.index)
+        slot = int(header.slot)
+        if precomputed is not None:
+            block_root, digest = precomputed
+        else:
+            block_root = type(header).hash_tree_root(header)
+            digest = None
+        if index >= self.geo.num_cells:
+            self._note_column(
+                "bad_index", root=block_root, index=index, slot=slot
+            )
+            raise DataAvailabilityError(
+                f"column index {index} out of range"
+            )
+        if not (
+            len(sidecar.column)
+            == len(sidecar.kzg_commitments)
+            == len(sidecar.kzg_proofs)
+        ):
+            self._note_column(
+                "malformed", root=block_root, index=index, slot=slot
+            )
+            raise DataAvailabilityError(
+                "column/commitment/proof lengths disagree"
+            )
+        if not self._slot_in_horizon(slot):
+            self._note_column(
+                "future_slot", root=block_root, index=index, slot=slot
+            )
+            raise DataAvailabilityError(
+                f"column slot {slot} beyond the clock horizon"
+            )
+        if digest is None:
+            digest = hashlib.sha256(sidecar.to_bytes()).digest()
+        if self.observed.is_known(slot, block_root, index, digest):
+            self._note_column(
+                "duplicate", root=block_root, index=index, slot=slot
+            )
+            raise DataAvailabilityError("duplicate column sidecar")
+        return block_root, digest
+
+    def precheck_column(self, sidecar):
+        """Cheap structural rejections without cache mutation (the
+        cheap-checks-first DoS ordering `precheck_sidecar` documents)."""
+        return self._structural_gate(sidecar)
+
+    def put_column(self, sidecar, precomputed=None) -> list:
+        """Validate + record one gossip column sidecar. Returns the
+        released (now >=50%-available, reconstructed) held blocks.
+        Raises DataAvailabilityError on invalid/duplicate input."""
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            DataAvailabilityError,
+        )
+
+        header = sidecar.signed_block_header.message
+        index = int(sidecar.index)
+        slot = int(header.slot)
+        block_root, digest = self._structural_gate(
+            sidecar, precomputed=precomputed
+        )
+
+        entry = self._pending.get(block_root)
+        if entry is None or entry.commitments is None:
+            entry = self._entry(block_root)
+            cands = entry.candidates.setdefault(index, {})
+            if digest not in cands:
+                if len(cands) >= self.MAX_CANDIDATES_PER_INDEX:
+                    self._note_column(
+                        "candidate_overflow",
+                        root=block_root,
+                        index=index,
+                        slot=slot,
+                    )
+                    return []
+                cands[digest] = sidecar
+            self.observed.observe(slot, block_root, index, digest)
+            self._note_column(
+                "cached_pending_block",
+                root=block_root,
+                index=index,
+                slot=slot,
+            )
+            return []
+
+        if not self._matches_body(sidecar, entry.commitments):
+            self._note_column(
+                "mismatched_commitment",
+                root=block_root,
+                index=index,
+                slot=slot,
+            )
+            raise DataAvailabilityError(
+                "column commitments do not match the block body"
+            )
+        with span("da/verify_column", index=index):
+            try:
+                ok = self._verify_columns([sidecar], slot=slot)
+            except DaError as e:
+                self._note_column(
+                    "invalid_proof",
+                    root=block_root,
+                    index=index,
+                    slot=slot,
+                )
+                raise DataAvailabilityError(
+                    f"malformed column sidecar: {e}"
+                ) from e
+        if not ok:
+            self._note_column(
+                "invalid_proof", root=block_root, index=index, slot=slot
+            )
+            raise DataAvailabilityError(
+                "cell proof verification failed"
+            )
+
+        self._note_column(
+            "verified", root=block_root, index=index, slot=slot
+        )
+        self.observed.observe(slot, block_root, index, digest)
+        if index not in entry.columns:
+            entry.columns[index] = sidecar
+        self._maybe_reconstruct(block_root, entry)
+
+        released = []
+        if entry.block is not None and len(entry.columns) >= (
+            self._required()
+        ):
+            released.append(entry.block)
+            self._finish(block_root, entry)
+        return released
+
+    # ------------------------------------------- blob-plane entry points
+
+    def precheck_sidecar(self, sidecar):
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            DataAvailabilityError,
+        )
+
+        raise DataAvailabilityError(
+            "node is in column-sampling mode: blob sidecars are not "
+            "accepted (columns gossip on data_column_sidecar_* topics)"
+        )
+
+    def put_sidecar(self, sidecar, precomputed=None):
+        self.precheck_sidecar(sidecar)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _finish(self, block_root: bytes, entry: _PendingColumns):
+        if entry.block is not None:
+            _COLUMN_BLOCKS_RELEASED.inc()
+            held_s = None
+            if entry.t_held is not None:
+                held_s = time.monotonic() - entry.t_held
+            self.journal.emit(
+                "block_release",
+                root=block_root,
+                slot=int(entry.block.message.slot),
+                outcome="complete",
+                duration_s=held_s,
+                n_sidecars=len(entry.columns),
+            )
+            entry.block = None
+            entry.t_held = None
+        _PENDING_COLUMN_BLOCKS.set(len(self.pending_block_roots()))
+
+    def prune(self, finalized_slot: int):
+        self.observed.prune(finalized_slot)
+        for root, entry in list(self._pending.items()):
+            slots = [
+                int(sc.signed_block_header.message.slot)
+                for sc in entry.columns.values()
+            ]
+            for cands in entry.candidates.values():
+                slots.extend(
+                    int(sc.signed_block_header.message.slot)
+                    for sc in cands.values()
+                )
+            if entry.block is not None:
+                slots.append(int(entry.block.message.slot))
+            if slots and max(slots) < finalized_slot:
+                self._drop_entry(root)
+        _PENDING_COLUMN_BLOCKS.set(len(self.pending_block_roots()))

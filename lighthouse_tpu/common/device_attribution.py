@@ -59,6 +59,7 @@ CONSUMERS = frozenset(
         "sidecar_header",  # blob-sidecar proposer-header checks
         "oppool",          # op-pool / aggregation revalidation
         "kzg",             # KZG proof verification + producer MSMs
+        "da_cells",        # DA sampling plane: RS extension + cell proofs
         "slasher",         # slashing-proof verification
         "light_client",    # light-client update production + sim actor
         "bench",           # benchmarks and measurement harnesses

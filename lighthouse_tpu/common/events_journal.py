@@ -72,6 +72,17 @@ KINDS = frozenset(
         # data-availability lifecycle (da_checker)
         "sidecar",
         "da_settle",
+        # DA sampling plane: column-sidecar lifecycle (gossip arrival,
+        # verify, reconstruction) — a protocol claim, canonical — and
+        # the bus's coalesced cell-proof batches, which (like
+        # signature_batch) depend on batch-formation timing and stay
+        # OUT of the canonical replay projection
+        "column_sidecar",
+        "cell_batch",
+        # DAS sampler verdicts (sim/das_sampler): issued/satisfied/
+        # withheld_flagged per sampled block — wall-clock poll timing,
+        # NOT canonical
+        "das_sample",
         # req/resp sync lifecycle (sync manager)
         "sync_request",
         "sync_batch",

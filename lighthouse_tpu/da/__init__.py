@@ -1,0 +1,24 @@
+"""Data-availability sampling plane (PeerDAS-shaped).
+
+Blob polynomials are Reed-Solomon extended 2x over a roots-of-unity
+domain in Fr (`da.erasure`, device kernel `ops/rs_extend`), split into
+cells whose KZG multiproofs verify through the same two-pair folded
+pairing as blob proofs (`da.cells`, riding `ops/kzg_verify`), and
+distributed as column sidecars over column subnets with per-node
+custody (`da.custody`). Any 50% of columns reconstructs every blob, so
+imports no longer require full sidecars.
+
+Layout mirrors the kzg package: pure host policy + ref oracles in the
+plane modules, device marshaling behind `da.tpu_backend`, everything
+dispatched through the guarded executor with tpu -> xla-host -> ref
+failover tiers.
+"""
+
+from lighthouse_tpu.da.domain import CellGeometry, DaError, geometry, geometry_for_spec
+
+__all__ = [
+    "CellGeometry",
+    "DaError",
+    "geometry",
+    "geometry_for_spec",
+]

@@ -1,0 +1,302 @@
+"""KZG cell multiproofs: compute + batched folded verification.
+
+The coset structure of `da.domain` makes cell proofs the SAME pairing
+shape as blob proofs. For cell k of a blob polynomial p with
+commitment C, the proof is the quotient commitment
+
+    W_k = commit( (p(X) - I_k(X)) / (X^m - c_k) )
+
+where I_k is the degree-<m interpolant of the cell's evaluations and
+Z_k(X) = X^m - c_k vanishes on the coset. The quotient is one
+synthetic long division (binomial divisor); the correctness identity
+
+    e(C - commit(I_k) + c_k*W_k, G2) * e(-W_k, [tau^m]G2) == 1
+
+folds over N cells with independent RLC scalars r_k into TWO Miller
+pairs total:
+
+    e( sum r_k*C_k + sum (r_k*c_k)*W_k - commit(sum r_k*I_k), G2 )
+      * e( -sum r_k*W_k, [tau^m]G2 ) == 1
+
+— exactly the lane layout of the existing blob-batch device kernel
+(`ops/kzg_verify.verify_kzg_proof_batch`): c_k plays z_i, the folded
+interpolant commitment plays the [sum r_i y_i]G1 aux lane, and
+[tau^m]G2 replaces [tau]G2. The tpu tier reuses that kernel verbatim
+via `da.tpu_backend`; ref is the host bigint fold; fake auto-accepts
+(structural crypto, like the rest of the fake plane). Backends are
+byte-identical on real tiers and fail over tpu -> xla-host -> ref
+through the guarded executor, matching `_verify_blob_batch_inner`.
+
+A batch item is the 4-tuple (commitment_bytes48, cell_index,
+cell_bytes, proof_bytes48). Batches normally arrive here through the
+verification bus's `submit_cells` path under the closed-vocabulary
+"da_cells" consumer label.
+"""
+
+import time
+
+from lighthouse_tpu.common import device_attribution as attribution
+from lighthouse_tpu.common import slot_budget
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.tracing import span
+from lighthouse_tpu.crypto.constants import R
+from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
+from lighthouse_tpu.crypto.ref_curve import G2 as G2_GROUP
+from lighthouse_tpu.crypto.ref_pairing import multi_pairing_is_one
+from lighthouse_tpu.da.domain import (
+    BYTES_PER_FIELD_ELEMENT,
+    CellGeometry,
+    DaError,
+)
+from lighthouse_tpu.da import erasure
+from lighthouse_tpu.device_plane import GUARD, host_device_scope, pow2_bucket
+from lighthouse_tpu.kzg.api import (
+    _decompress_checked,
+    _g1_lincomb,
+    _rlc_scalars,
+    _setup_for,
+    blob_to_polynomial,
+)
+from lighthouse_tpu.kzg.api import _msm_backend
+from lighthouse_tpu.kzg.trusted_setup import TrustedSetup
+
+from lighthouse_tpu.bls.point_serde import g1_compress
+
+_CELL_BATCHES = REGISTRY.counter_vec(
+    "lighthouse_tpu_da_cell_batches_total",
+    "DA cell-proof batches verified, by backend and outcome",
+    ("backend", "result"),
+)
+_CELL_PROOFS = REGISTRY.counter(
+    "lighthouse_tpu_da_cell_proofs_verified_total",
+    "individual cell proofs folded into verified batches",
+)
+_VERIFY_SECONDS = REGISTRY.histogram_vec(
+    "lighthouse_tpu_da_cell_verify_seconds",
+    "DA cell batch verification wall time by backend",
+    ("backend",),
+)
+
+
+def cell_to_ints(cell: bytes, geo: CellGeometry) -> list:
+    cell = bytes(cell)
+    if len(cell) != geo.cell_bytes:
+        raise DaError(
+            f"cell is {len(cell)} bytes, geometry wants {geo.cell_bytes}"
+        )
+    out = []
+    for i in range(0, len(cell), BYTES_PER_FIELD_ELEMENT):
+        v = int.from_bytes(cell[i : i + BYTES_PER_FIELD_ELEMENT], "big")
+        if v >= R:
+            raise DaError("cell element is not a canonical field element")
+        out.append(v)
+    return out
+
+
+def cells_from_evals(evals, geo: CellGeometry) -> list:
+    """2n extended evaluations -> num_cells cell byte strings (strided
+    coset order, da.domain)."""
+    if len(evals) != geo.ext_elements:
+        raise DaError(
+            f"{len(evals)} evaluations, geometry wants {geo.ext_elements}"
+        )
+    cells = []
+    for k in range(geo.num_cells):
+        cells.append(
+            b"".join(
+                (evals[i] % R).to_bytes(BYTES_PER_FIELD_ELEMENT, "big")
+                for i in geo.cell_indices(k)
+            )
+        )
+    return cells
+
+
+def _divide_by_vanishing(poly, m: int, c_k: int):
+    """p(X) = q(X) * (X^m - c_k) + rem(X), deg rem < m. One synthetic
+    long division; rem IS the cell interpolant I_k."""
+    n = len(poly)
+    rem = [v % R for v in poly] + [0] * max(0, m - n)
+    q = [0] * max(0, n - m)
+    for i in range(n - 1, m - 1, -1):
+        q[i - m] = rem[i]
+        rem[i - m] = (rem[i - m] + c_k * rem[i]) % R
+        rem[i] = 0
+    return q, rem[:m]
+
+
+def compute_cells(
+    blob: bytes,
+    geo: CellGeometry,
+    backend: str = "ref",
+    consumer: str | None = None,
+) -> list:
+    """Blob -> num_cells cell byte strings (extension on the selected
+    backend; single-blob convenience over `erasure.extend_blobs`)."""
+    evals = erasure.extend_blobs(
+        [blob], geo, backend=backend, consumer=consumer
+    )[0]
+    return cells_from_evals(evals, geo)
+
+
+def compute_cells_and_kzg_proofs(
+    blob: bytes,
+    geo: CellGeometry,
+    setup: TrustedSetup | None = None,
+    backend: str = "ref",
+    consumer: str | None = None,
+) -> tuple:
+    """(cells, proofs) for one blob — the column-sidecar production
+    path. The extension and each quotient-commitment MSM run on the
+    selected backend (fake: extension is still real data, proofs are
+    the structural identity point — the fake verifier accepts them)."""
+    poly = blob_to_polynomial(blob)
+    setup = _setup_for(geo.blob_elements, setup)
+    evals = erasure.extend_blobs(
+        [blob], geo, backend=backend, consumer=consumer
+    )[0]
+    cells = cells_from_evals(evals, geo)
+    proofs = []
+    m = geo.cell_elements
+    with span("da/cell_proofs", n_cells=geo.num_cells, backend=backend):
+        for k in range(geo.num_cells):
+            q, _rem = _divide_by_vanishing(poly, m, geo.vanishing_const(k))
+            if q:
+                pt = _msm_backend(q, setup, backend, consumer=consumer)
+            else:
+                pt = G1_GROUP.infinity  # deg p < m: zero quotient
+            proofs.append(g1_compress(pt))
+    return cells, proofs
+
+
+def _fold_inputs(items, geo: CellGeometry, seed):
+    """Shared host front half of both real verify backends: RLC
+    scalars, policy-checked decompressed points, vanishing constants,
+    and the folded interpolant polynomial sum r_k * I_k."""
+    n = len(items)
+    rs = _rlc_scalars(n, seed)
+    cs, ws, rzs = [], [], []
+    m = geo.cell_elements
+    interp_acc = [0] * m
+    for r, (comm, k, cell, proof) in zip(rs, items, strict=True):
+        cs.append(_decompress_checked(comm, "commitment"))
+        ws.append(_decompress_checked(proof, "cell proof"))
+        rzs.append(r * geo.vanishing_const(k) % R)
+        ys = cell_to_ints(cell, geo)
+        i_k = erasure.lagrange_coeffs(geo.cell_points(k), ys)
+        for d in range(m):
+            interp_acc[d] = (interp_acc[d] + r * i_k[d]) % R
+    return rs, cs, ws, rzs, interp_acc
+
+
+def _verify_cells_ref(items, geo, setup, seed) -> bool:
+    rs, cs, ws, rzs, interp_acc = _fold_inputs(items, geo, seed)
+    m = geo.cell_elements
+    with span("da/cell_rlc_fold", n=len(items)):
+        lhs = G1_GROUP.infinity
+        w_sum = G1_GROUP.infinity
+        for r, rz, c, w in zip(rs, rzs, cs, ws, strict=True):
+            lhs = G1_GROUP.add(lhs, G1_GROUP.mul_scalar(c, r))
+            lhs = G1_GROUP.add(lhs, G1_GROUP.mul_scalar(w, rz))
+            w_sum = G1_GROUP.add(w_sum, G1_GROUP.mul_scalar(w, r))
+        interp_commit = _g1_lincomb(setup.g1_powers[:m], interp_acc)
+        lhs = G1_GROUP.add(lhs, G1_GROUP.neg(interp_commit))
+    pairs = [
+        (G1_GROUP.to_affine(lhs), G2_GROUP.to_affine(G2_GROUP.generator)),
+        (G1_GROUP.to_affine(G1_GROUP.neg(w_sum)), setup.tau_g2_power(m)),
+    ]
+    return multi_pairing_is_one(pairs)
+
+
+def verify_cell_proof_batch(
+    items,
+    geo: CellGeometry,
+    backend: str = "ref",
+    setup: TrustedSetup | None = None,
+    seed: int | None = None,
+    consumer: str | None = None,
+) -> bool:
+    """Batch cell-availability check: N (commitment, cell_index, cell,
+    proof) items in ONE two-pair pairing identity (any N). Empty
+    batches verify. Soundness matches the blob batch: independent r_k
+    per call, a single bad cell breaks the fold except with probability
+    ~2^-RAND_BITS."""
+    items = list(items)
+    for it in items:
+        if len(it) != 4:
+            raise DaError(
+                "cell batch item must be (commitment, index, cell, proof)"
+            )
+    if not items:
+        return True
+    setup = _setup_for(geo.blob_elements, setup)
+    n = len(items)
+    t0 = time.perf_counter()
+    # slot-budget dispatch mark for EVERY backend tier, same stand-in
+    # convention as the blob-KZG settle (kzg/api.py)
+    _budget_tok = slot_budget.open_dispatch("da_cells", kind="da")
+    try:
+        result = _verify_cells_inner(
+            items, geo, backend, setup, seed, consumer
+        )
+    finally:
+        slot_budget.close_dispatch(_budget_tok)
+    if backend != "tpu":
+        attribution.note_batch(
+            consumer, "da_cells", lanes=None, live=n,
+            duration_s=time.perf_counter() - t0,
+        )
+    _CELL_BATCHES.labels(backend, "ok" if result else "fail").inc()
+    if result:
+        _CELL_PROOFS.inc(n)
+    return result
+
+
+def _verify_cells_inner(items, geo, backend, setup, seed, consumer) -> bool:
+    with _VERIFY_SECONDS.labels(backend).time(), span(
+        "da/verify_cells", n=len(items), backend=backend
+    ):
+        if backend == "fake":
+            result = True
+        elif backend == "ref":
+            result = _verify_cells_ref(items, geo, setup, seed)
+        elif backend == "tpu":
+            from lighthouse_tpu.da.tpu_backend import (
+                verify_cell_proof_batch_tpu,
+            )
+
+            def device_attempt(plan):
+                return bool(
+                    plan.verdict(
+                        bool(
+                            verify_cell_proof_batch_tpu(
+                                items, geo, setup=setup, seed=seed,
+                                consumer=consumer,
+                            )
+                        )
+                    )
+                )
+
+            def xla_host_tier():
+                with host_device_scope():
+                    return bool(
+                        verify_cell_proof_batch_tpu(
+                            items, geo, setup=setup, seed=seed,
+                            consumer=consumer,
+                        )
+                    )
+
+            def ref_tier():
+                return _verify_cells_ref(items, geo, setup, seed)
+
+            result = GUARD.dispatch(
+                "da_cells",
+                pow2_bucket(len(items)),
+                device_attempt,
+                fallbacks=[
+                    ("xla-host", xla_host_tier),
+                    ("ref", ref_tier),
+                ],
+            )
+        else:
+            raise DaError(f"unknown DA backend {backend!r}")
+    return result

@@ -1,0 +1,168 @@
+"""TPU backend for the DA plane: host marshal -> device.
+
+Two device workloads:
+
+* `rs_extend_tpu` — the Reed-Solomon extension. Blob coefficients pack
+  into `ops.rfield` Montgomery bundles (the mod-r twin of the mod-p
+  fieldb layout), blob lanes pad to a power-of-two bucket with zero
+  polynomials (a zero polynomial evaluates to zero everywhere, so
+  padding cannot perturb live lanes), and ONE `ops.rs_extend` Horner
+  scan evaluates every (point, blob) pair. Output unpacks to plain
+  canonical ints, byte-identical to the host oracle.
+
+* `verify_cell_proof_batch_tpu` — cell multiproof verification. The
+  coset fold (da/cells.py docstring) has the exact lane layout of the
+  blob-proof kernel, so this marshal REUSES the jitted
+  `ops/kzg_verify.verify_kzg_proof_batch` graph from kzg/tpu_backend:
+  lanes [C | W(r*c_k) | W(r)], the folded interpolant commitment as
+  the aux lane, and [tau^m]G2 as the G2 pair. One kernel, two
+  workloads — the graphs cannot drift.
+
+Lane counts bucket to powers of two (pow2-lane discipline, same policy
+as bls/kzg tpu backends).
+"""
+
+import time
+
+import numpy as np
+
+from lighthouse_tpu.common import device_attribution as attribution
+from lighthouse_tpu.common.compile_ledger import LEDGER
+from lighthouse_tpu.common.metrics import REGISTRY
+from lighthouse_tpu.common.tracing import span
+from lighthouse_tpu.crypto.constants import R
+from lighthouse_tpu.crypto.ref_curve import G1 as G1_GROUP
+from lighthouse_tpu.da import cells as _cells
+from lighthouse_tpu.da.domain import CellGeometry
+from lighthouse_tpu.kzg import tpu_backend as _kzg_tpu
+from lighthouse_tpu.kzg.api import _g1_lincomb
+from lighthouse_tpu.ops import rfield as rf
+
+_EXTEND_BATCHES = REGISTRY.counter_vec(
+    "lighthouse_tpu_da_extend_device_batches_total",
+    "RS-extension device dispatches by bucketed blob lane count",
+    ("lanes",),
+)
+_CELL_DEVICE_BATCHES = REGISTRY.counter_vec(
+    "lighthouse_tpu_da_cell_device_batches_total",
+    "DA cell-verify device dispatches by bucketed lane count",
+    ("lanes",),
+)
+
+MIN_BUCKET = 2
+
+_EXTEND_JIT: list = []
+
+
+def _get_extend_fn():
+    if not _EXTEND_JIT:
+        import jax
+
+        from lighthouse_tpu.ops.rs_extend import rs_extend_graph
+
+        _EXTEND_JIT.append(jax.jit(rs_extend_graph))
+    return _EXTEND_JIT[0]
+
+
+def _bucket(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+def rs_extend_tpu(
+    polys, geo: CellGeometry, consumer: str | None = None
+) -> list:
+    """Batched device extension: list of coefficient lists -> list of
+    2n-long evaluation lists (plain canonical ints)."""
+    n = len(polys)
+    n_coeff = geo.blob_elements
+    bucket = _bucket(n)
+    with span("da/extend_marshal", n_blobs=n, lanes=bucket):
+        # (N_COEFF, BLOBS, NB): coefficient-major so the Horner scan
+        # indexes one leading-axis slice per step
+        coeffs = np.zeros((n_coeff, bucket, rf.NB), dtype=np.int32)
+        for b, poly in enumerate(polys):
+            coeffs[:, b, :] = rf.pack_ints(poly)
+        coeffs_mont = rf.to_mont(coeffs)
+        points_mont = rf.to_mont(rf.pack_ints(geo.ext_points))
+
+    _EXTEND_BATCHES.labels(str(bucket)).inc()
+    with span("da/extend_device", lanes=bucket):
+        fn = _get_extend_fn()
+        t0 = time.perf_counter()
+        out = np.asarray(fn(coeffs_mont, points_mont))
+        LEDGER.note_dispatch(
+            "rs_extend", fn, (), f"blobs{bucket}",
+            time.perf_counter() - t0,
+        )
+    attribution.note_batch(
+        consumer,
+        "rs_extend",
+        lanes=bucket,
+        live=n,
+        duration_s=time.perf_counter() - t0,
+    )
+    # (PTS, BLOBS, NB) plain canonical -> per-blob int lists
+    flat = rf.unpack_ints(out[:, :n, :])  # point-major
+    return [
+        [flat[p * n + b] for p in range(geo.ext_elements)]
+        for b in range(n)
+    ]
+
+
+def verify_cell_proof_batch_tpu(
+    items,
+    geo: CellGeometry,
+    setup=None,
+    seed=None,
+    consumer: str | None = None,
+) -> bool:
+    """Device cell-multiproof fold, reusing the blob-proof kernel (see
+    module docstring for the lane mapping)."""
+    rs, cs, ws, rzs, interp_acc = _cells._fold_inputs(items, geo, seed)
+    n = len(items)
+    m = geo.cell_elements
+
+    with span("da/cell_marshal", n_cells=n):
+        bucket = _bucket(n)
+        pad = bucket - n
+        c_affs = [G1_GROUP.to_affine(c) for c in cs]
+        w_affs = [G1_GROUP.to_affine(w) for w in ws]
+        # lane layout: [C (r) | pad] + [W (r*c_k) | pad] + [W (r) | pad]
+        lane_affs = (
+            c_affs + [None] * pad
+            + w_affs + [None] * pad
+            + w_affs + [None] * pad
+        )
+        lane_scalars = rs + [0] * pad + rzs + [0] * pad + rs + [0] * pad
+        pts_aff, lane_mask = _kzg_tpu._pack_g1(lane_affs)
+        bits = _kzg_tpu._scalar_bits(lane_scalars)
+
+        # aux lane: -commit(sum r_k I_k) — one size-m host MSM over the
+        # setup's G1 powers (m is the cell size: tiny)
+        aux_pt = G1_GROUP.neg(
+            _g1_lincomb(setup.g1_powers[:m], interp_acc)
+        )
+        aux_aff, aux_mask = _kzg_tpu._pack_g1([G1_GROUP.to_affine(aux_pt)])
+        tau_g2 = _kzg_tpu._pack_g2_point(setup.tau_g2_power(m))
+
+    _CELL_DEVICE_BATCHES.labels(str(3 * bucket)).inc()
+    with span("da/cell_device", lanes=3 * bucket):
+        fn = _kzg_tpu._get_fn()
+        t0 = time.perf_counter()
+        ok = fn(pts_aff, bits, lane_mask, aux_aff, aux_mask, tau_g2)
+        LEDGER.note_dispatch(
+            "da_cell_verify", fn, _kzg_tpu._impl_key(),
+            f"lanes{3 * bucket}", time.perf_counter() - t0,
+        )
+        result = bool(np.asarray(ok))
+    attribution.note_batch(
+        consumer,
+        "da_cells",
+        lanes=3 * bucket,
+        live=3 * n,
+        duration_s=time.perf_counter() - t0,
+    )
+    return result

@@ -339,6 +339,52 @@ class Harness:
             )
         return out
 
+    def make_data_column_sidecars(self, signed_block, blobs):
+        """Build the FULL column-sidecar set for a block produced with
+        blob_kzg_commitments (PeerDAS shape): every blob is RS-extended
+        and cut into cells (da/), column k collects cell k of every
+        blob plus its per-cell KZG proofs, and the signed header binds
+        each column to the block root. Deterministic — re-running over
+        the same blobs yields byte-identical sidecars, which is what
+        lets reconstruction-regenerated columns re-serve cleanly."""
+        from lighthouse_tpu.da import cells as da_cells
+        from lighthouse_tpu.da import geometry_for_spec
+
+        geo = geometry_for_spec(self.spec)
+        t = self.t
+        msg = signed_block.message
+        header = t.SignedBeaconBlockHeader(
+            message=t.BeaconBlockHeader(
+                slot=msg.slot,
+                proposer_index=msg.proposer_index,
+                parent_root=bytes(msg.parent_root),
+                state_root=bytes(msg.state_root),
+                body_root=type(msg.body).hash_tree_root(msg.body),
+            ),
+            signature=bytes(signed_block.signature),
+        )
+        commitments = [
+            bytes(c) for c in msg.body.blob_kzg_commitments
+        ]
+        per_blob = [
+            da_cells.compute_cells_and_kzg_proofs(
+                bytes(blob), geo, consumer="da_cells"
+            )
+            for blob in blobs
+        ]
+        out = []
+        for k in range(geo.num_cells):
+            out.append(
+                t.DataColumnSidecar(
+                    index=k,
+                    column=[cells[k] for cells, _ in per_blob],
+                    kzg_commitments=commitments,
+                    kzg_proofs=[proofs[k] for _, proofs in per_blob],
+                    signed_block_header=header,
+                )
+            )
+        return out
+
     def run_slots(self, n: int):
         start = self.state.slot + 1
         for slot in range(start, start + n):

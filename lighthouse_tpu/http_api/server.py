@@ -1051,6 +1051,45 @@ class BeaconApiServer:
                     "recent": recorder.recent(limit),
                 }
             }
+        if parts[:3] == ["lighthouse", "da", "columns"] and len(
+            parts
+        ) >= 4:
+            # GET /lighthouse/da/columns/{block_id}[?indices=..]: the
+            # verified column sidecars a column-mode node currently
+            # SERVES (held in the column checker until finality
+            # pruning), scoped to the node's custody assignment when a
+            # node handle is wired — the surface DAS samplers poll. A
+            # root nobody imported resolves to an empty list (that
+            # absence IS the withholding signal), never a 404.
+            ident = parts[3]
+            if ident.startswith("0x"):
+                try:
+                    root = bytes.fromhex(ident[2:])
+                except ValueError:
+                    raise ApiError(400, "invalid block root") from None
+            else:
+                block = self._resolve_block(ident)
+                root = type(block.message).hash_tree_root(
+                    block.message
+                )
+            cols_fn = getattr(chain.da_checker, "columns_for", None)
+            cols = cols_fn(root) if cols_fn is not None else []
+            node = getattr(self, "node", None)
+            if node is not None and getattr(node, "column_mode", False):
+                custody = set(node.custody_columns)
+                cols = [
+                    sc for sc in cols if int(sc.index) in custody
+                ]
+            q = self._query(path)
+            if "indices" in q:
+                try:
+                    wanted = {
+                        int(i) for i in q["indices"].split(",") if i
+                    }
+                except ValueError:
+                    raise ApiError(400, "invalid indices") from None
+                cols = [sc for sc in cols if int(sc.index) in wanted]
+            return {"data": [to_json(type(sc), sc) for sc in cols]}
         if parts[:3] == ["lighthouse", "tpu", "stats"]:
             # lighthouse namespace analog: process + chain internals
             return {
@@ -1579,6 +1618,18 @@ class BeaconApiServer:
         except Exception:
             doc["hardware_measurements"] = None
         node = getattr(self, "node", None)
+        if getattr(node, "column_mode", False):
+            # DAS view: deterministic custody assignment plus the
+            # sampler's issued/satisfied/flagged counters when a
+            # sampler is attached (the sim's DasSampler registers
+            # itself on the node)
+            doc["da"]["custody"] = {
+                "subnets": list(node.custody_subnets),
+                "columns": list(node.custody_columns),
+            }
+            sampler = getattr(node, "das_sampler", None)
+            if sampler is not None:
+                doc["da"]["sampling"] = sampler.stats()
         processor = getattr(node, "processor", None)
         if processor is not None:
             doc["queues"] = processor.queue_depths()

@@ -44,10 +44,45 @@ class TrustedSetup:
     _window_tables: dict = field(
         default_factory=dict, compare=False, repr=False
     )
+    # dev setups remember tau so derived G2 powers ([tau^m]G2 for the
+    # DA cell-multiproof pairing) can be computed on demand; a ceremony
+    # setup would ship these points explicitly and leaves this None.
+    _dev_tau: int | None = field(default=None, compare=False, repr=False)
+    _g2_power_cache: dict = field(
+        default_factory=dict, compare=False, repr=False
+    )
 
     @property
     def g1_generator(self):
         return self.g1_powers[0]
+
+    def tau_g2_power(self, m: int) -> tuple:
+        """[tau^m]G2 as an affine twist point — the second pairing
+        input of the coset-folded cell multiproof check (`da.cells`),
+        where the vanishing polynomial of a size-m cell coset is
+        X^m - c_k. m = 1 is the classic [tau]G2.
+
+        Dev setups derive the point from the known tau; a ceremony
+        setup must provide the monomial G2 powers (c-kzg's
+        trusted_setup.txt ships 65) — raise loudly rather than guess.
+        """
+        if m == 1:
+            return self.tau_g2
+        hit = self._g2_power_cache.get(m)
+        if hit is not None:
+            return hit
+        if self._dev_tau is None:
+            raise ValueError(
+                f"trusted setup does not carry [tau^{m}]G2 (ceremony "
+                "setups must ship monomial G2 powers for DA cells)"
+            )
+        pt = G2_GROUP.to_affine(
+            G2_GROUP.mul_scalar(
+                G2_GROUP.generator, pow(self._dev_tau, m, R)
+            )
+        )
+        self._g2_power_cache[m] = pt
+        return pt
 
     def g1_window_table(self, n_points: int, c: int) -> tuple:
         """Digit-multiple table for the device fixed-base MSM
@@ -155,6 +190,7 @@ def dev_setup(size: int, tau: int = DEV_TAU) -> TrustedSetup:
         tau_g2=G2_GROUP.to_affine(
             G2_GROUP.mul_scalar(G2_GROUP.generator, tau)
         ),
+        _dev_tau=tau,
     )
     if tau == DEV_TAU:
         _CACHE[size] = setup

@@ -66,6 +66,9 @@ PRIORITIES = {
     # sidecars drain right after blocks: a held block's import latency
     # is bounded by its slowest sidecar (deneb queue ordering)
     "gossip_blob_sidecar": 1,
+    # column sidecars share the sidecar tier: a column-mode block's
+    # import latency is bounded by its slowest 50%-threshold column
+    "gossip_data_column": 1,
     "chain_segment": 1,
     "gossip_aggregate": 2,
     "gossip_attestation": 3,
@@ -78,6 +81,7 @@ PRIORITIES = {
 DEFAULT_BOUNDS = {
     "gossip_block": 1024,
     "gossip_blob_sidecar": 4096,
+    "gossip_data_column": 4096,
     "chain_segment": 64,
     "gossip_aggregate": 4096,
     "gossip_attestation": 16384,
@@ -98,7 +102,12 @@ AGGREGATE_BATCH_MAX = 64
 # forensic query needs), and each drained batch lands one
 # processor_batch event.
 _JOURNALED_ENQUEUE_KINDS = frozenset(
-    {"gossip_block", "gossip_blob_sidecar", "chain_segment"}
+    {
+        "gossip_block",
+        "gossip_blob_sidecar",
+        "gossip_data_column",
+        "chain_segment",
+    }
 )
 
 

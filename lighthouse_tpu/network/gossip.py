@@ -41,6 +41,17 @@ def compute_blob_subnet(index: int, subnet_count: int) -> int:
     return int(index) % max(int(subnet_count), 1)
 
 
+def data_column_sidecar_topic_name(subnet_id: int) -> str:
+    """`data_column_sidecar_{subnet_id}` — the PeerDAS column topics; a
+    column's subnet is its index modulo DATA_COLUMN_SIDECAR_SUBNET_COUNT
+    (compute_subnet_for_data_column_sidecar)."""
+    return f"data_column_sidecar_{subnet_id}"
+
+
+def compute_column_subnet(index: int, subnet_count: int) -> int:
+    return int(index) % max(int(subnet_count), 1)
+
+
 def message_id(data: bytes) -> bytes:
     return hashlib.sha256(data).digest()[:20]
 

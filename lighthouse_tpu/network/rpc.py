@@ -52,6 +52,8 @@ class BlocksByRangeRequest(ssz.Container):
 MAX_REQUEST_BLOCKS = 1024
 # deneb p2p: MAX_REQUEST_BLOCKS_DENEB (128) * MAX_BLOBS_PER_BLOCK (6)
 MAX_REQUEST_BLOB_SIDECARS = 768
+# PeerDAS p2p: MAX_REQUEST_BLOCKS_DENEB (128) * NUMBER_OF_COLUMNS (128)
+MAX_REQUEST_DATA_COLUMN_SIDECARS = 16384
 
 
 class BlobIdentifier(ssz.Container):
@@ -64,8 +66,23 @@ class BlobIdentifier(ssz.Container):
     index: ssz.uint64
 
 
+class DataColumnIdentifier(ssz.Container):
+    """(block_root, index) — the by-root request key for one COLUMN
+    sidecar (PeerDAS p2p DataColumnIdentifier), wire-local like
+    BlobIdentifier above."""
+
+    block_root: ssz.bytes32
+    index: ssz.uint64
+
+
 class BlobSidecarsByRootRequest(ssz.Container):
     identifiers: ssz.List(BlobIdentifier, MAX_REQUEST_BLOB_SIDECARS)
+
+
+class DataColumnSidecarsByRootRequest(ssz.Container):
+    identifiers: ssz.List(
+        DataColumnIdentifier, MAX_REQUEST_DATA_COLUMN_SIDECARS
+    )
 
 
 class BlobSidecarsByRangeRequest(ssz.Container):
@@ -83,6 +100,7 @@ QUOTAS = {
     "blocks_by_root": (128, 10),
     "blob_sidecars_by_range": (MAX_REQUEST_BLOB_SIDECARS, 10),
     "blob_sidecars_by_root": (MAX_REQUEST_BLOB_SIDECARS, 10),
+    "data_column_sidecars_by_root": (MAX_REQUEST_DATA_COLUMN_SIDECARS, 10),
 }
 
 _RPC_REQUESTS = REGISTRY.counter_vec(
@@ -93,6 +111,10 @@ _RPC_REQUESTS = REGISTRY.counter_vec(
 _RPC_SIDECARS_SERVED = REGISTRY.counter(
     "lighthouse_tpu_rpc_blob_sidecars_served_total",
     "blob sidecars served over the by_range/by_root req/resp methods",
+)
+_RPC_COLUMNS_SERVED = REGISTRY.counter(
+    "lighthouse_tpu_rpc_data_columns_served_total",
+    "data-column sidecars served over the by_root req/resp method",
 )
 
 
@@ -254,6 +276,38 @@ class RpcServer:
                 if int(sc.index) in indices:
                     out.append(sc)
         _RPC_SIDECARS_SERVED.inc(len(out))
+        return out
+
+    @_counted("data_column_sidecars_by_root")
+    def data_column_sidecars_by_root(self, peer_id: str, identifiers):
+        """Serve verified (or reconstructed) column sidecars for
+        explicit (block_root, index) keys — the column-mode twin of
+        blob_sidecars_by_root behind unknown-parent recovery and range
+        sync. A column node holds every released block's FULL column
+        set until finalization prunes it (the checker reconstructs the
+        missing half at release), so any node that imported the block
+        can serve any index. Blob-mode nodes hold no columns and
+        answer empty."""
+        identifiers = list(identifiers)[:MAX_REQUEST_DATA_COLUMN_SIDECARS]
+        self._limit(
+            peer_id,
+            "data_column_sidecars_by_root",
+            float(len(identifiers) or 1),
+        )
+        columns_for = getattr(self.chain.da_checker, "columns_for", None)
+        if columns_for is None:
+            return []
+        wanted: dict[bytes, set] = {}
+        for ident in identifiers:
+            wanted.setdefault(bytes(ident.block_root), set()).add(
+                int(ident.index)
+            )
+        out = []
+        for root, indices in wanted.items():
+            for sc in columns_for(root):
+                if int(sc.index) in indices:
+                    out.append(sc)
+        _RPC_COLUMNS_SERVED.inc(len(out))
         return out
 
     @_counted("blob_sidecars_by_range")

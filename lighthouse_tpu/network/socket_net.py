@@ -52,6 +52,7 @@ from lighthouse_tpu.network.rpc import (
     BlobSidecarsByRangeRequest,
     BlobSidecarsByRootRequest,
     BlocksByRangeRequest,
+    DataColumnSidecarsByRootRequest,
     Goodbye,
     MetaData,
     Ping,
@@ -195,6 +196,19 @@ class RpcClientProxy:
         )
         return [
             self.net.t.BlobSidecar.decode(frame_decompress(c))
+            for c in chunks
+        ]
+
+    def data_column_sidecars_by_root(self, caller: str, identifiers):
+        req = DataColumnSidecarsByRootRequest(
+            identifiers=list(identifiers)
+        )
+        chunks = self._call(
+            "data_column_sidecars_by_root",
+            frame_compress(req.to_bytes()),
+        )
+        return [
+            self.net.t.DataColumnSidecar.decode(frame_decompress(c))
             for c in chunks
         ]
 
@@ -825,6 +839,14 @@ class SocketNet:
                 frame_decompress(payload)
             )
             sidecars = srv.blob_sidecars_by_root(
+                peer_id, req.identifiers
+            )
+            return [frame_compress(sc.to_bytes()) for sc in sidecars]
+        if method == "data_column_sidecars_by_root":
+            req = DataColumnSidecarsByRootRequest.decode(
+                frame_decompress(payload)
+            )
+            sidecars = srv.data_column_sidecars_by_root(
                 peer_id, req.identifiers
             )
             return [frame_compress(sc.to_bytes()) for sc in sidecars]

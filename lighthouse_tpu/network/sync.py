@@ -47,6 +47,7 @@ from lighthouse_tpu.network.rpc import (
     BlobIdentifier,
     BlobSidecarsByRangeRequest,
     BlocksByRangeRequest,
+    DataColumnIdentifier,
     RateLimitExceeded,
     RpcError,
 )
@@ -649,6 +650,10 @@ class SyncManager:
                 needed[root] = (sb, missing)
         if not needed:
             return True
+        if getattr(da, "put_column", None) is not None:
+            # column mode: blob sidecars don't exist on this node's
+            # wire — pull each block's missing columns by root instead
+            return self._fetch_segment_columns(needed, block_peer)
 
         needed_keys = {
             (root, i)
@@ -943,6 +948,9 @@ class SyncManager:
         missing = self.chain.da_checker.missing_indices(root, block)
         if not missing:
             return
+        if getattr(self.chain.da_checker, "put_column", None) is not None:
+            self._fetch_lookup_columns(pid, rpc, root, block)
+            return
         idents = [
             BlobIdentifier(block_root=root, index=i)
             for i in sorted(missing)
@@ -967,3 +975,100 @@ class SyncManager:
             {root: (block, missing)},
             foreign_reason="foreign_sidecar",
         )
+
+    def _fetch_lookup_columns(self, pid, rpc, root: bytes, block):
+        """Column-mode twin of the blob lookup fetch: pull the missing
+        column sidecars for a by-root block from the same peer and
+        route them through the chain's column entry point. The
+        structural binding rule is the blob plane's: a column whose
+        header does not carry EXACTLY the served block's signature is
+        a scored offense, and the accepted header needs no extra
+        pairing because the block's own proposal check covers it.
+        Crossing the 50% threshold inside this loop releases (and
+        imports) the held block; the caller's process_block then hits
+        the known-block gate, which lookup_parent treats as success."""
+        da = self.chain.da_checker
+        missing = da.missing_indices(root, block)
+        if not missing:
+            return
+        idents = [
+            DataColumnIdentifier(block_root=root, index=i)
+            for i in sorted(missing)
+        ]
+        try:
+            with span("sync/data_column_sidecars_by_root", peer=pid):
+                sidecars = rpc.data_column_sidecars_by_root(
+                    self._caller(), idents
+                )
+        except RateLimitExceeded:
+            _REQUEST_ERRORS.labels(
+                "data_column_sidecars_by_root", "rate_limited"
+            ).inc()
+            return
+        except Exception:
+            _REQUEST_ERRORS.labels(
+                "data_column_sidecars_by_root", "error"
+            ).inc()
+            return
+        fetched = 0
+        for sc in sidecars:
+            hdr = sc.signed_block_header.message
+            if type(hdr).hash_tree_root(hdr) != root:
+                self._downscore(
+                    pid, SCORE_INVALID_MESSAGE, "foreign_sidecar"
+                )
+                continue
+            if int(sc.index) not in missing:
+                continue
+            if bytes(sc.signed_block_header.signature) != bytes(
+                block.signature
+            ):
+                self._downscore(
+                    pid, SCORE_INVALID_MESSAGE, "sidecar_header_mismatch"
+                )
+                continue
+            try:
+                self.chain.process_data_column_sidecar(
+                    sc, verify_header=False
+                )
+                fetched += 1
+            except Exception as e:
+                # duplicates on a retried lookup are expected; real
+                # mismatches surface as DA failures at import
+                _LOG.debug("column ingest skipped: %s", e)
+        if fetched:
+            try:
+                # a block the checker never registered caches the
+                # fetched columns as UNVERIFIED candidates; put_block
+                # settles them in one folded cell batch so
+                # missing_indices reflects the fetch (no-op when the
+                # block was already registered or held)
+                da.put_block(root, block)
+            except Exception as e:
+                _LOG.debug("column settle skipped: %s", e)
+        _SIDECARS_FETCHED.inc(fetched)
+        self.metrics["sidecars_fetched"] += fetched
+
+    def _fetch_segment_columns(self, needed, block_peer) -> bool:
+        """Column-mode twin of `_fetch_segment_sidecars`' fetch half:
+        by-range blob requests have no column analog here, so each
+        blob-committing block in the segment pulls its missing columns
+        by root — from the block's server first, then any other
+        trusted peer. Returns False when some block stays below its
+        50% threshold (the batch must requeue)."""
+        da = self.chain.da_checker
+        ordered = [block_peer] + [
+            p for p in self.peers if p != block_peer
+        ]
+        ok = True
+        for root, (sb, _missing) in needed.items():
+            for pid in ordered:
+                rpc = self.peers.get(pid)
+                if rpc is None or pid in self.quarantined:
+                    continue
+                self._fetch_lookup_columns(pid, rpc, root, sb)
+                if not da.missing_indices(root, sb):
+                    break
+            if da.missing_indices(root, sb):
+                ok = False
+        return ok

@@ -19,6 +19,8 @@ from lighthouse_tpu.network.gossip import (
     SCORE_VALID,
     blob_sidecar_topic_name,
     compute_blob_subnet,
+    compute_column_subnet,
+    data_column_sidecar_topic_name,
     decode_gossip,
     encode_gossip,
     topic,
@@ -38,6 +40,12 @@ _LC_GOSSIP = REGISTRY.counter_vec(
     ("topic", "direction"),
 )
 
+_COLUMNS_CUSTODIED = REGISTRY.gauge_vec(
+    "lighthouse_tpu_da_columns_custodied",
+    "column indices this node custodies (da/custody.py assignment)",
+    ("node",),
+)
+
 
 class BeaconNode:
     def __init__(
@@ -50,6 +58,7 @@ class BeaconNode:
         backend: str = "ref",
         slasher=None,
         anchor_block=None,
+        column_mode: bool = False,
     ):
         """`anchor_block` set = checkpoint-sync boot (`ClientGenesis::
         WeakSubjSszBytes`, client/src/config.rs:31-34): `genesis_state`
@@ -58,6 +67,7 @@ class BeaconNode:
         the anchor."""
         self.node_id = node_id
         self.spec = spec
+        self.column_mode = bool(column_mode)
         self.clock = ManualSlotClock(
             genesis_state.genesis_time, spec.SECONDS_PER_SLOT
         )
@@ -77,7 +87,27 @@ class BeaconNode:
                 kv=kv,
                 backend=backend,
                 slot_clock=self.clock,
+                column_mode=column_mode,
             )
+        if self.column_mode:
+            # deterministic custody assignment (da/custody.py): scopes
+            # what this node advertises/serves and the health report;
+            # subscriptions still cover ALL column subnets (the
+            # full-custody default — see custody.py docstring)
+            from lighthouse_tpu.da import custody as _custody
+
+            self.custody_subnets = _custody.custody_subnets(
+                node_id, spec
+            )
+            self.custody_columns = _custody.custody_columns(
+                node_id, spec
+            )
+            _COLUMNS_CUSTODIED.labels(node_id).set(
+                len(self.custody_columns)
+            )
+        else:
+            self.custody_subnets = ()
+            self.custody_columns = ()
         self.fork_digest = compute_fork_digest(
             spec.fork_version_at_epoch(0),
             bytes(genesis_state.genesis_validators_root),
@@ -132,6 +162,7 @@ class BeaconNode:
             handlers={
                 "gossip_block": self._on_block,
                 "gossip_blob_sidecar": self._on_blob_sidecar,
+                "gossip_data_column": self._on_data_column,
                 "chain_segment": self._on_segment,
                 "gossip_aggregate": self._on_aggregates,
                 "gossip_attestation": self._on_attestations,
@@ -175,9 +206,22 @@ class BeaconNode:
             # light clients anywhere in the mesh hear finality moves
             "light_client_finality_update",
             "light_client_optimistic_update",
-        ) + tuple(
-            blob_sidecar_topic_name(i)
-            for i in range(self.spec.BLOB_SIDECAR_SUBNET_COUNT)
+        ) + (
+            # column mode replaces the blob-sidecar plane wholesale:
+            # DA data moves as column slices on the PeerDAS topics.
+            # Every node follows all column subnets (full custody —
+            # custody.py's assignment scopes serving/advertising)
+            tuple(
+                data_column_sidecar_topic_name(i)
+                for i in range(
+                    self.spec.DATA_COLUMN_SIDECAR_SUBNET_COUNT
+                )
+            )
+            if self.column_mode
+            else tuple(
+                blob_sidecar_topic_name(i)
+                for i in range(self.spec.BLOB_SIDECAR_SUBNET_COUNT)
+            )
         )
 
     def _init_subnet_service(self):
@@ -326,6 +370,15 @@ class BeaconNode:
             self.processor.submit(
                 "gossip_blob_sidecar", (sidecar, from_peer)
             )
+        elif name.startswith("data_column_sidecar"):
+            try:
+                sidecar = self.chain.t.DataColumnSidecar.decode(data)
+            except (ValueError, IndexError):
+                self.hub.report(from_peer, SCORE_INVALID_MESSAGE)
+                return
+            self.processor.submit(
+                "gossip_data_column", (sidecar, from_peer)
+            )
         elif name == "beacon_aggregate_and_proof":
             sap = self.chain.t.SignedAggregateAndProof.decode(data)
             self.processor.submit("gossip_aggregate", (sap, from_peer))
@@ -377,6 +430,23 @@ class BeaconNode:
         self.hub.publish(
             self.node_id,
             topic(self.fork_digest, blob_sidecar_topic_name(sub)),
+            encode_gossip(sidecar.to_bytes()),
+        )
+
+    def publish_data_column_sidecar(self, sidecar):
+        """Route a column sidecar onto its index's subnet topic
+        (compute_subnet_for_data_column_sidecar)."""
+        if self.hub is None:
+            return
+        sub = compute_column_subnet(
+            int(sidecar.index),
+            self.spec.DATA_COLUMN_SIDECAR_SUBNET_COUNT,
+        )
+        self.hub.publish(
+            self.node_id,
+            topic(
+                self.fork_digest, data_column_sidecar_topic_name(sub)
+            ),
             encode_gossip(sidecar.to_bytes()),
         )
 
@@ -511,6 +581,25 @@ class BeaconNode:
         sidecar, from_peer = payload
         try:
             self.chain.process_blob_sidecar(sidecar)
+            if self.hub is not None:
+                self.hub.report(from_peer, SCORE_VALID)
+        except DataAvailabilityError as e:
+            if self.hub is not None:
+                self.hub.report(
+                    from_peer,
+                    SCORE_DUPLICATE
+                    if "duplicate" in str(e)
+                    else SCORE_INVALID_MESSAGE,
+                )
+
+    def _on_data_column(self, payload):
+        from lighthouse_tpu.beacon_chain.data_availability_checker import (
+            DataAvailabilityError,
+        )
+
+        sidecar, from_peer = payload
+        try:
+            self.chain.process_data_column_sidecar(sidecar)
             if self.hub is not None:
                 self.hub.report(from_peer, SCORE_VALID)
         except DataAvailabilityError as e:

@@ -1,0 +1,299 @@
+"""Array-native scalar-field (mod r) arithmetic for the DA plane.
+
+`ops.fieldb` bundles BLS12-381 *base*-field values (mod p, 381 bits).
+Reed-Solomon blob extension works in the *scalar* field (mod r, 255
+bits) — blob polynomials have Fr coefficients and are evaluated over
+roots-of-unity domains in Fr. This module is the same relaxed-limb
+Montgomery machine re-parameterized for r:
+
+- A value is an int32 array `(..., NB)`: NB = 23 limbs of 12 bits
+  (22 limbs cover the 264-bit Montgomery radix, one headroom limb).
+- No tower, no slot axis: Fr is prime. All leading axes are batch.
+
+RELAXED-LIMB INVARIANT (mirrors ops.fieldb — see its docstring for the
+shared machinery; only the numbers differ):
+
+  Every bundle flowing between ops has non-negative limbs <= LIMB_RELAX
+  (4097) and value < 2.3r. Exact canonical limbs/values exist only
+  inside `canon`.
+
+  Why this is sound (numbers: r = 7.2453*2^252, Montgomery radix
+  R_mont = 2^264, r/R_mont = 0.0017688; the reduce_small divisor is 8,
+  with per-quotient-unit error d = 8*2^252 - r = 0.7547*2^252
+  = 0.10417r):
+  * conv products: limbs <= 4097 give per-term products <= 4097^2 and
+    column sums <= 23 * 4097^2 < 2^29 — no int32 overflow.
+  * `reduce_small` subtracts q*r with q = floor(top_two_limbs / 8).
+    Soundness: t2*2^252 <= x (non-negative limbs) and 8*2^252 > r, so
+    q*r <= q*8*2^252 <= x. Remainder: t2 <= 8q + 7 and the relaxed low
+    21 limbs contribute < 1.0005*2^252, so
+    x - q*r < q*d + 8.0005*2^252 < 1.105r + 0.1042r*q.
+  * Montgomery REDC carry across the 2^264 boundary: value(low 22
+    limbs) is = 0 mod 2^264 and < 1.0003*2^264, so it is EXACTLY 0 or
+    2^264 and the carry into the high half is `any(low != 0)`.
+  * Bound closure at 2.3r:
+      mul_lazy: inputs < 2.3r -> T < 5.29 r^2,
+        T/R_mont < 5.29*(r/R_mont)*r = 0.0094r, output
+        < 0.0094r + 1.001r < 1.02r.
+      add: x < 4.6r = 33.4*2^252 -> q <= 4 -> out < 1.53r.
+      sub: x < 2.3r + 32r < 34.3r = 248.6*2^252 -> q <= 31 -> first
+        reduce_small gives < 1.105r + 3.23r = 4.34r, so it reduces
+        TWICE; second pass input < 4.34r = 31.5*2^252 -> q <= 3 ->
+        out < 1.42r.
+    Everything stays < 1.53r < 2.3r, with wide margin (verified
+    adversarially in tests/test_da_plane.py).
+  * SPREAD_SUB (value 32r) spreads its 2-unit limb offsets over limbs
+    0..20 ONLY: any invariant-satisfying value (< 2.3r < 17*2^252,
+    non-negative limbs) has limb 22 == 0 and limb 21 <= 16, so the
+    spread constant needs no headroom above limb 21 — its own limb 21
+    (floor(32r/2^252) - 2 = 229) absorbs the largest possible b limb.
+
+Parity note: the reference client does Fr arithmetic for erasure
+coding inside c-kzg-4844 / rust-eth-kzg; this is that plane re-laid-out
+for VPU execution behind the guarded `rs_extend` dispatch.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.constants import LIMB_BITS, LIMB_MASK, R
+
+NLIMBS = 22  # Montgomery radix limbs: 2^264 (22 * 12) > 2^255 > r
+NB = NLIMBS + 1  # bundle limb count (one headroom limb -> 2^276)
+_TOP = NB - 1
+LIMB_RELAX = LIMB_MASK + 2  # relaxed limb bound (4097)
+
+_R_MONT = 1 << (LIMB_BITS * NLIMBS)  # 2^264
+MONT_ONE = _R_MONT % R
+MONT_R2 = (_R_MONT * _R_MONT) % R
+
+_NPRIME_INT = (-pow(R, -1, _R_MONT)) % _R_MONT
+
+
+def _limbs(v: int, n: int) -> np.ndarray:
+    return np.array(
+        [(v >> (LIMB_BITS * i)) & LIMB_MASK for i in range(n)],
+        dtype=np.int32,
+    )
+
+
+NPRIME_LIMBS = _limbs(_NPRIME_INT, NLIMBS)
+R_LIMBS32 = _limbs(R, NLIMBS)
+
+ZERO_B = np.zeros(NB, dtype=np.int32)
+ONE_MONT_B = _limbs(MONT_ONE, NB)
+R2_B = _limbs(MONT_R2, NB)
+
+# 2^276 - r: adding q copies == subtracting q*r mod 2^276.
+COMP_R = _limbs((1 << (LIMB_BITS * NB)) - R, NB)
+# Canonicalization cond-subtract constants (values < 2.3r need one
+# conditional -2r then one conditional -r).
+COMP_2R = _limbs((1 << (LIMB_BITS * NB)) - 2 * R, NB)
+
+# Subtraction constant: value 32r, limbs spread by two units over limbs
+# 0..20 so a - b + SPREAD_SUB has non-negative limbs for any
+# relaxed-limb b satisfying the invariant (b limb 21 <= 16, limb 22
+# == 0 — see module docstring). Value headroom: a - b + 32r < 34.3r
+# keeps reduce_small's q <= 31.
+SPREAD_SUB = _limbs(32 * R, NB)
+for _i in range(NB - 2):
+    SPREAD_SUB[_i] += 2 << LIMB_BITS
+    SPREAD_SUB[_i + 1] -= 2
+assert SPREAD_SUB.min() >= 0
+assert SPREAD_SUB[: NB - 2].min() >= LIMB_RELAX
+assert SPREAD_SUB[NB - 2] >= 18 and SPREAD_SUB[NB - 1] == 0
+# Invariant premise for the limb-0..20-only spread: 2.3r < 17*2^252.
+assert 23 * R < 170 * (1 << 252)
+
+# Convolution masks (i + j == k), full and low-truncated.
+_CONV_FULL = np.zeros((NB, NB, 2 * NB - 1), dtype=np.int32)
+for _i in range(NB):
+    for _j in range(NB):
+        _CONV_FULL[_i, _j, _i + _j] = 1
+_CONV_LOW = np.zeros((NLIMBS, NLIMBS, NLIMBS), dtype=np.int32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        if _i + _j < NLIMBS:
+            _CONV_LOW[_i, _j, _i + _j] = 1
+_CONV_MR = np.zeros((NLIMBS, NLIMBS, 2 * NLIMBS - 1), dtype=np.int32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _CONV_MR[_i, _j, _i + _j] = 1
+
+
+# ----------------------------------------------------------- carry handling
+
+
+def _pad_last(x, n):
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, n)])
+
+
+def _partial_pass(x):
+    c = x >> LIMB_BITS
+    d = x & LIMB_MASK
+    return d + jnp.pad(c[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+
+
+def _relax(x, out_len, passes=3):
+    """Value-preserving (mod 2^(12*out_len)) relaxation to limbs <= ~4096.
+
+    Same bound chain as ops.fieldb._relax: each pass maps limb bound L
+    to 4095 + (L >> 12); three passes take any L < 2^30 to <= 4096."""
+    in_len = x.shape[-1]
+    if in_len < out_len:
+        x = _pad_last(x, out_len - in_len)
+    elif in_len > out_len:
+        x = x[..., :out_len]
+    for _ in range(passes):
+        x = _partial_pass(x)
+    return x
+
+
+def _ks_resolve(x):
+    """Kogge-Stone carry resolution; limbs must be < 2*4096 (unit
+    carries). Returns (canonical limbs, top carry-out)."""
+    g = x > LIMB_MASK
+    p = x == LIMB_MASK
+    shift = 1
+    L = x.shape[-1]
+    gg, pp = g, p
+    while shift < L:
+        pad = [(0, 0)] * (x.ndim - 1) + [(shift, 0)]
+        gg_prev = jnp.pad(gg[..., :-shift], pad)
+        pp_prev = jnp.pad(pp[..., :-shift], pad)
+        gg = gg | (pp & gg_prev)
+        pp = pp & pp_prev
+        shift *= 2
+    carry_in = jnp.pad(
+        gg[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)]
+    ).astype(jnp.int32)
+    return (x + carry_in) & LIMB_MASK, gg[..., -1]
+
+
+def reduce_small(x):
+    """Relaxed-limbed x (NB limbs) -> value < 1.105r + 0.1042r*q_max,
+    limbs <= 4096. Quotient estimate from the top two limbs against r
+    (r < 8*2^252): q = (x >> 252) // 8 satisfies q*r <= x (see module
+    docstring)."""
+    t2 = x[..., _TOP] * (1 << LIMB_BITS) + x[..., _TOP - 1]
+    q = t2 // 8
+    return _relax(x + q[..., None] * jnp.asarray(COMP_R), NB)
+
+
+def _cond_sub(x, comp_const):
+    """Subtract the complement's value iff x >= value (exact compare).
+    Input limbs must be canonical (callers resolve first)."""
+    s = x + jnp.asarray(comp_const)
+    c = s >> LIMB_BITS
+    d = s & LIMB_MASK
+    top1 = c[..., -1]
+    s = d + jnp.pad(c[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    out, top2 = _ks_resolve(s)
+    ge = (top1 + top2.astype(jnp.int32)) > 0
+    return jnp.where(ge[..., None], out, x)
+
+
+def canon(x):
+    """Lazy value (< 2.3r) -> exact canonical [0, r), canonical limbs."""
+    x, _ = _ks_resolve(x)
+    x = _cond_sub(x, COMP_2R)
+    return _cond_sub(x, COMP_R)
+
+
+# ------------------------------------------------------------- multiplies
+
+
+def mul_lazy(a, b):
+    """Batched Montgomery product: (..., NB) x (..., NB) -> (..., NB);
+    inputs < 2.3r relaxed, output < 1.02r, limbs <= LIMB_RELAX."""
+    t = _relax(
+        jnp.einsum(
+            "...ij,ijk->...k",
+            a[..., :, None] * b[..., None, :],
+            jnp.asarray(_CONV_FULL),
+        ),
+        2 * NB,
+    )
+    t_low = t[..., :NLIMBS]
+    m = _relax(
+        jnp.einsum(
+            "...ij,ijk->...k",
+            t_low[..., :, None] * jnp.asarray(NPRIME_LIMBS)[None, :],
+            jnp.asarray(_CONV_LOW),
+        ),
+        NLIMBS,
+    )
+    mr = jnp.einsum(
+        "...ij,ijk->...k",
+        m[..., :, None] * jnp.asarray(R_LIMBS32)[None, :],
+        jnp.asarray(_CONV_MR),
+    )
+    full = _relax(t + _pad_last(mr, 2 * NB - mr.shape[-1]), 2 * NB)
+    # REDC carry across the 2^264 boundary: value(low 22 limbs) is
+    # exactly 0 or 2^264, so the carry is any(low != 0).
+    low_nonzero = jnp.any(full[..., :NLIMBS] != 0, axis=-1)
+    out = full[..., NLIMBS : NLIMBS + NB]
+    return out.at[..., 0].add(low_nonzero.astype(jnp.int32))
+
+
+def sqr_lazy(a):
+    return mul_lazy(a, a)
+
+
+# ------------------------------------------------------------ add / sub
+
+
+def add(a, b):
+    return reduce_small(_partial_pass(a + b))
+
+
+def sub(a, b):
+    s = a - b + jnp.asarray(SPREAD_SUB)
+    # 34.3r input needs two quotient-estimate passes (see docstring).
+    return reduce_small(reduce_small(_relax(s, NB, passes=2)))
+
+
+def neg(a):
+    return sub(jnp.zeros_like(a), a)
+
+
+# ------------------------------------------------------------- predicates
+
+
+def is_zero(a):
+    return jnp.all(canon(a) == 0, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(canon(a) == canon(b), axis=-1)
+
+
+# --------------------------------------------------------- host converters
+
+
+def pack_ints(values) -> np.ndarray:
+    """Host: list of ints -> (len, NB) canonical limb bundle (plain
+    domain, values reduced mod r)."""
+    return np.stack([_limbs(v % R, NB) for v in values])
+
+
+def unpack_ints(bundle) -> list:
+    out = []
+    arr = np.asarray(bundle)
+    flat = arr.reshape(-1, arr.shape[-1])
+    for row in flat:
+        acc = 0
+        for i, limb in enumerate(row):
+            acc += int(limb) << (LIMB_BITS * i)
+        out.append(acc % R)
+    return out
+
+
+def to_mont(a):
+    return mul_lazy(a, jnp.broadcast_to(jnp.asarray(R2_B), a.shape))
+
+
+def from_mont(a):
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    return canon(mul_lazy(a, one))

@@ -1,0 +1,72 @@
+"""Device Reed-Solomon blob extension: batched Fr polynomial evaluation.
+
+PeerDAS-shaped data availability extends each blob polynomial (n Fr
+coefficients — this codebase keeps blobs in coefficient form, see
+`kzg.api.blob_to_polynomial`) to 2n evaluations over the 2n-th
+roots-of-unity domain in Fr. Any n of the 2n evaluations then determine
+the polynomial, which is what lets nodes reconstruct from 50% of
+columns instead of downloading full sidecars.
+
+The graph is one batched Horner scan over ALL (point, blob) pairs at
+once — `ops.rfield` relaxed-limb Montgomery bundles, no carry
+resolution on the hot path:
+
+    acc[p, b] <- acc[p, b] * x[p] + coeff[i, b]      (i = n-1 .. 0)
+
+Work is O(n) multiplies per point (O(n * 2n) per blob batch). That is
+asymptotically worse than an FFT over the evaluation domain, but at
+devnet blob sizes the whole scan is a handful of fused VPU convolutions
+and the dispatch is dominated by fixed costs; the FFT restructuring for
+mainnet blob counts is the ROADMAP "mainnet blob-count scaling" item.
+
+Host-side policy (domain construction, cell slicing, oracle, guarded
+dispatch) lives in `lighthouse_tpu.da.erasure`; this module is the pure
+jittable graph, verified byte-identical against the host bigint Horner
+oracle in tests/test_da_plane.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.ops import rfield as rf
+
+NB = rf.NB
+
+
+def eval_poly_batch(coeffs, points):
+    """Evaluate a batch of coefficient-form Fr polynomials at a batch
+    of points.
+
+    coeffs: (N_COEFF, BLOBS, NB) int32 — Montgomery-domain canonical
+        bundles, coeffs[i] = coefficient of X^i for every blob.
+    points: (PTS, NB) int32 — Montgomery-domain canonical bundles.
+
+    Returns (PTS, BLOBS, NB) lazy Montgomery-domain evaluations
+    (limbs <= LIMB_RELAX, value < 2.3r); callers `rf.canon` at the
+    host boundary.
+
+    Bound closure per Horner step (see ops.rfield docstring): acc
+    < 1.53r (add output) and points < r feed mul_lazy (< 1.02r out);
+    canonical coeffs (< r) feed add (< 1.53r out).
+    """
+    n_coeff, n_blobs, _ = coeffs.shape
+    n_pts = points.shape[0]
+    x = jnp.broadcast_to(points[:, None, :], (n_pts, n_blobs, NB))
+
+    def body(i, acc):
+        c = jax.lax.dynamic_index_in_dim(
+            coeffs, n_coeff - 1 - i, axis=0, keepdims=False
+        )
+        return rf.add(rf.mul_lazy(acc, x), jnp.broadcast_to(c, acc.shape))
+
+    acc = jnp.zeros((n_pts, n_blobs, NB), dtype=jnp.int32)
+    return jax.lax.fori_loop(0, n_coeff, body, acc)
+
+
+def rs_extend_graph(coeffs, points):
+    """Full RS-extension graph: evaluate + leave the Montgomery domain
+    + canonicalize, so hosts unpack plain ints directly.
+
+    Returns (PTS, BLOBS, NB) canonical-limb plain-domain evaluations.
+    """
+    return rf.from_mont(eval_poly_batch(coeffs, points))

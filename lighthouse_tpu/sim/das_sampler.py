@@ -1,0 +1,237 @@
+"""DAS sampling actor: one per simulated node in column mode.
+
+Role of the reference's PeerDAS sampling loop (`DataAvailability
+Sampling` in the fulu design docs): for every column-carrying block a
+node hears about, probe a few deterministic column indices against its
+peers' serving surfaces and decide — from samples alone, never from
+the proposer's word — whether the data behind the block is actually
+retrievable. A block whose sampled columns stay unserved after the
+sampling deadline is flagged withheld.
+
+The actor is DRIVING machinery (the orchestrator feeds it roots and
+polls it each slot), but its evidence runs through the same planes the
+invariants read: samples are issued against peers' REST
+``/lighthouse/da/columns/{root}?indices=…`` endpoints, every returned
+cell re-verifies through the node's verification bus under the
+``da_cells`` consumer label (trust-but-verify: a lying serving peer is
+a wrong verdict, not a satisfied sample), and every verdict lands in
+the node's journal as a ``das_sample`` event plus the ``da_*`` metric
+families. Sample-index choice is a pure function of (seed, node,
+root), so a replay issues the identical probes.
+"""
+
+import hashlib
+import json
+import urllib.error
+import urllib.request
+
+from lighthouse_tpu.common.logging import get_logger
+from lighthouse_tpu.common.metrics import REGISTRY
+
+_LOG = get_logger("sim.das")
+
+_SAMPLES = REGISTRY.counter_vec(
+    "lighthouse_tpu_da_samples_total",
+    "DAS sampler probes by outcome (issued|satisfied|unsatisfied|"
+    "verify_failed)",
+    ("outcome",),
+)
+_FLAGS = REGISTRY.counter(
+    "lighthouse_tpu_da_withholding_flags_total",
+    "column-carrying blocks a DAS sampler flagged as withheld "
+    "(sampling deadline passed with unserved sampled columns)",
+)
+
+# polls (slots) a sample may stay unserved before the block is flagged
+FLAG_AFTER_POLLS = 2
+
+
+class DasSampler:
+    """Samples column availability for one node against its peers."""
+
+    def __init__(
+        self,
+        name: str,
+        spec,
+        journal,
+        bus,
+        peer_urls,
+        samples_per_slot: int,
+        seed: int = 0,
+        backend: str = "ref",
+    ):
+        """`peer_urls` is a callable returning the base URLs of the
+        node's currently-online peers (the orchestrator's view — a
+        sampler never probes a socket it knows is down)."""
+        from lighthouse_tpu.da.domain import geometry_for_spec
+
+        self.name = name
+        self.geo = geometry_for_spec(spec)
+        self.journal = journal
+        self.bus = bus
+        self.peer_urls = peer_urls
+        self.samples_per_slot = int(samples_per_slot)
+        self.seed = int(seed)
+        self.backend = backend
+        # root hex -> sample state
+        self.pending: dict = {}
+        self.flagged: list = []
+        self.counts = {
+            "issued": 0, "satisfied": 0, "verify_failed": 0,
+        }
+
+    # ------------------------------------------------------------ intake
+
+    def _indices_for(self, root_hex: str) -> list:
+        """Deterministic distinct column indices for (seed, node, root):
+        a seeded hash-chain walk over the column space, so a replayed
+        run probes the identical columns."""
+        want = min(self.samples_per_slot, self.geo.num_cells)
+        out: list = []
+        ctr = 0
+        while len(out) < want:
+            digest = hashlib.sha256(
+                f"{self.seed}:{self.name}:{root_hex}:{ctr}".encode()
+            ).digest()
+            idx = int.from_bytes(digest[:8], "big") % self.geo.num_cells
+            if idx not in out:
+                out.append(idx)
+            ctr += 1
+        return out
+
+    def observe_block(self, root_hex: str, slot: int):
+        """The orchestrator heard a column-carrying block enter the
+        network: issue this node's samples against it."""
+        if root_hex in self.pending or self.samples_per_slot <= 0:
+            return
+        indices = self._indices_for(root_hex)
+        self.pending[root_hex] = {
+            "slot": slot,
+            "indices": indices,
+            "satisfied": set(),
+            "polls": 0,
+        }
+        self.counts["issued"] += len(indices)
+        _SAMPLES.labels("issued").inc(len(indices))
+        self.journal.emit(
+            "das_sample",
+            root=bytes.fromhex(root_hex[2:]),
+            slot=slot,
+            outcome="issued",
+            n=len(indices),
+            indices=",".join(str(i) for i in indices),
+        )
+
+    # ------------------------------------------------------------- probes
+
+    def _fetch_column(self, url: str, root_hex: str, index: int):
+        req = f"{url}/lighthouse/da/columns/{root_hex}?indices={index}"
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                docs = json.loads(r.read())["data"]
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            _LOG.debug("%s sample fetch failed: %s", self.name, e)
+            return None
+        return docs[0] if docs else None
+
+    def _verify_sidecar(self, doc: dict, slot: int) -> bool:
+        """Re-verify a served column's cell proofs through the bus under
+        the da_cells consumer — a sample is satisfied only by data that
+        PROVES against the block's commitments."""
+        index = int(doc["index"])
+        items = [
+            (
+                bytes.fromhex(c[2:]),
+                index,
+                bytes.fromhex(cell[2:]),
+                bytes.fromhex(p[2:]),
+            )
+            for c, cell, p in zip(
+                doc["kzg_commitments"], doc["column"], doc["kzg_proofs"],
+                strict=True,
+            )
+        ]
+        return self.bus.submit_cells(
+            items,
+            self.geo,
+            backend=self.backend,
+            journal=self.journal,
+            slot=slot,
+        )
+
+    def poll(self, slot: int):
+        """One sampling round: probe every unsatisfied index of every
+        pending block against the online peers; flag blocks whose
+        samples outlived the deadline."""
+        for root_hex, st in sorted(self.pending.items()):
+            missing = [
+                i for i in st["indices"] if i not in st["satisfied"]
+            ]
+            if not missing:
+                continue
+            urls = list(self.peer_urls())
+            for index in missing:
+                for url in urls:
+                    doc = self._fetch_column(url, root_hex, index)
+                    if doc is None:
+                        continue
+                    if self._verify_sidecar(doc, slot):
+                        st["satisfied"].add(index)
+                        self.counts["satisfied"] += 1
+                        _SAMPLES.labels("satisfied").inc()
+                        self.journal.emit(
+                            "das_sample",
+                            root=bytes.fromhex(root_hex[2:]),
+                            slot=slot,
+                            outcome="satisfied",
+                            index=index,
+                        )
+                    else:
+                        # served data that fails its own proof: the
+                        # das_no_wrong_verdicts invariant holds this
+                        # counter to zero on honest runs
+                        self.counts["verify_failed"] += 1
+                        _SAMPLES.labels("verify_failed").inc()
+                        self.journal.emit(
+                            "das_sample",
+                            root=bytes.fromhex(root_hex[2:]),
+                            slot=slot,
+                            outcome="verify_failed",
+                            index=index,
+                        )
+                    break
+            st["polls"] += 1
+            still = [
+                i for i in st["indices"] if i not in st["satisfied"]
+            ]
+            if still and st["polls"] >= FLAG_AFTER_POLLS:
+                if root_hex not in self.flagged:
+                    self.flagged.append(root_hex)
+                    _SAMPLES.labels("unsatisfied").inc(len(still))
+                    _FLAGS.inc()
+                    self.journal.emit(
+                        "das_sample",
+                        root=bytes.fromhex(root_hex[2:]),
+                        slot=slot,
+                        outcome="withheld_flagged",
+                        missing=len(still),
+                        indices=",".join(str(i) for i in still),
+                    )
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """The health-plane view (/lighthouse/health doc.da.sampling)."""
+        outstanding = sum(
+            1
+            for st in self.pending.values()
+            if len(st["satisfied"]) < len(st["indices"])
+        )
+        return {
+            "blocks_sampled": len(self.pending),
+            "samples_issued": self.counts["issued"],
+            "samples_satisfied": self.counts["satisfied"],
+            "verify_failed": self.counts["verify_failed"],
+            "outstanding_blocks": outstanding,
+            "withheld_flagged": list(self.flagged),
+        }

@@ -33,6 +33,10 @@ class SimContext:
     snapshot_after: dict
     blob_blocks: dict                # "0x…" root -> n blobs
     eclipse_windows: dict            # name -> (at_slot, until_slot)
+    # column-mode runs: "0x…" root -> {slot, n_blobs, served, columns,
+    # withheld, available} for every column-carrying block (the das_*
+    # checks' driving context; evidence still comes from the planes)
+    das_blocks: dict = field(default_factory=dict)
     # name -> pre-flood median probe latency (seconds), recorded by the
     # orchestrator BEFORE any overload fault fires — the budget the
     # post-flood recovery check holds the node to
@@ -963,6 +967,146 @@ def device_breaker_balanced(ctx: SimContext) -> list:
     return out
 
 
+def das_convergence(ctx: SimContext) -> list:
+    """Column-mode availability is decided by the DATA, never the
+    proposer's word: every column-carrying block whose served columns
+    reached the 50% reconstruction threshold was imported by every
+    honest node — with at least threshold-many distinct column indices
+    individually verified in that node's journal — and every block
+    published below the threshold was imported by NO node (its root is
+    nobody's head, and the chain kept growing past it on the parent)."""
+    if not ctx.das_blocks:
+        return ["scenario produced no column-carrying blocks"]
+    out = []
+    for root_hex, meta in sorted(ctx.das_blocks.items()):
+        threshold = meta["columns"] // 2
+        for name in ctx.honest_online():
+            sn = ctx.nodes[name]
+            if meta["slot"] <= sn.anchor_slot:
+                continue  # backfilled history: no DA required
+            imports = ctx.events(
+                name, root=root_hex, kind="block_import",
+                outcome="imported",
+            )
+            if meta["available"]:
+                if not imports:
+                    out.append(
+                        f"{name}: available column block {root_hex} "
+                        "not imported"
+                    )
+                    continue
+                verified = ctx.events(
+                    name, root=root_hex, kind="column_sidecar",
+                    outcome="verified",
+                )
+                indices = {e["attrs"]["index"] for e in verified}
+                if len(indices) < threshold:
+                    out.append(
+                        f"{name}: column block {root_hex} imported "
+                        f"with only {len(indices)}/{threshold} "
+                        "verified columns"
+                    )
+            elif imports:
+                out.append(
+                    f"{name}: imported WITHHELD block {root_hex} "
+                    f"({meta['served']}/{meta['columns']} columns "
+                    "served — below the reconstruction threshold)"
+                )
+        if not meta["available"]:
+            for name in ctx.honest_online():
+                if ctx.health(name)["head"]["root"] == root_hex:
+                    out.append(
+                        f"{name}: head sits on the withheld block "
+                        f"{root_hex}"
+                    )
+    return out
+
+
+def das_withheld_flagged(ctx: SimContext) -> list:
+    """Every below-threshold (withheld) block was flagged by EVERY
+    honest node's sampler — a das_sample/withheld_flagged journal event
+    per node per root — and the registry's flag counter agrees. A
+    scheduled das_withhold fault that never actually withheld a block
+    tested nothing and is itself a violation."""
+    out = []
+    withheld = {
+        r: m
+        for r, m in sorted(ctx.das_blocks.items())
+        if m["withheld"] and not m["available"]
+    }
+    if any(f.kind == "das_withhold" for f in ctx.scenario.faults) and (
+        not withheld
+    ):
+        out.append(
+            "das_withhold was scheduled but no block was ever "
+            "withheld below the threshold"
+        )
+    expected_flags = 0
+    for root_hex in withheld:
+        for name in ctx.honest_online():
+            flags = ctx.events(
+                name, root=root_hex, kind="das_sample",
+                outcome="withheld_flagged",
+            )
+            if not flags:
+                out.append(
+                    f"{name}: withheld block {root_hex} was never "
+                    "flagged by its sampler"
+                )
+            expected_flags += len(flags)
+    reg = ctx.diff("lighthouse_tpu_da_withholding_flags_total")
+    if withheld and int(reg) < len(withheld):
+        out.append(
+            f"registry counted {int(reg)} withholding flags for "
+            f"{len(withheld)} withheld blocks"
+        )
+    return out
+
+
+def das_no_wrong_verdicts(ctx: SimContext) -> list:
+    """The cell-proof plane never lied: every bus-journaled cell_batch
+    verdict is ok (honest sim traffic is all-valid), at least one cell
+    batch actually rode the bus, and no sampler saw served data fail
+    its own proof (a das_sample/verify_failed event would mean a
+    serving peer handed out cells that do not verify — a wrong verdict
+    on one side or the other)."""
+    out = []
+    n_batches = 0
+    for name in ctx.honest_online():
+        bad = [
+            ev
+            for ev in ctx.events(name, kind="cell_batch")
+            if ev.get("outcome") != "ok"
+        ]
+        n_batches += len(ctx.events(name, kind="cell_batch"))
+        if bad:
+            out.append(
+                f"{name}: {len(bad)} cell_batch verdicts were not ok "
+                f"(first: {bad[0].get('outcome')!r})"
+            )
+        failed = ctx.events(
+            name, kind="das_sample", outcome="verify_failed"
+        )
+        if failed:
+            out.append(
+                f"{name}: {len(failed)} sampled columns failed "
+                "verification — served data did not prove"
+            )
+    if not n_batches:
+        out.append(
+            "no cell_batch events journaled — cell proofs never rode "
+            "the verification bus"
+        )
+    wrong = ctx.diff(
+        'lighthouse_tpu_da_samples_total{outcome="verify_failed"}'
+    )
+    if wrong > 0:
+        out.append(
+            f"registry counted {int(wrong)} verify-failed samples"
+        )
+    return out
+
+
 def finalized(ctx: SimContext) -> list:
     out = []
     for name in ctx.honest_online():
@@ -994,6 +1138,9 @@ CHECKS = {
     "device_faults_caught": device_faults_caught,
     "device_no_wrong_verdicts": device_no_wrong_verdicts,
     "device_breaker_balanced": device_breaker_balanced,
+    "das_convergence": das_convergence,
+    "das_withheld_flagged": das_withheld_flagged,
+    "das_no_wrong_verdicts": das_no_wrong_verdicts,
 }
 
 

@@ -124,6 +124,10 @@ class Simulation:
         )
         self.nodes: list[SimNode] = []
         self.blob_blocks: dict = {}   # root hex -> n_blobs
+        # column-mode runs: root hex -> {slot, n_blobs, served,
+        # columns, withheld, available} for every column-carrying
+        # block (DRIVING context for the das_* invariants)
+        self.das_blocks: dict = {}
         self.eclipse_windows: dict = {}  # name -> (at, until)
         self.probe_budget: dict = {}  # name -> pre-flood probe median
         self._slot = 0
@@ -139,6 +143,7 @@ class Simulation:
 
     def _boot_node(self, sn: SimNode, genesis_state, anchor_block=None,
                    kv=None):
+        das = self.scenario.das
         sn.node = BeaconNode(
             sn.name,
             genesis_state,
@@ -146,6 +151,7 @@ class Simulation:
             backend=self.scenario.backend,
             kv=kv,
             anchor_block=anchor_block,
+            column_mode=bool(das.get("column_mode")),
         )
         sn.node.chain.journal.configure(
             capacity=self.scenario.journal_capacity
@@ -167,6 +173,31 @@ class Simulation:
         self._subscribe_all_subnets(sn)
         sn.api = sn.node.start_http_api()
         sn.online = True
+        if (
+            sn.index is not None
+            and das.get("column_mode")
+            and das.get("samples_per_slot")
+        ):
+            # one DAS sampler per honest node, probing its ONLINE peers
+            # (rebooted nodes get a fresh sampler with fresh counters,
+            # like every other per-node-life surface); attaching it to
+            # the node makes its stats ride /lighthouse/health
+            from lighthouse_tpu.sim.das_sampler import DasSampler
+
+            sn.node.das_sampler = DasSampler(
+                sn.name,
+                self.spec,
+                sn.node.chain.journal,
+                sn.node.chain.verification_bus,
+                peer_urls=lambda me=sn: [
+                    o.base_url()
+                    for o in self._honest_online()
+                    if o is not me
+                ],
+                samples_per_slot=das["samples_per_slot"],
+                seed=self.scenario.seed,
+                backend=self.scenario.backend,
+            )
 
     def _subscribe_all_subnets(self, sn: SimNode):
         """Full-custody attestation subnets: the sim floods singles on
@@ -387,17 +418,51 @@ class Simulation:
         signed = sn.chain.t.signed_block_classes[fork](
             message=block, signature=sig
         )
+        column_mode = bool(sc.das.get("column_mode"))
         sidecars = []
         if blobs:
             from lighthouse_tpu.harness import Harness
 
-            sidecars = Harness.make_blob_sidecars(
-                _TypesShim(sn.chain.t), signed, blobs
+            shim = _TypesShim(sn.chain.t, self.spec)
+            if column_mode:
+                sidecars = Harness.make_data_column_sidecars(
+                    shim, signed, blobs
+                )
+            else:
+                sidecars = Harness.make_blob_sidecars(shim, signed, blobs)
+        root = type(block).hash_tree_root(block)
+        withhold = (
+            self._das_withhold_for(sn.name, slot)
+            if column_mode and blobs
+            else None
+        )
+        if withhold is not None:
+            # data-withholding proposer: publish the block but serve
+            # only the first `rate` columns — and do NOT self-import
+            # (the adversary knows its own block is unavailable; its
+            # head stays on the parent like every honest sampler's)
+            served = sidecars[: withhold.rate]
+            self._note_das_block(
+                root, slot, blobs,
+                served=len(served), total=len(sidecars), withheld=True,
             )
+            self._emit_all(
+                slot, "das_withhold",
+                node=sn.name, served=len(served), columns=len(sidecars),
+            )
+            sn.produced_slots.append(slot)
+            sn.node.publish_block(signed)
+            for scd in served:
+                sn.node.publish_data_column_sidecar(scd)
+            return
+        if blobs:
             # own sidecars first so the producer's own import settles
             for scd in sidecars:
                 try:
-                    sn.chain.process_blob_sidecar(scd)
+                    if column_mode:
+                        sn.chain.process_data_column_sidecar(scd)
+                    else:
+                        sn.chain.process_blob_sidecar(scd)
                 except Exception as e:
                     _LOG.debug("own sidecar skipped: %s", e)
         try:
@@ -410,11 +475,53 @@ class Simulation:
             # tracked only once the block actually entered the network
             # — a failed own-import must not leave a phantom entry the
             # da_completeness invariant would hold every node to
-            root = type(block).hash_tree_root(block)
-            self.blob_blocks["0x" + root.hex()] = len(blobs)
+            if column_mode:
+                self._note_das_block(
+                    root, slot, blobs,
+                    served=len(sidecars), total=len(sidecars),
+                    withheld=False,
+                )
+            else:
+                self.blob_blocks["0x" + root.hex()] = len(blobs)
         sn.node.publish_block(signed)
         for scd in sidecars:
-            sn.node.publish_blob_sidecar(scd)
+            if column_mode:
+                sn.node.publish_data_column_sidecar(scd)
+            else:
+                sn.node.publish_blob_sidecar(scd)
+
+    def _das_withhold_for(self, name: str, slot: int):
+        """The active das_withhold fault targeting this proposer node at
+        `slot`, if any."""
+        for f in self.scenario.faults:
+            if (
+                f.kind == "das_withhold"
+                and f.active(slot)
+                and self.scenario.node_name(f.node) == name
+            ):
+                return f
+        return None
+
+    def _note_das_block(self, root, slot, blobs, served, total,
+                        withheld):
+        """Record a column-carrying block for the das_* invariants and
+        hand its root to every honest sampler."""
+        root_hex = "0x" + root.hex()
+        self.das_blocks[root_hex] = {
+            "slot": slot,
+            "n_blobs": len(blobs),
+            "served": served,
+            "columns": total,
+            "withheld": withheld,
+            # 50%-of-columns reconstruction threshold (the erasure
+            # extension doubles the data): at least half the columns
+            # makes the block's data recoverable by anyone
+            "available": served >= total // 2,
+        }
+        for other in self._honest_online():
+            sampler = getattr(other.node, "das_sampler", None)
+            if sampler is not None:
+                sampler.observe_block(root_hex, slot)
 
     # -------------------------------------------------- attestation plane
 
@@ -853,6 +960,10 @@ class Simulation:
                     self._attest(sn, slot)
             self._settle()
             self._drain_all()
+            for sn in self._honest_online():
+                sampler = getattr(sn.node, "das_sampler", None)
+                if sampler is not None:
+                    sampler.poll(slot)
             for sn in self._online():
                 self._self_aggregate(sn, slot)
         snapshot_after = REGISTRY.snapshot()
@@ -864,6 +975,7 @@ class Simulation:
             snapshot_before=snapshot_before,
             snapshot_after=snapshot_after,
             blob_blocks=dict(self.blob_blocks),
+            das_blocks=dict(self.das_blocks),
             eclipse_windows=dict(self.eclipse_windows),
             probe_budget=dict(self.probe_budget),
         )
@@ -1038,8 +1150,10 @@ class Simulation:
 
 
 class _TypesShim:
-    """Duck-typed `self` for Harness.make_blob_sidecars (which only
-    reads `self.t`) so the sidecar-building logic stays in ONE place."""
+    """Duck-typed `self` for Harness.make_blob_sidecars /
+    make_data_column_sidecars (which read only `self.t` and
+    `self.spec`) so the sidecar-building logic stays in ONE place."""
 
-    def __init__(self, t):
+    def __init__(self, t, spec=None):
         self.t = t
+        self.spec = spec

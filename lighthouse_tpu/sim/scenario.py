@@ -36,11 +36,19 @@ FAULT_KINDS = (
     "device_error",         # dispatches raise -> breaker + failover
     "device_flip",          # device LIES -> canary catches, quarantine
     "device_slow_compile",  # injected compile delay (bounded)
+    "das_withhold",  # node: PROPOSER index — while the window is
+                     # active, its column-carrying proposals publish
+                     # the block but serve only `rate` of the columns
+                     # (rate < half withholds the data; samplers must
+                     # flag it). Requires das.column_mode.
 )
 
 # guarded device planes a device_* fault may target (executor dispatch
 # plane labels)
-DEVICE_PLANES = ("bls", "kzg", "merkle_proof", "msm", "sharded")
+DEVICE_PLANES = (
+    "bls", "kzg", "merkle_proof", "msm", "sharded",
+    "rs_extend", "da_cells",
+)
 
 SCENARIO_KINDS = ("multi_node", "vc_http", "lc_serve")
 
@@ -66,6 +74,9 @@ INVARIANT_NAMES = (
     "device_faults_caught",
     "device_no_wrong_verdicts",
     "device_breaker_balanced",
+    "das_convergence",
+    "das_withheld_flagged",
+    "das_no_wrong_verdicts",
 )
 
 _CONDITIONER_RATE_KEYS = {
@@ -83,8 +94,14 @@ _TOP_KEYS = {
     "name", "kind", "seed", "nodes", "validators", "slots", "backend",
     "spec", "blob_slots", "conditioner", "faults", "invariants",
     "journal_capacity", "adversaries", "description",
-    "processor_bounds",
+    "processor_bounds", "das",
 }
+
+# the data-availability-sampling block: column_mode flips every node's
+# DA gate from blob sidecars to column sidecars; samples_per_slot is
+# how many distinct column indices each node's sampler probes per
+# column-carrying block
+_DAS_KEYS = {"column_mode", "samples_per_slot"}
 
 _FAULT_KEYS = {
     "kind", "at_slot", "until_slot", "node", "groups", "rate", "plane",
@@ -133,6 +150,8 @@ class Scenario:
     # extra validator-less nodes available as fault actors (spammers)
     adversaries: list = field(default_factory=list)
     description: str = ""
+    # DAS config: {"column_mode": bool, "samples_per_slot": int}
+    das: dict = field(default_factory=dict)
 
     @property
     def honest_names(self) -> list:
@@ -199,6 +218,20 @@ def validate(doc: dict) -> Scenario:
     adversaries = doc.get("adversaries", [])
     if not all(isinstance(a, str) and a for a in adversaries):
         _err(name, "'adversaries' must be a list of names")
+
+    das = doc.get("das", {})
+    if not isinstance(das, dict):
+        _err(name, "'das' must be an object")
+    bad = set(das) - _DAS_KEYS
+    if bad:
+        _err(name, f"unknown das keys {sorted(bad)}")
+    if "column_mode" in das and not isinstance(das["column_mode"], bool):
+        _err(name, "das 'column_mode' must be a boolean")
+    sps = das.get("samples_per_slot", 0)
+    if not isinstance(sps, int) or sps < 0:
+        _err(name, "das 'samples_per_slot' must be a non-negative int")
+    if sps and not das.get("column_mode"):
+        _err(name, "das sampling requires 'column_mode': true")
 
     faults = []
     for i, f in enumerate(doc.get("faults", [])):
@@ -291,9 +324,24 @@ def validate(doc: dict) -> Scenario:
                 name,
                 f"fault #{i}: 'plane' only applies to device_* faults",
             )
+        if fkind == "das_withhold":
+            if not das.get("column_mode"):
+                _err(
+                    name,
+                    f"fault #{i}: das_withhold requires das "
+                    "'column_mode': true",
+                )
+            if until is None:
+                _err(name, f"fault #{i}: das_withhold needs 'until_slot'")
         rate = f.get("rate", 4)
-        if not isinstance(rate, int) or rate < 1:
-            _err(name, f"fault #{i}: 'rate' must be a positive integer")
+        # das_withhold's rate is the number of columns SERVED — zero
+        # (publish the block, serve nothing) is a legitimate adversary
+        rate_floor = 0 if fkind == "das_withhold" else 1
+        if not isinstance(rate, int) or rate < rate_floor:
+            _err(
+                name,
+                f"fault #{i}: 'rate' must be an integer >= {rate_floor}",
+            )
         faults.append(
             FaultSpec(
                 kind=fkind, at_slot=at, until_slot=until,
@@ -309,6 +357,10 @@ def validate(doc: dict) -> Scenario:
                 name,
                 f"unknown invariant {inv!r} (one of {INVARIANT_NAMES})",
             )
+    if any(i.startswith("das_") for i in invariants) and not das.get(
+        "column_mode"
+    ):
+        _err(name, "das_* invariants require das 'column_mode': true")
     if "sheds_bounded" in invariants:
         # the invariant cross-checks per-node-LIFE shed counters (reset
         # on reboot, skipped while offline) against the process-global
@@ -374,6 +426,7 @@ def validate(doc: dict) -> Scenario:
         adversaries=list(adversaries),
         description=doc.get("description", ""),
         processor_bounds=dict(processor_bounds),
+        das=dict(das),
     )
 
 

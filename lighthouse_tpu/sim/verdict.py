@@ -28,6 +28,10 @@ CANONICAL_KINDS = (
     "block_release",
     "sidecar",
     "da_settle",
+    # column-sidecar lifecycle (DA sampling plane): arrival, verify,
+    # and reconstruction verdicts are protocol claims — cell_batch
+    # (bus coalescing economics) stays OUT like signature_batch
+    "column_sidecar",
     "sync_batch",
     "sync_request",
     "peer_downscore",
@@ -117,6 +121,7 @@ def build_report(sim, ctx, violations: list) -> dict:
         if k.startswith("lighthouse_tpu_sim_")
         or k.startswith("lighthouse_tpu_sync_")
         or k.startswith("lighthouse_tpu_rpc_")
+        or k.startswith("lighthouse_tpu_da_")
     }
     return {
         "scenario": sc.name,

@@ -569,6 +569,38 @@ def types_for(spec: Spec) -> SimpleNamespace:
         block_root: Root
         index: ssz.uint64
 
+    # -------------------------------------------- column data availability
+
+    Cell = ssz.ByteVector(
+        spec.FIELD_ELEMENTS_PER_CELL * spec.BYTES_PER_FIELD_ELEMENT
+    )
+
+    class DataColumnSidecar(ssz.Container):
+        """PeerDAS-shaped column sidecar (consensus/types/src/
+        data_column_sidecar.rs): one vertical slice of the extended blob
+        matrix — cell `index` of EVERY blob the block commits to — plus
+        the per-cell KZG proofs and the signed header binding it to the
+        block. Gossiped on `data_column_sidecar_{subnet}` topics; any
+        50% of a block's columns reconstruct the full matrix
+        (da.erasure)."""
+
+        index: ssz.uint64
+        column: ssz.List(Cell, spec.MAX_BLOB_COMMITMENTS_PER_BLOCK)
+        kzg_commitments: ssz.List(
+            KZGCommitment, spec.MAX_BLOB_COMMITMENTS_PER_BLOCK
+        )
+        kzg_proofs: ssz.List(
+            KZGProof, spec.MAX_BLOB_COMMITMENTS_PER_BLOCK
+        )
+        signed_block_header: SignedBeaconBlockHeader
+
+    class DataColumnIdentifier(ssz.Container):
+        """(block_root, index) — the by-root request key for a column
+        sidecar (PeerDAS p2p DataColumnIdentifier)."""
+
+        block_root: Root
+        index: ssz.uint64
+
     ns = SimpleNamespace(**{
         k: v
         for k, v in locals().items()
@@ -576,6 +608,7 @@ def types_for(spec: Spec) -> SimpleNamespace:
     })
     ns.spec = spec
     ns.Blob = Blob
+    ns.Cell = Cell
     # light-client generalized-index constants (state-shape-derived)
     ns.FINALIZED_ROOT_GINDEX = FINALIZED_ROOT_GINDEX
     ns.CURRENT_SYNC_COMMITTEE_GINDEX = CURRENT_SYNC_COMMITTEE_GINDEX

@@ -139,6 +139,17 @@ class Spec:
     # MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS)
     MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS: int = 4096
 
+    # column data-availability sampling plane (PeerDAS-shaped, served
+    # by lighthouse_tpu.da): each blob polynomial is Reed-Solomon
+    # extended 2x and split into cells of FIELD_ELEMENTS_PER_CELL
+    # evaluations; column k = cell k of every blob in the block. Cell
+    # size must divide the extended domain (2 * FIELD_ELEMENTS_PER_BLOB)
+    # and the subnet count must divide NUMBER_OF_COLUMNS.
+    FIELD_ELEMENTS_PER_CELL: int = 64
+    DATA_COLUMN_SIDECAR_SUBNET_COUNT: int = 128
+    CUSTODY_REQUIREMENT: int = 4
+    SAMPLES_PER_SLOT: int = 8
+
     # bellatrix (merge) — execution payload sizes + penalty variants
     # (consensus/types/src/eth_spec.rs MaxBytesPerTransaction etc.,
     # chain_spec.rs *_bellatrix fields)
@@ -171,6 +182,15 @@ class Spec:
     DOMAIN_APPLICATION_BUILDER: bytes = b"\x00\x00\x00\x01"
 
     # ---- derived helpers ----
+
+    @property
+    def NUMBER_OF_COLUMNS(self) -> int:
+        """Cells per extended blob — derived, so presets cannot drift:
+        the 2x-extended domain split into FIELD_ELEMENTS_PER_CELL
+        chunks (mainnet: 2*4096/64 = 128)."""
+        return (
+            2 * self.FIELD_ELEMENTS_PER_BLOB // self.FIELD_ELEMENTS_PER_CELL
+        )
 
     def slot_to_epoch(self, slot: int) -> int:
         return slot // self.SLOTS_PER_EPOCH
@@ -316,6 +336,12 @@ def minimal_spec(**overrides) -> Spec:
         MAX_BLOB_COMMITMENTS_PER_BLOCK=16,
         BLOB_SIDECAR_SUBNET_COUNT=4,
         MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS=4,
+        # tiny DAS geometry: 4-element blobs extend to 8 evaluations,
+        # split into 4 columns of 2-element cells over 4 subnets
+        FIELD_ELEMENTS_PER_CELL=2,
+        DATA_COLUMN_SIDECAR_SUBNET_COUNT=4,
+        CUSTODY_REQUIREMENT=2,
+        SAMPLES_PER_SLOT=2,
     )
     return replace(base, **overrides) if overrides else base
 

@@ -106,6 +106,7 @@ DEFAULT_CLASS_BUDGETS = {
     "oppool": 10.0,
     "slasher": 30.0,
     "kzg": 5.0,
+    "da_cells": 5.0,
     "bench": 10.0,
 }
 DEFAULT_BUDGET_S = 5.0
@@ -132,12 +133,20 @@ class _Submission:
     __slots__ = (
         "sets", "consumer", "journal", "slot", "attrs", "backend",
         "budget_s", "submitted_at", "expires_at", "event", "result",
-        "exc", "done", "claimed", "dispatch_t0",
+        "exc", "done", "claimed", "dispatch_t0", "kind", "extra",
     )
 
     def __init__(
-        self, sets, consumer, journal, slot, attrs, backend, budget_s
+        self, sets, consumer, journal, slot, attrs, backend, budget_s,
+        kind="bls", extra=None,
     ):
+        # `kind` selects the shared-dispatch plane ("bls" signature
+        # sets | "da_cells" cell-proof items); the queue, flush
+        # triggers, deadline handling, and mixed-fail isolation are
+        # kind-agnostic — only the dispatch and journal event differ.
+        self.kind = kind
+        # kind-specific dispatch context (da_cells: geometry + setup)
+        self.extra = extra
         self.sets = sets
         self.consumer = consumer
         self.journal = journal
@@ -250,6 +259,49 @@ class VerificationBus:
             backend or self.backend,
             budget_s,
         )
+        return self._submit_and_wait(sub)
+
+    def submit_cells(
+        self,
+        items,
+        geometry,
+        consumer: str = "da_cells",
+        deadline=None,
+        journal=None,
+        slot=None,
+        journal_attrs: dict | None = None,
+        backend: str | None = None,
+        setup=None,
+    ) -> bool:
+        """Verify DA cell-proof items (commitment, cell_index, cell,
+        proof) as one unit, coalesced with other pending CELL
+        submissions into one folded pairing batch (`da.cells
+        .verify_cell_proof_batch`). Same queue/deadline/mixed-fail
+        contract as `submit`; cell batches never merge with signature
+        batches — the flush groups by (backend, kind) because the two
+        planes fold over different device kernels. Empty submissions
+        verify vacuously, like `submit`."""
+        items = list(items)
+        if not items:
+            attribution.normalize(consumer)
+            return True
+        consumer = attribution.normalize(consumer)
+        _SUBMITTED.labels(consumer).inc()
+        budget_s = self._budget_for(consumer, deadline)
+        sub = _Submission(
+            items,
+            consumer,
+            journal if journal is not None else self.journal,
+            slot,
+            journal_attrs,
+            backend or self.backend,
+            budget_s,
+            kind="da_cells",
+            extra={"geometry": geometry, "setup": setup},
+        )
+        return self._submit_and_wait(sub)
+
+    def _submit_and_wait(self, sub: _Submission) -> bool:
         hold_s = self._hold_s(sub.backend)
         # the pressure signal only matters when a hold could actually
         # be taken — on zero-hold (host-backend passthrough) paths the
@@ -262,7 +314,7 @@ class VerificationBus:
         # on another submitter's thread — this thread still blocks for
         # exactly that long). The queue-wait/dispatch split comes from
         # the flush's dispatch_t0 stamp at close.
-        _budget_tok = slot_budget.open_dispatch(consumer, kind="bus")
+        _budget_tok = slot_budget.open_dispatch(sub.consumer, kind="bus")
         try:
             with self._lock:
                 self._pending.append(sub)
@@ -404,8 +456,8 @@ class VerificationBus:
             return
         groups: dict = {}
         for s in batch:
-            groups.setdefault(s.backend, []).append(s)
-        for backend, subs in groups.items():
+            groups.setdefault((s.backend, s.kind), []).append(s)
+        for (backend, _kind), subs in groups.items():
             self._dispatch_group(subs, backend, trigger)
 
     def _dispatch_group(self, subs, backend, trigger: str):
@@ -432,6 +484,49 @@ class VerificationBus:
             if stragglers:
                 with self._lock:
                     self._completed += len(stragglers)
+
+    def _shared_verify(self, subs, backend):
+        """Kind dispatch: one group is homogeneous by construction
+        (the flush groups by (backend, kind))."""
+        if subs[0].kind == "da_cells":
+            return self._cells_shared_verify(subs, backend)
+        return self._guarded_shared_verify(subs, backend)
+
+    def _cells_shared_verify(self, subs, backend):
+        """Shared DA cell-proof dispatch: concatenate every
+        submission's items into ONE folded pairing batch.
+        `da.cells.verify_cell_proof_batch` owns the tier walk (tpu ->
+        xla-host -> ref through the guarded executor, plane
+        "da_cells"), slot-budget marking, and per-consumer attribution
+        (`note_batch`), so the bus adds only queueing + coalescing
+        here. The wall model is shared with the signature plane —
+        both are two-pair folded pairings whose wall is dominated by
+        the same fixed dispatch cost, and the model only gates flush
+        timing. Returns (ok, None): cell batches carry no
+        lanes/waste record (the tpu marshal reports its own)."""
+        from lighthouse_tpu import bls
+        from lighthouse_tpu.da import cells as da_cells
+
+        items = [it for s in subs for it in s.sets]
+        geo = subs[0].extra["geometry"]
+        setup = next(
+            (
+                s.extra.get("setup")
+                for s in subs
+                if s.extra.get("setup") is not None
+            ),
+            None,
+        )
+        effective = backend or bls.default_backend()
+        ok = da_cells.verify_cell_proof_batch(
+            items,
+            geo,
+            backend=effective,
+            setup=setup,
+            seed=self.seed,
+            consumer="da_cells",
+        )
+        return bool(ok), None
 
     def _guarded_shared_verify(self, subs, backend):
         """The shared dispatch, routed through the device-plane guard
@@ -535,7 +630,7 @@ class VerificationBus:
         exc = None
         record = None
         try:
-            ok, record = self._guarded_shared_verify(subs, backend)
+            ok, record = self._shared_verify(subs, backend)
         except Exception as e:
             ok = False
             exc = e
@@ -575,9 +670,7 @@ class VerificationBus:
             sub_exc = None
             sub_record = None
             try:
-                ok_i, sub_record = self._guarded_shared_verify(
-                    [s], backend
-                )
+                ok_i, sub_record = self._shared_verify([s], backend)
             except Exception as e:
                 ok_i = False
                 sub_exc = e
@@ -607,7 +700,10 @@ class VerificationBus:
         """One `signature_batch` event per contributing submission,
         sharing the batch id and economics — the journal side of the
         attribution_complete equality (registry counted each
-        contributor's sets in verify_signature_sets_shared)."""
+        contributor's sets in verify_signature_sets_shared). DA cell
+        submissions emit `cell_batch` instead: they attribute through
+        `note_batch` (not `note_sets`), so they live outside the
+        signature-side equality and the canonical replay hash."""
         now = time.monotonic()
         for s, ok_i in zip(subs, verdicts):
             journal = s.journal
@@ -641,12 +737,20 @@ class VerificationBus:
                 "error" if exc is not None
                 else ("ok" if ok_i else "failed")
             )
-            journal.emit(
-                "signature_batch",
-                slot=s.slot,
-                outcome=outcome,
-                **attrs,
-            )
+            if s.kind == "da_cells":
+                journal.emit(
+                    "cell_batch",
+                    slot=s.slot,
+                    outcome=outcome,
+                    **attrs,
+                )
+            else:
+                journal.emit(
+                    "signature_batch",
+                    slot=s.slot,
+                    outcome=outcome,
+                    **attrs,
+                )
 
     def _complete(self, subs, verdicts, exc_all=None):
         now = time.monotonic()

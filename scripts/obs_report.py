@@ -26,10 +26,19 @@ so the inputs are RAW journals (the sim's canonical replay journals
 strip `t` by design — export raw ones with `bn --journal-jsonl` or
 read live nodes).
 
+Counter mode (``--counters``): the non-histogram families — every
+plain counter/gauge series, labels expanded — rendered as a sorted
+value table. This is how the DA sampling plane's `da_*` families
+(samples by outcome, withholding flags, column/cell batch counts,
+custody gauges) read out of a scrape: ``--counters --family
+lighthouse_tpu_da`` is the post-run DAS audit view.
+
 Importable pieces (used by tests and bench tooling):
   parse_histograms(text)   -> {(name, labels): {"buckets", "sum", "count"}}
+  parse_counters(text)     -> {(name, labels): value}
   bucket_quantile(buckets, count, q) -> float | None
   render_report(text, family_filter=None) -> str
+  render_counter_report(text, family_filter=None) -> str
   render_slot_budget(doc, waterfalls=6) -> str   (--slot-budget mode)
   build_timelines({node: [event, ...]}) -> {root: timeline}
   timeline_population_stats(timelines) -> dict
@@ -105,6 +114,72 @@ def parse_histograms(text: str) -> dict:
     return {
         k: v for k, v in out.items() if v["buckets"] and v["count"]
     }
+
+
+def parse_counters(text: str) -> dict:
+    """Prometheus text exposition -> plain (counter/gauge) series:
+    {(family, labels_tuple): value}. Histogram components are excluded
+    — `_bucket` series always, and `_sum`/`_count` series whose base
+    family actually exposes buckets (a counter legitimately named
+    `*_total_count` without buckets still renders)."""
+    hist_families = {
+        m.group("name")[: -len("_bucket")]
+        for m in (
+            _SERIES_RE.match(line.strip()) for line in text.splitlines()
+        )
+        if m
+        and m.group("name").endswith("_bucket")
+        and "le" in _parse_labels(m.group("labels") or "")
+    }
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if m is None:
+            continue
+        name = m.group("name")
+        if name.endswith("_bucket"):
+            continue
+        for suffix in ("_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in hist_families:
+                break
+        else:
+            try:
+                value = float(m.group("value"))
+            except ValueError:
+                continue
+            labels = _parse_labels(m.group("labels") or "")
+            out[(name, tuple(sorted(labels.items())))] = value
+    return out
+
+
+def counter_rows(text: str, family_filter: str | None = None) -> list:
+    """[(series_label, value)] sorted by family then descending value."""
+    rows = []
+    for (family, labels), value in parse_counters(text).items():
+        if family_filter and family_filter not in family:
+            continue
+        label_str = ",".join(f"{k}={v}" for k, v in labels)
+        series = family + (f"{{{label_str}}}" if label_str else "")
+        rows.append((series, value))
+    rows.sort(key=lambda r: (r[0].split("{")[0], -r[1]))
+    return rows
+
+
+def render_counter_report(
+    text: str, family_filter: str | None = None
+) -> str:
+    rows = counter_rows(text, family_filter)
+    if not rows:
+        return "no counter/gauge series matched\n"
+    width = max(len(r[0]) for r in rows)
+    lines = [f"{'series':<{width}}  {'value':>12}"]
+    for series, value in rows:
+        v = f"{int(value)}" if value == int(value) else f"{value:.6g}"
+        lines.append(f"{series:<{width}}  {v:>12}")
+    return "\n".join(lines) + "\n"
 
 
 def bucket_quantile(buckets, count: int, q: float):
@@ -508,6 +583,13 @@ def main(argv=None) -> int:
         "(e.g. stage_seconds, http_request)",
     )
     ap.add_argument(
+        "--counters",
+        action="store_true",
+        help="render plain counter/gauge families instead of "
+        "histograms (e.g. --counters --family lighthouse_tpu_da "
+        "for the DAS audit view)",
+    )
+    ap.add_argument(
         "--slot-budget",
         action="store_true",
         help="render per-import critical-path waterfalls + stage "
@@ -575,7 +657,10 @@ def main(argv=None) -> int:
             text = f.read()
     else:
         text = sys.stdin.read()
-    sys.stdout.write(render_report(text, args.family))
+    if args.counters:
+        sys.stdout.write(render_counter_report(text, args.family))
+    else:
+        sys.stdout.write(render_report(text, args.family))
     return 0
 
 

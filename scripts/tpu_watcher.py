@@ -90,6 +90,12 @@ SWEEP = [
     # the 1k and 16k query shapes (depth 6, the finality branch)
     ("xla", 1024, "lcproof"),
     ("xla", 16384, "lcproof"),
+    # --- DA sampling plane (PR 18): first real hardware numbers for
+    # the batched Reed-Solomon extension Horner scan + the cell
+    # multiproof fold on the guarded device plane, at the 8- and
+    # 32-blob shapes (byte-identical host-oracle check every iteration)
+    ("xla", 8, "das"),
+    ("xla", 32, "das"),
     # --- slot-budget decomposition on real kernels: stage medians,
     # serial dispatches and the fusable gap for a full block import
     # (stamped into scripts/perf_gate_baseline.json's hardware block)

@@ -89,6 +89,13 @@ def test_rpc_over_socket_status_ping_blocks():
         root = a.chain.head_root
         (blk,) = rpc.blocks_by_root("node-b", [root])
         assert type(blk.message).hash_tree_root(blk.message) == root
+        # column-mode req/resp framing round-trips; a blob-mode peer
+        # holds no columns and answers empty
+        from lighthouse_tpu.network.rpc import DataColumnIdentifier
+
+        assert rpc.data_column_sidecars_by_root(
+            "node-b", [DataColumnIdentifier(block_root=root, index=0)]
+        ) == []
     finally:
         net_a.close()
         net_b.close()
