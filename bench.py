@@ -53,6 +53,11 @@ Env knobs:
                             p50/p99 vs the 200 ms budget, serial
                             dispatches, fusable gap (perf_gate.py
                             diffs this against its committed baseline)
+  BENCH_CONFIG=slotfuse     one-dispatch-slot A/B: the same blob
+                            import schedule with --slot-fuse off vs
+                            on — wall p50/p99 per arm, dispatches per
+                            import, and canonical verdict
+                            byte-identity between the two arms
 """
 
 import json
@@ -168,6 +173,7 @@ def _active_metric():
         "serve": "serve_mixed_traffic_throughput",
         "busmix": "bus_amortization_speedup",
         "slotpath": "slotpath_wall_p50_ms",
+        "slotfuse": "slotfuse_speedup",
         "das": "das_cell_verify_throughput",
     }.get(cfg, "verify_signature_sets_throughput")
 
@@ -334,6 +340,13 @@ def _measure(jax, platform):
         from lighthouse_tpu import bench_slotpath
 
         return bench_slotpath.measure(jax, platform)
+    if config == "slotfuse":
+        # one-dispatch-slot A/B: serial vs chained slot-program over
+        # the same deterministic blob schedule, with verdict
+        # byte-identity asserted between the arms
+        from lighthouse_tpu import bench_slotfuse
+
+        return bench_slotfuse.measure(jax, platform)
     if config == "das":
         # DA sampling plane: device RS extension + cell-multiproof
         # fold, host-oracle-checked every iteration

@@ -18,6 +18,15 @@ Tests and benches stay exempt (the framework only walks the package),
 as does ops/ — its kernels are the layer BELOW the dispatchers, and
 its own public batch entry points (ops/merkle_proof) carry their own
 guard.
+
+The one-dispatch slot extends the boundary by two entry points. The
+raw chained executor (``run_slot_program_segments``) delivers settle
+verdicts straight off the device path — outside a guarded attempt it
+has no watchdog, no canary, no breaker, and no fault-injection plan,
+so only its own module (ops/slot_program.py, whose ``SlotProgram.run``
+wraps it in ``GUARD.dispatch``) may call it. ``dispatch_async`` needs
+no rule of its own: it delegates every submission to ``dispatch`` on
+the worker thread, so it IS the guarded boundary, not a bypass.
 """
 
 import ast
@@ -36,6 +45,10 @@ RAW_DISPATCHERS = {
     "g1_msm_fixed_base_tpu",
     "rs_extend_tpu",
     "verify_cell_proof_batch_tpu",
+    # the raw chained slot-program executor: tree-hash -> signature
+    # fold -> KZG settle with verdict delivery, guard-railed only when
+    # SlotProgram.run wraps it in a guarded attempt
+    "run_slot_program_segments",
 }
 
 # package-relative posix paths that implement the guarded boundary:
@@ -50,6 +63,7 @@ ALLOWED_MODULES = {
     "da/tpu_backend.py",
     "device_plane/executor.py",
     "device_plane/canary.py",
+    "ops/slot_program.py",
 }
 
 

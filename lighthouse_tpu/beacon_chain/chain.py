@@ -106,11 +106,19 @@ class BeaconChain:
         slot_clock=None,
         execution_layer=None,
         column_mode: bool = False,
+        slot_fuse: bool = True,
     ):
         self.spec = spec
         self.execution_layer = execution_layer
         self.t = types_for(spec)
         self.backend = backend
+        # one-dispatch slot (bn --slot-fuse, default on): blob imports
+        # defer the DA checker's KZG settle into the import's chained
+        # slot-program so the fold + settle cross the host<->device
+        # boundary ONCE (ops/slot_program.py). Column mode keeps its
+        # own sampling-plane settle — the fused path only engages when
+        # the active checker supports deferred settles.
+        self.slot_fuse = bool(slot_fuse)
         # column_mode swaps the blob DA checker for the PeerDAS-shaped
         # column checker: blocks gate on >=50% of DataColumnSidecars
         # instead of every BlobSidecar (beacon_chain/column_checker.py)
@@ -533,6 +541,29 @@ class BeaconChain:
             lambda: self._process_block_inner(signed_block, block_root),
         )
 
+    def _fuse_active(self) -> bool:
+        """True when this import should use the one-dispatch slot path
+        (``bn --slot-fuse``, default on)."""
+        return self.slot_fuse and hasattr(
+            self.da_checker, "put_block_fused"
+        )
+
+    def _fused_held(self, block, block_root, missing):
+        """A fused import whose deferred settle left sidecars missing
+        lands exactly where the serial DA gate would have put it: held,
+        unobserved, retriable on release."""
+        # the serial path holds BEFORE the proposer observation; undo
+        # ours so the released block can re-enter this pipeline
+        self.observed_block_producers.forget(
+            block.slot, block.proposer_index, block_root
+        )
+        self.metrics["da_blocks_held"] = (
+            self.metrics.get("da_blocks_held", 0) + 1
+        )
+        raise BlockError(
+            f"data unavailable: missing blob sidecars {sorted(missing)}"
+        )
+
     def _process_block_inner(self, signed_block, block_root):
         spec = self.spec
         block = signed_block.message
@@ -550,11 +581,21 @@ class BeaconChain:
             DataAvailabilityError,
         )
 
+        fused_work = None
         try:
             with budget_stage("kzg_settle"):
-                missing = self.da_checker.put_block(
-                    block_root, signed_block
-                )
+                if self._fuse_active():
+                    # one-dispatch slot: partition candidates now,
+                    # defer the folded KZG verify onto the import's
+                    # single chained dispatch (staged below, ridden by
+                    # the signature collector's bus submit)
+                    missing, fused_work = self.da_checker.put_block_fused(
+                        block_root, signed_block
+                    )
+                else:
+                    missing = self.da_checker.put_block(
+                        block_root, signed_block
+                    )
         except DataAvailabilityError as e:
             # structurally invalid on the DA axis (e.g. more commitments
             # than MAX_BLOBS_PER_BLOCK) — a hard reject, not a hold
@@ -568,9 +609,13 @@ class BeaconChain:
             )
         # only an available block may advance the fork-choice clock —
         # before the DA gate a far-future block would drag the
-        # checker's own horizon along with it
-        if self.fork_choice.current_slot < block.slot:
-            self.fork_choice.set_slot(block.slot)
+        # checker's own horizon along with it. On the fused path the
+        # verdict is still pending: the advance waits for finalize (the
+        # sync path's set_slot-inside-store_write discipline), so a
+        # fused-held block leaves the clock untouched like a serial one.
+        if fused_work is None:
+            if self.fork_choice.current_slot < block.slot:
+                self.fork_choice.set_slot(block.slot)
 
         with budget_stage("structural"):
             parent_state = self._snapshots.get(parent_root)
@@ -617,24 +662,57 @@ class BeaconChain:
         ):
             state = process_slots(state, block.slot, spec)
         engine = _EngineAdapter(self.execution_layer)
+        if fused_work is not None:
+            # the deferred settle rides the SAME dispatch as the
+            # block's signature fold: the collector's bus submit below
+            # picks it up into one chained slot-program
+            self.verification_bus.stage_program_work(fused_work)
         try:
-            with span("import/block_processing"), budget_stage(
-                "block_processing"
-            ):
-                per_block_processing(
-                    state,
-                    signed_block,
-                    spec,
-                    BlockSignatureStrategy.VERIFY_BULK,
-                    self.pubkey_cache,
-                    backend=self.backend,
-                    execution_engine=engine,
-                    consumer="gossip_single",
-                    journal=self.journal,
-                    bus=self.verification_bus,
-                )
-        except BlockProcessingError as e:
-            raise BlockError(str(e)) from e
+            try:
+                with span("import/block_processing"), budget_stage(
+                    "block_processing"
+                ):
+                    per_block_processing(
+                        state,
+                        signed_block,
+                        spec,
+                        BlockSignatureStrategy.VERIFY_BULK,
+                        self.pubkey_cache,
+                        backend=self.backend,
+                        execution_engine=engine,
+                        consumer="gossip_single",
+                        journal=self.journal,
+                        bus=self.verification_bus,
+                    )
+            except BlockProcessingError as e:
+                if fused_work is not None:
+                    # the serial gate orders DA before signatures:
+                    # finalize the deferred settle FIRST so a block
+                    # that is both unavailable and unverifiable lands
+                    # as HELD, exactly like the serial path
+                    with budget_stage("kzg_settle"):
+                        fused_missing = fused_work.finalize()
+                    if fused_missing:
+                        self._fused_held(
+                            block, block_root, fused_missing
+                        )
+                raise BlockError(str(e)) from e
+            if fused_work is not None:
+                with budget_stage("kzg_settle"):
+                    fused_missing = fused_work.finalize()
+                if fused_missing:
+                    self._fused_held(block, block_root, fused_missing)
+                if self.fork_choice.current_slot < block.slot:
+                    self.fork_choice.set_slot(block.slot)
+        finally:
+            if fused_work is not None:
+                # un-stage on every exit (a pre-submit failure must not
+                # leak this import's settle into the next submit on
+                # this thread) and keep the checker sound: a work the
+                # program never ran settles serially here
+                self.verification_bus.pop_staged_work()
+                if not fused_work.finalized:
+                    fused_work.finalize()
         with span("import/state_root"), budget_stage("state_root"):
             post_root = cached_state_root(state)
         if bytes(block.state_root) != post_root:
@@ -1024,9 +1102,28 @@ class BeaconChain:
         # imported unavailable — the sync manager requeues it.
         try:
             with budget_stage("kzg_settle"):
-                missing = self.da_checker.put_block(
-                    block_root, signed_block
-                )
+                if self._fuse_active():
+                    # the sync path has no co-resident signature fold
+                    # (NO_VERIFICATION), but the settle still goes out
+                    # as ONE chained program instead of a standalone
+                    # KZG dispatch
+                    missing, fused_work = self.da_checker.put_block_fused(
+                        block_root, signed_block
+                    )
+                    if fused_work is not None:
+                        try:
+                            self.verification_bus.submit_program(
+                                fused_work,
+                                consumer="kzg",
+                                journal=self.journal,
+                                slot=int(block.slot),
+                            )
+                        finally:
+                            missing = fused_work.finalize()
+                else:
+                    missing = self.da_checker.put_block(
+                        block_root, signed_block
+                    )
         except DataAvailabilityError as e:
             raise BlockError(str(e)) from e
         if missing:

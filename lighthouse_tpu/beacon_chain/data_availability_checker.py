@@ -135,6 +135,86 @@ class _PendingComponents:
         self.t_held = None
 
 
+class PendingSettle:
+    """One block's DEFERRED candidate settle, produced by
+    `put_block_fused`: the body-matching candidates are partitioned
+    host-side, the folded KZG verify rides the import's chained
+    slot-program (`ops/slot_program.py`), and the verdict fans back
+    through `deliver` before `finalize` applies it with serial
+    byte-identity — a True verdict accepts the fold exactly like the
+    serial batch path, a False/"error" verdict falls back to the same
+    per-sidecar host recovery, and a never-delivered verdict (the
+    program never dispatched) settles fully serially."""
+
+    __slots__ = (
+        "checker", "block_root", "signed_block", "entry", "matching",
+        "discarded", "verdict", "finalized", "missing",
+    )
+
+    def __init__(
+        self, checker, block_root, signed_block, entry, matching,
+        discarded,
+    ):
+        self.checker = checker
+        self.block_root = block_root
+        self.signed_block = signed_block
+        self.entry = entry
+        self.matching = matching
+        self.discarded = discarded
+        self.verdict = None  # None | True | False | "error"
+        self.finalized = False
+        self.missing = None
+
+    def payload(self):
+        """The folded batch the chained program verifies: parallel
+        (blobs, commitments, proofs) lists plus the checker's backend —
+        the exact inputs the serial `_verify_batch` would fold."""
+        scs = [sc for _, _, sc in self.matching]
+        return (
+            [bytes(sc.blob) for sc in scs],
+            [bytes(sc.kzg_commitment) for sc in scs],
+            [bytes(sc.kzg_proof) for sc in scs],
+            self.checker.backend,
+        )
+
+    def deliver(self, verdict):
+        """Record the chained program's fold verdict (idempotent-last:
+        a mixed-batch retry re-delivers, and the retry's verdict is the
+        one the batch semantics say counts)."""
+        if not self.finalized:
+            self.verdict = verdict
+
+    def finalize(self) -> set:
+        """Apply the (delivered or serially computed) verdict with the
+        serial settle's exact note/journal/forget discipline, then run
+        put_block's hold tail. Returns the missing indices; safe to
+        call more than once (later calls return the first answer)."""
+        if self.finalized:
+            return self.missing
+        self.finalized = True
+        ch = self.checker
+        with span("da/settle_candidates", n=len(self.matching)):
+            if self.verdict is True:
+                accepted = list(self.matching)
+            elif self.verdict is None:
+                accepted = ch._verify_matching(self.matching)
+            else:
+                # False or "error": per-sidecar recovery so honest
+                # candidates still land — serial fold-failure semantics
+                accepted = ch._verify_each(self.matching)
+        ch._apply_settle(
+            self.block_root, self.entry, self.matching, accepted,
+            self.discarded,
+        )
+        self.missing = ch.missing_indices(
+            self.block_root, self.signed_block
+        )
+        ch._hold_tail(
+            self.block_root, self.signed_block, self.entry, self.missing
+        )
+        return self.missing
+
+
 class DataAvailabilityChecker:
     # memory bounds against unsolicited gossip: at most this many roots
     # tracked (candidate-only spam entries evicted first, then oldest —
@@ -317,6 +397,64 @@ class DataAvailabilityChecker:
         entry.commitments = commitments
         self._settle_candidates(block_root, entry)
         missing = self.missing_indices(block_root, signed_block)
+        self._hold_tail(block_root, signed_block, entry, missing)
+        return missing
+
+    def put_block_fused(self, block_root: bytes, signed_block):
+        """Fused-path variant of `put_block` for the one-dispatch slot:
+        partition the pre-block candidates NOW (host-only work), and —
+        when the optimistic verdict could make the block available —
+        DEFER the folded KZG verify into the import's chained
+        slot-program instead of paying a dispatch here. Returns
+        `(missing, pending)`:
+
+          * `(missing, None)` — settled serially, byte-identical to
+            `put_block` (no commitments, nothing matching to fold, or
+            sidecars are genuinely missing so the block holds exactly
+            as before);
+          * `(set(), PendingSettle)` — every commitment is covered if
+            the fold verifies; the caller rides the work on the
+            import's single dispatch and calls `finalize()` for the
+            real missing set."""
+        commitments = self.block_commitments(signed_block)
+        if not commitments:
+            return set(), None
+        if len(commitments) > self.spec.MAX_BLOBS_PER_BLOCK:
+            raise DataAvailabilityError(
+                f"block commits to {len(commitments)} blobs, max is "
+                f"{self.spec.MAX_BLOBS_PER_BLOCK}"
+            )
+        entry = self._entry(block_root)
+        entry.commitments = commitments
+        matching, discarded = self._partition_candidates(
+            block_root, entry
+        )
+        covered = set(entry.sidecars) | {i for i, _, _ in matching}
+        optimistic_missing = {
+            i for i in range(len(commitments)) if i not in covered
+        }
+        if not matching or optimistic_missing:
+            # nothing to fold, or the block cannot become available
+            # this import regardless of the fold's verdict: settle
+            # serially now — byte-identical to put_block
+            if matching:
+                with span("da/settle_candidates", n=len(matching)):
+                    accepted = self._verify_matching(matching)
+            else:
+                accepted = []
+            self._apply_settle(
+                block_root, entry, matching, accepted, discarded
+            )
+            missing = self.missing_indices(block_root, signed_block)
+            self._hold_tail(block_root, signed_block, entry, missing)
+            return missing, None
+        return set(), PendingSettle(
+            self, block_root, signed_block, entry, matching, discarded
+        )
+
+    def _hold_tail(self, block_root, signed_block, entry, missing):
+        """put_block's terminal: hold an unavailable block (or drop a
+        workless entry), finish an available one."""
         if missing:
             # far-future blocks are reported unavailable but NOT cached
             # — they would dodge finality pruning indefinitely
@@ -330,7 +468,6 @@ class DataAvailabilityChecker:
                 self._drop_entry(block_root)
         else:
             self._finish(block_root, entry)
-        return missing
 
     def _settle_candidates(self, block_root: bytes, entry):
         """Pre-block candidates -> verified sidecars: pick the
@@ -341,6 +478,22 @@ class DataAvailabilityChecker:
         accepted has its observed digest forgotten — its redelivery
         should be judged against the now-known block (mismatch/invalid
         penalties), not shrugged off as a duplicate."""
+        matching, discarded = self._partition_candidates(
+            block_root, entry
+        )
+        if matching:
+            with span("da/settle_candidates", n=len(matching)):
+                accepted = self._verify_matching(matching)
+        else:
+            accepted = []
+        self._apply_settle(
+            block_root, entry, matching, accepted, discarded
+        )
+
+    def _partition_candidates(self, block_root: bytes, entry):
+        """Host half of the settle: split cached candidates into
+        body-matching vs discarded (and note the mismatches), clearing
+        the candidate table. Pure bookkeeping — no pairing work."""
         matching, discarded = [], []
         for i, cands in entry.candidates.items():
             usable = i not in entry.sidecars and i < len(entry.commitments)
@@ -356,28 +509,44 @@ class DataAvailabilityChecker:
             self._note_sidecar(
                 "mismatched_commitment", root=block_root, n=len(discarded)
             )
+        return matching, discarded
+
+    def _verify_each(self, matching) -> list:
+        """Per-sidecar recovery verdicts (the fold failed or raised):
+        honest candidates still land, each judged alone."""
+        from lighthouse_tpu.kzg import KzgError
+
+        out = []
+        for item in matching:
+            try:
+                if self._verify_batch([item[2]]):
+                    out.append(item)
+            except KzgError:
+                pass
+        return out
+
+    def _verify_matching(self, matching) -> list:
+        """Device half of the serial settle: ONE folded batch, falling
+        back to per-sidecar verdicts when the fold fails or a malformed
+        candidate raises."""
+        from lighthouse_tpu.kzg import KzgError
+
+        try:
+            if self._verify_batch([sc for _, _, sc in matching]):
+                return matching
+            return self._verify_each(matching)
+        except KzgError:
+            # one malformed candidate must not sink the rest
+            return self._verify_each(matching)
+
+    def _apply_settle(
+        self, block_root: bytes, entry, matching, accepted, discarded
+    ):
+        """Bookkeeping half of the settle: install accepted sidecars,
+        note invalid proofs, emit the da_settle event, and forget every
+        discarded/rejected digest so redeliveries are judged fresh."""
+        discarded = list(discarded)
         if matching:
-            from lighthouse_tpu.kzg import KzgError
-
-            def _verify_singly():
-                out = []
-                for item in matching:
-                    try:
-                        if self._verify_batch([item[2]]):
-                            out.append(item)
-                    except KzgError:
-                        pass
-                return out
-
-            with span("da/settle_candidates", n=len(matching)):
-                try:
-                    if self._verify_batch([sc for _, _, sc in matching]):
-                        accepted = matching
-                    else:
-                        accepted = _verify_singly()
-                except KzgError:
-                    # one malformed candidate must not sink the rest
-                    accepted = _verify_singly()
             if len(accepted) < len(matching):
                 self._note_sidecar(
                     "invalid_proof",

@@ -68,6 +68,17 @@ class ObservedBlockProducers:
             return "duplicate"
         return "equivocation"
 
+    def forget(self, slot: int, proposer: int, block_root: bytes):
+        """Un-record an observation IF it still points at this root —
+        the fused import path observes the proposer before the deferred
+        DA verdict resolves, and a fused-HELD block must stay
+        retriable on release (the serial gate never observes a held
+        block). A different recorded root stays: that is real
+        equivocation evidence, not this import's bookkeeping."""
+        key = (slot, proposer)
+        if self._seen.get(key) == block_root:
+            del self._seen[key]
+
     def prune(self, finalized_slot: int):
         for k in [k for k in self._seen if k[0] < finalized_slot]:
             del self._seen[k]

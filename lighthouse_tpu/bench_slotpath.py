@@ -24,8 +24,25 @@ N_VALIDATORS = 16
 # bellatrix activates at epoch 1 (minimal: slot 8); every 4th slot
 # after that carries blobs so the import pays the KZG-settle round trip
 # on top of the signature fold — the two-dispatch shape whose gap the
-# fusable-gap ledger exists to measure
+# fusable-gap ledger exists to measure. SLOTPATH_BLOB_PERIOD/
+# SLOTPATH_BLOBS override the cadence and per-slot blob count so the
+# fused path can be benched at heavier blob geometries without
+# editing this file.
 BLOB_PERIOD = 4
+
+
+def _geometry():
+    """(n_imports, blob_period, blobs_per_slot) from the env:
+    SLOTPATH_BLOCKS (BENCH_NSETS keeps working as the legacy name),
+    SLOTPATH_BLOB_PERIOD, SLOTPATH_BLOBS."""
+    n_imports = int(
+        os.environ.get("SLOTPATH_BLOCKS")
+        or os.environ.get("BENCH_NSETS")
+        or 16
+    )
+    period = int(os.environ.get("SLOTPATH_BLOB_PERIOD") or BLOB_PERIOD)
+    blobs = int(os.environ.get("SLOTPATH_BLOBS") or 2)
+    return n_imports, max(1, period), max(1, blobs)
 
 
 def _build_node(backend: str):
@@ -64,17 +81,26 @@ def measure(jax, platform):
     backend = os.environ.get(
         "BENCH_SLOTPATH_BACKEND", "tpu" if on_tpu else "fake"
     )
-    n_imports = int(os.environ.get("BENCH_NSETS") or 16)
+    n_imports, blob_period, blobs_per_slot = _geometry()
 
     h, node = _build_node(backend)
     chain = node.chain
+    # BENCH_SLOTFUSE=off restores the serial three-dispatch path (the
+    # A/B partner bench_slotfuse drives both arms itself)
+    if os.environ.get("BENCH_SLOTFUSE") == "off":
+        chain.slot_fuse = False
     recorder = chain.slot_budget
     recorder.configure(ring=max(n_imports + 8, 128))
     blob_start = int(h.spec.SLOTS_PER_EPOCH)
+    blob_imports = 0
     for slot in range(1, n_imports + 1):
         node.on_slot(slot)
-        if slot >= blob_start and slot % BLOB_PERIOD == 0:
-            blobs = [_blob(h.spec, slot * 16 + i) for i in range(2)]
+        if slot >= blob_start and slot % blob_period == 0:
+            blob_imports += 1
+            blobs = [
+                _blob(h.spec, slot * 16 + i)
+                for i in range(blobs_per_slot)
+            ]
             comms = [
                 kzg.blob_to_kzg_commitment(b, consumer="bench")
                 for b in blobs
@@ -116,6 +142,13 @@ def measure(jax, platform):
     gap_multi_ms = round(
         multi_gaps[len(multi_gaps) // 2] * 1000.0, 3
     ) if multi_gaps else 0.0
+    # one-dispatch-slot evidence: how many imports went out as a fused
+    # chained program (dispatch kind "fused") vs the serial shape
+    fused_imports = sum(
+        1
+        for r in recs
+        if any(d.get("kind") == "fused" for d in r["dispatches"])
+    )
     return {
         "metric": "slotpath_wall_p50_ms",
         "value": wall_p50_ms,
@@ -141,5 +174,10 @@ def measure(jax, platform):
         "serial_dispatches_p50": summary["serial_dispatches_p50"],
         "serial_dispatches_max": summary["serial_dispatches_max"],
         "accounting_complete": accounting_complete,
+        "slot_fuse": bool(chain.slot_fuse),
+        "blob_imports": blob_imports,
+        "fused_imports": fused_imports,
+        "blob_period": blob_period,
+        "blobs_per_slot": blobs_per_slot,
         "valid_for_headline": bool(on_tpu and n_imports >= 16),
     }

@@ -160,6 +160,15 @@ def _apply_breaker_flags(chain, args) -> None:
         GUARD.self_test(journal=getattr(chain, "journal", None))
 
 
+def _apply_slot_fuse_flag(chain, args) -> None:
+    """bn --slot-fuse: one-dispatch slot programs (default on)."""
+    if chain is None:
+        return
+    fuse = getattr(args, "slot_fuse", None)
+    if fuse is not None:
+        chain.slot_fuse = fuse == "on"
+
+
 def _apply_slot_budget_flags(chain, args) -> None:
     """Slot-budget profiler knobs: the enable switch and the recent-
     imports ring size behind GET /lighthouse/slot_budget."""
@@ -208,6 +217,7 @@ def _serve_api(chain, args, banner: str) -> int:
     _apply_journal_flags(chain, args)
     _apply_bus_flags(chain, args)
     _apply_breaker_flags(chain, args)
+    _apply_slot_fuse_flag(chain, args)
     _apply_slot_budget_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
@@ -346,6 +356,7 @@ def cmd_bn(args):
     _apply_journal_flags(chain, args)
     _apply_bus_flags(chain, args)
     _apply_breaker_flags(chain, args)
+    _apply_slot_fuse_flag(chain, args)
     _apply_slot_budget_flags(chain, args)
     srv = BeaconApiServer(
         chain, host=args.http_address, port=args.http_port
@@ -861,6 +872,15 @@ def build_parser():
         help="canary sentinel checks on shared device batches: auto "
         "(tpu backend or armed fault injection — the default), on, "
         "or off",
+    )
+    bn.add_argument(
+        "--slot-fuse",
+        choices=["on", "off"],
+        default=None,
+        help="one-dispatch slot: chain tree-hash, signature fold and "
+        "KZG settle of a blob import into a single guarded device "
+        "dispatch (default on; off restores the serial "
+        "three-dispatch path)",
     )
     bn.add_argument(
         "--slot-budget",

@@ -401,10 +401,15 @@ class SlotBudgetRecorder:
         recs = self.recent()
         by_stage: dict = {}
         walls, gaps, serials = [], [], []
+        fused_imports = serial_imports = 0
         for r in recs:
             walls.append(r["wall_s"])
             gaps.append(r["fusable_gap_s"])
             serials.append(r["serial_dispatches"])
+            if any(d["kind"] == "fused" for d in r["dispatches"]):
+                fused_imports += 1
+            elif r["dispatches"]:
+                serial_imports += 1
             seen: dict = {}
             for name, s, e in r["stages"]:
                 seen[name] = seen.get(name, 0.0) + (e - s)
@@ -433,6 +438,11 @@ class SlotBudgetRecorder:
             if gaps else None,
             "serial_dispatches_p50": _quantile(serials, 0.5),
             "serial_dispatches_max": serials[-1] if serials else None,
+            # one-dispatch-slot ledger: imports whose device work rode
+            # a chained slot-program (dispatch kind "fused") vs imports
+            # that paid separate serial round trips
+            "fused_imports": fused_imports,
+            "serial_dispatch_imports": serial_imports,
             "stages": stages,
         }
 

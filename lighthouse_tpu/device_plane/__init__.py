@@ -34,6 +34,7 @@ from lighthouse_tpu.device_plane.executor import (
     GUARD,
     CanaryViolation,
     DeviceFaultError,
+    DispatchHandle,
     GuardedExecutor,
     host_device_scope,
     pow2_bucket,
@@ -45,6 +46,7 @@ __all__ = [
     "GUARD",
     "CanaryViolation",
     "DeviceFaultError",
+    "DispatchHandle",
     "GuardedExecutor",
     "host_device_scope",
     "pow2_bucket",

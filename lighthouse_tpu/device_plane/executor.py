@@ -37,6 +37,7 @@ accelerator, one set of jit caches); `bn --device-breaker-*` knobs call
 `GUARD.configure(...)`.
 """
 
+import queue
 import threading
 import time
 from contextlib import contextmanager
@@ -153,6 +154,46 @@ class InjectionPlan:
 
 NULL_PLAN = InjectionPlan()
 
+# dispatch_async double-buffer depth: one dispatch RUNNING on the
+# worker plus this many QUEUED behind it; a deeper submit blocks in
+# submission order, bounding how far ahead the host may marshal
+ASYNC_QUEUE_DEPTH = 1
+
+
+class DispatchHandle:
+    """Future-like handle returned by `dispatch_async`: the verdict of
+    one guarded dispatch running on the executor's FIFO worker thread.
+    `result()` blocks until the dispatch resolves and re-raises
+    whatever the synchronous `dispatch` would have raised on the
+    caller's thread — failover exhaustion, unguarded data-dependent
+    exceptions — so async callers keep the exact error semantics of
+    the serial path."""
+
+    __slots__ = ("_event", "_result", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._exc = None
+
+    def _resolve(self, result, exc):
+        self._result = result
+        self._exc = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise DeviceTimeout(
+                "dispatch_async result not ready within "
+                f"{timeout}s wait"
+            )
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
 
 def pow2_bucket(n: int) -> int:
     """Smallest power of two >= max(n, 1) — the shape-bucket convention
@@ -183,6 +224,12 @@ class GuardedExecutor:
         self._tls = threading.local()
         self._abandoned: list = []
         self._reaper = None
+        # dispatch_async plumbing: ONE FIFO worker thread so handles
+        # resolve in submission order, and a bounded queue so the host
+        # can marshal at most one dispatch ahead (double buffering)
+        self._async_lock = threading.Lock()
+        self._async_queue = None
+        self._async_worker = None
         self._init_config()
         self._init_counters()
 
@@ -393,6 +440,63 @@ class GuardedExecutor:
         finally:
             slot_budget.close_dispatch(_budget_tok)
             self._tls.transitions = None
+
+    def dispatch_async(
+        self, plane: str, bucket, device_fn, **kwargs
+    ) -> DispatchHandle:
+        """Non-blocking submission: enqueue one guarded dispatch on the
+        executor's single FIFO worker thread and return a
+        `DispatchHandle` immediately, so the caller's host work (SSZ
+        decode / marshal of import N+1) overlaps device compute of
+        import N. Every dispatch keeps the FULL guard rails — the
+        worker delegates to `dispatch`, so watchdog, canary, breaker,
+        injection, and failover apply unchanged.
+
+        Double buffering: the queue admits ONE submission beyond the
+        dispatch currently running; a deeper submission blocks here in
+        FIFO order (bounded marshal-ahead, and handles resolve in
+        submission order because one worker drains one queue).
+
+        The worker thread carries no slot-budget import record — async
+        dispatches are pipeline work ACROSS imports, profiled by the
+        bench harness rather than any single import's waterfall."""
+        handle = DispatchHandle()
+        with self._async_lock:
+            if self._async_queue is None:
+                self._async_queue = queue.Queue(
+                    maxsize=ASYNC_QUEUE_DEPTH
+                )
+            if (
+                self._async_worker is None
+                or not self._async_worker.is_alive()
+            ):
+                self._async_worker = threading.Thread(
+                    target=self._async_loop,
+                    name="device-async-executor",
+                    daemon=True,
+                )
+                self._async_worker.start()
+            q = self._async_queue
+        q.put((handle, plane, bucket, device_fn, kwargs))
+        return handle
+
+    def _async_loop(self):
+        while True:
+            q = self._async_queue
+            if q is None:
+                return
+            try:
+                item = q.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            handle, plane, bucket, device_fn, kwargs = item
+            try:
+                result = self.dispatch(plane, bucket, device_fn, **kwargs)
+            # lint: allow(except-swallow): worker-thread trampoline — the exception re-raises on the handle owner's thread via result()
+            except BaseException as exc:
+                handle._resolve(None, exc)
+            else:
+                handle._resolve(result, None)
 
     def _run_marked(self, device_fn, plan):
         """Invoke the attempt with this thread marked guard-active, so

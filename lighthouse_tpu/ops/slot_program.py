@@ -1,0 +1,241 @@
+"""Chained slot-program: one guarded dispatch for a whole import.
+
+PR 17's dispatch-gap ledger put a number on the one-dispatch-slot item:
+a blob import pays TWO serial host<->device round trips (the DA
+checker's KZG settle, then the verification bus's signature fold) with
+a multi-millisecond host gap between them — and on hardware every
+extra serial dispatch costs ~90 ms fixed (PERF_NOTES scaling model).
+This module is the fusion: a `SlotProgram` collects the import's
+co-resident device work — tree-hash Merkle branch checks, the
+signature RLC fold, and the KZG/blob settle — and runs ALL of it
+inside ONE `GUARD.dispatch` crossing, so the import uploads its inputs
+once, runs one scheduled device program, and downloads one verdict
+bundle (the fully pipelined verification datapath of the FPGA
+verification-engine design, arxiv 2112.02229).
+
+Guard-rail contract (identical to the bus's shared signature verify,
+`verification_bus/bus.py::_guarded_shared_verify`):
+
+  * the program dispatches on the "bls" plane (the pairing plane every
+    segment folds over), so it shares the breaker, canary, and fault-
+    injection state with the plain signature path — a quarantined
+    plane fails the CHAINED program over to the serial host tiers
+    exactly like it fails a plain batch;
+  * when the canary is active, the known-answer sentinel pair is
+    checked FIRST inside the same guarded attempt and the valid
+    sentinel rides the signature fold as an attribution-free extra
+    set — a lying plane is caught before any segment verdict escapes;
+  * every verdict the program produces routes through the attempt's
+    `InjectionPlan.verdict`, so a flip injection flips the settle and
+    Merkle verdicts too — which is exactly how the canary catches it;
+  * failover order mirrors the serial path: tpu -> xla-host (same
+    graphs pinned to the host device) -> ref; host backends get the
+    ref tier with `fault_types=(DeviceFaultError,)` so data-dependent
+    exceptions keep their caller-visible semantics.
+
+Byte-identity: each settle work keeps its OWN folded batch (per-
+submission verdict isolation — one import's invalid blob can never
+fail a coterminous import's settle), delivered via `work.deliver`, and
+a False/"error" settle verdict makes the DA checker fall back to the
+same per-sidecar host recovery the serial path uses. The signature
+fold is the unchanged `bls.verify_signature_sets_shared` boundary.
+
+`run_slot_program_segments` is the RAW chained executor: it must only
+ever run inside `SlotProgram.run`'s guarded attempt (or its failover
+tiers) — the guarded-dispatch lint pass pins it to this module the
+same way it pins `verify_signature_sets_tpu`.
+"""
+
+from lighthouse_tpu.common import slot_budget
+
+
+def _settle_tier_backend(work_backend: str, tier: str) -> str:
+    """Map a settle work's own backend onto a failover tier: the device
+    attempt and the xla-host tier keep the work's backend (xla-host
+    re-runs the same graphs pinned to the host device); the ref tier
+    drops a device backend to the reference fold, while host stubs
+    (fake) stay themselves — they ARE the host equivalent."""
+    if tier == "ref" and work_backend == "tpu":
+        return "ref"
+    return work_backend
+
+
+def run_slot_program_segments(
+    program, sig_backend, tier, plan, extra_sets, seed
+):
+    """Execute every segment of `program` as one chained device
+    program: KZG settle works first (each its own folded batch, verdict
+    delivered per work), then Merkle branch checks, then the signature
+    RLC fold spanning every submission. Returns `(ok, record)` where
+    `ok` is the signature+Merkle verdict (settle verdicts fan back via
+    `work.deliver`) and `record` is the signature batch economics.
+
+    RAW entry point: callers reach it only through `SlotProgram.run`
+    (guarded attempt + failover tiers) — see the lint pass."""
+    from lighthouse_tpu import bls, kzg
+
+    for work in program.settles:
+        blobs, commitments, proofs, work_backend = work.payload()
+        try:
+            ok = kzg.verify_blob_kzg_proof_batch(
+                blobs,
+                commitments,
+                proofs,
+                backend=_settle_tier_backend(work_backend, tier),
+                consumer="kzg",
+            )
+        except kzg.KzgError:
+            # same recovery the serial settle uses: a malformed
+            # candidate must not sink the rest — the checker falls
+            # back to per-sidecar verdicts on finalize
+            work.deliver("error")
+        else:
+            work.deliver(plan.verdict(bool(ok)))
+    program.merkle_results = []
+    merkle_ok = True
+    if program.merkle_segments:
+        from lighthouse_tpu.ops import merkle_proof
+
+        for queries, roots, consumer in program.merkle_segments:
+            verdicts = [
+                plan.verdict(bool(v))
+                for v in merkle_proof.batch_verify_branches(
+                    queries, roots, consumer=consumer
+                )
+            ]
+            program.merkle_results.append(verdicts)
+            merkle_ok = merkle_ok and all(verdicts)
+    if not program.signature_submissions:
+        # settle/Merkle-only program (the sync path's deferred settle):
+        # the group verdict is the non-signature segments' conjunction
+        return plan.verdict(True) and merkle_ok, None
+    ok, record = bls.verify_signature_sets_shared(
+        program.signature_submissions,
+        backend=sig_backend,
+        seed=seed,
+        extra_sets=extra_sets,
+    )
+    return plan.verdict(bool(ok)) and merkle_ok, record
+
+
+class SlotProgram:
+    """Builder for one import's chained device program. Compose with
+    `add_settle` (a DA checker `PendingSettle` — or anything exposing
+    `payload() -> (blobs, commitments, proofs, backend)` and
+    `deliver(verdict)`), `add_signatures`, and `add_merkle`; then one
+    `run()` is one guarded host<->device crossing for everything."""
+
+    def __init__(self, seed=None):
+        self.seed = seed
+        self.settles: list = []
+        self.signature_submissions: list = []  # (sets, consumer)
+        self.merkle_segments: list = []  # (queries, roots, consumer)
+        self.merkle_results: list = []
+
+    def add_settle(self, work):
+        self.settles.append(work)
+        return self
+
+    def add_signatures(self, sets, consumer: str):
+        sets = list(sets)
+        if sets:
+            self.signature_submissions.append((sets, consumer))
+        return self
+
+    def add_merkle(self, queries, roots, consumer: str = "bench"):
+        queries = list(queries)
+        if queries:
+            self.merkle_segments.append((queries, list(roots), consumer))
+        return self
+
+    @property
+    def empty(self) -> bool:
+        return not (
+            self.settles
+            or self.signature_submissions
+            or self.merkle_segments
+        )
+
+    def total_live(self) -> int:
+        return (
+            sum(len(s) for s, _ in self.signature_submissions)
+            + sum(len(w.payload()[0]) for w in self.settles)
+            + sum(len(q) for q, _, _ in self.merkle_segments)
+        )
+
+    def run(
+        self,
+        backend: str | None = None,
+        journal=None,
+        slot=None,
+        predicted_s=None,
+    ):
+        """One guarded dispatch for the whole program: watchdog +
+        breaker + canary + deterministic injection around the chained
+        segments, serial host failover on any device fault. Returns
+        `(ok, record)` like the bus's shared verify; settle verdicts
+        fan back through each work's `deliver`."""
+        from lighthouse_tpu import bls
+        from lighthouse_tpu.device_plane import (
+            GUARD,
+            DeviceFaultError,
+            canary,
+            host_device_scope,
+            pow2_bucket,
+        )
+        from lighthouse_tpu.device_plane.executor import NULL_PLAN
+
+        effective = backend or bls.default_backend()
+        canary_on = GUARD.canary_active(effective)
+        extra = (
+            [canary.bls_sentinels()[0]]
+            if canary_on and self.signature_submissions
+            else None
+        )
+
+        def attempt(plan):
+            if canary_on:
+                canary.check_pair(effective, plan)
+            return run_slot_program_segments(
+                self, backend, "device", plan, extra, self.seed
+            )
+
+        def host_tier(tier_backend, tier, scoped=False):
+            def run_tier():
+                if scoped:
+                    with host_device_scope():
+                        return run_slot_program_segments(
+                            self, tier_backend, tier, NULL_PLAN, None,
+                            self.seed,
+                        )
+                return run_slot_program_segments(
+                    self, tier_backend, tier, NULL_PLAN, None, self.seed
+                )
+
+            return run_tier
+
+        if effective == "tpu":
+            fallbacks = [
+                ("xla-host", host_tier("tpu", "xla-host", scoped=True)),
+                ("ref", host_tier("ref", "ref")),
+            ]
+            fault_types = None  # any escape from a device dispatch
+        else:
+            fallbacks = [("ref", host_tier("ref", "ref"))]
+            fault_types = (DeviceFaultError,)
+        # the fused dispatch interval belongs to the bus's caller-side
+        # "fused" mark (or, driven directly, to this outermost open)
+        tok = slot_budget.open_dispatch("slot_program", kind="fused")
+        try:
+            return GUARD.dispatch(
+                "bls",
+                pow2_bucket(max(1, self.total_live())),
+                attempt,
+                fallbacks=fallbacks,
+                journal=journal,
+                slot=slot,
+                predicted_s=predicted_s,
+                fault_types=fault_types,
+            )
+        finally:
+            slot_budget.close_dispatch(tok)

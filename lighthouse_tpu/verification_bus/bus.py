@@ -201,6 +201,11 @@ class VerificationBus:
         self.seed = seed
         self.wall_model = PredictedWallModel()
         self._lock = threading.Lock()
+        # thread-local slot-program staging: the chain stages an
+        # import's deferred DA settle here so the SAME submit that
+        # carries the import's signature sets becomes one chained
+        # slot-program (one dispatch for fold + settle)
+        self._tls = threading.local()
         self._pending: list[_Submission] = []
         self._batch_seq = 0
         # counters (under _lock)
@@ -240,13 +245,23 @@ class VerificationBus:
 
         `deadline` is a PR 10 Deadline (anything with `.remaining()`)
         or a float budget in seconds; None derives the class budget
-        (slot-clock-wired for gossip classes when available)."""
+        (slot-clock-wired for gossip classes when available).
+
+        When the chain staged a deferred DA settle on this thread
+        (`stage_program_work`), the submission becomes a CHAINED
+        slot-program: the settle rides the same single dispatch as the
+        signature fold (`ops/slot_program.py`), with per-submission
+        verdict isolation preserved — the settle verdict fans back
+        through the staged work, never through this return value."""
         sets = list(sets)
         if not sets:
             # still validate the label — a typo'd consumer must fail
-            # loudly here like it would on the non-empty path
+            # loudly here like it would on the non-empty path. A staged
+            # settle stays staged: the chain's finalize fallback settles
+            # it serially if no non-empty submit follows.
             attribution.normalize(consumer)
             return True
+        work = self.pop_staged_work()
         consumer = attribution.normalize(consumer)
         _SUBMITTED.labels(consumer).inc()
         budget_s = self._budget_for(consumer, deadline)
@@ -258,6 +273,55 @@ class VerificationBus:
             journal_attrs,
             backend or self.backend,
             budget_s,
+            kind="slot_program" if work is not None else "bls",
+            extra={"work": work} if work is not None else None,
+        )
+        return self._submit_and_wait(sub)
+
+    def stage_program_work(self, work):
+        """Stage one import's deferred device work (a DA checker
+        `PendingSettle`) on THIS thread: the next `submit` from the
+        same thread folds it into a chained slot-program. Thread-local
+        by design — the staging site and the signature-collector
+        submit run on the import thread back to back."""
+        self._tls.staged_work = work
+
+    def pop_staged_work(self):
+        """Claim (and clear) this thread's staged program work."""
+        work = getattr(self._tls, "staged_work", None)
+        if work is not None:
+            self._tls.staged_work = None
+        return work
+
+    def submit_program(
+        self,
+        work,
+        consumer: str = "kzg",
+        deadline=None,
+        journal=None,
+        slot=None,
+        backend: str | None = None,
+    ) -> bool:
+        """Submit a settle-only chained slot-program (the sync import
+        path: NO_VERIFICATION skips the signature fold, but the
+        deferred DA settle still wants the guarded one-dispatch
+        boundary). Blocks until the program ran; the settle verdict
+        fans back through `work.deliver`, and the caller reads it via
+        `work.finalize()` — the boolean returned here is the program's
+        group verdict, vacuously True for a healthy settle-only run."""
+        consumer = attribution.normalize(consumer)
+        _SUBMITTED.labels(consumer).inc()
+        budget_s = self._budget_for(consumer, deadline)
+        sub = _Submission(
+            [],
+            consumer,
+            journal if journal is not None else self.journal,
+            slot,
+            None,
+            backend or self.backend,
+            budget_s,
+            kind="slot_program",
+            extra={"work": work},
         )
         return self._submit_and_wait(sub)
 
@@ -313,8 +377,13 @@ class VerificationBus:
         # IS the import's causal device round trip (the flush may run
         # on another submitter's thread — this thread still blocks for
         # exactly that long). The queue-wait/dispatch split comes from
-        # the flush's dispatch_t0 stamp at close.
-        _budget_tok = slot_budget.open_dispatch(sub.consumer, kind="bus")
+        # the flush's dispatch_t0 stamp at close. Chained slot-programs
+        # mark kind "fused" so the dispatch ledger can count fused vs
+        # serial round trips per import.
+        _budget_tok = slot_budget.open_dispatch(
+            sub.consumer,
+            kind="fused" if sub.kind == "slot_program" else "bus",
+        )
         try:
             with self._lock:
                 self._pending.append(sub)
@@ -419,6 +488,11 @@ class VerificationBus:
         pending = [s for s in self._pending if not s.claimed]
         if not pending:
             return None
+        if any(s.kind == "slot_program" for s in pending):
+            # a chained slot-program IS an import's critical path
+            # carrying its own co-resident settle — holding it for
+            # co-riders only delays the import it was fused for
+            return "bulk"
         live = sum(len(s.sets) for s in pending)
         if live >= self.fill_target:
             return "fill"
@@ -490,7 +564,46 @@ class VerificationBus:
         (the flush groups by (backend, kind))."""
         if subs[0].kind == "da_cells":
             return self._cells_shared_verify(subs, backend)
+        if subs[0].kind == "slot_program":
+            return self._program_shared_verify(subs, backend)
         return self._guarded_shared_verify(subs, backend)
+
+    def _program_shared_verify(self, subs, backend):
+        """Chained slot-program dispatch: the group's signature sets
+        AND each submission's staged DA settle run as ONE guarded
+        device program (`ops/slot_program.py`) — one upload, one
+        scheduled program, one verdict bundle. The returned (ok,
+        record) is the signature verdict (the group contract the
+        mixed-batch retry isolates per submission); settle verdicts
+        fan back through each work's `deliver`, so one import's
+        invalid blob can never fail a coterminous import's fold. The
+        program dispatches on the same "bls" plane as the plain path:
+        same breaker, same canary sentinels, same deterministic
+        injection, same serial host failover tiers."""
+        from lighthouse_tpu import bls
+        from lighthouse_tpu.ops.slot_program import SlotProgram
+
+        program = SlotProgram(seed=self.seed)
+        for s in subs:
+            if s.sets:
+                program.add_signatures(s.sets, s.consumer)
+            work = (s.extra or {}).get("work")
+            if work is not None:
+                program.add_settle(work)
+        effective = backend or bls.default_backend()
+        journal = next(
+            (s.journal for s in subs if s.journal is not None), None
+        )
+        slot = next((s.slot for s in subs if s.slot is not None), None)
+        return program.run(
+            backend=backend,
+            journal=journal,
+            slot=slot,
+            predicted_s=self.wall_model.predict_s(
+                max(1, program.total_live()),
+                cold_risk=effective == "tpu",
+            ),
+        )
 
     def _cells_shared_verify(self, subs, backend):
         """Shared DA cell-proof dispatch: concatenate every
@@ -708,6 +821,13 @@ class VerificationBus:
         for s, ok_i in zip(subs, verdicts):
             journal = s.journal
             if journal is None:
+                continue
+            if s.kind == "slot_program" and not s.sets:
+                # settle-only program (sync path): no signature sets
+                # were counted on the registry side, so no
+                # signature_batch event either — the settle's own
+                # sidecar/da_settle events are its forensic record,
+                # exactly like the serial path
                 continue
             attrs = {
                 "consumer": s.consumer,

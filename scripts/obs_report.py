@@ -311,6 +311,16 @@ def render_slot_budget(doc: dict, waterfalls: int = 6,
             sdmax=doc.get("serial_dispatches_max"),
         )
     )
+    if "fused_imports" in doc:
+        # one-dispatch-slot ledger: chained slot-program imports vs
+        # imports that paid separate serial round trips
+        lines.append(
+            "dispatch mode: {f} fused (chained slot-program), "
+            "{s} serial".format(
+                f=doc.get("fused_imports", 0),
+                s=doc.get("serial_dispatch_imports", 0),
+            )
+        )
     stages = doc.get("stages") or {}
     if stages:
         name_w = max(len(n) for n in stages)
@@ -344,7 +354,11 @@ def render_slot_budget(doc: dict, waterfalls: int = 6,
             for name, s, e in (r.get("stages") or [])
         ] + [
             (
-                f"dev:{d.get('label')}",
+                # fused dispatches (the chained slot-program) are the
+                # one-dispatch slot's signature — make them readable
+                # at a glance in the waterfall
+                f"dev:{d.get('label')}"
+                + ("[fused]" if d.get("kind") == "fused" else ""),
                 d.get("start_s", 0.0),
                 d.get("end_s", 0.0),
                 "=",

@@ -8,9 +8,13 @@ deliberately separate:
 
   * STRUCTURE (exact, no timing in them — these never flake): the
     expected stage set is present, the accounting identity closed on
-    every import, the blob shape paid its >= 2 serial dispatches, and
-    the import count matches the request. A structure failure means
-    the instrument (or the import pipeline) broke, not that the
+    every import, and the dispatch shape matches the import mode —
+    with `--slot-fuse` on (the default: the bench line carries
+    `slot_fuse: true`) every blob import must ride ONE chained
+    dispatch (`serial_dispatches_max == 1`, zero multi-dispatch
+    imports, every blob import fused); with the fuse off the blob
+    shape must pay its >= 2 serial dispatches. A structure failure
+    means the instrument (or the import pipeline) broke, not that the
     machine was slow.
   * TIMING (tolerance-banded): wall p50 and each stage median must
     stay within `1 + rel_tolerance` of the baseline, with an absolute
@@ -106,13 +110,41 @@ def check_structure(line: dict) -> list:
             "accounting identity broken: union + unattributed != wall "
             "on at least one import"
         )
-    if (line.get("serial_dispatches_max") or 0) < 2:
-        out.append(
-            "no import paid >= 2 serial dispatches — the blob settle "
-            "round trip went missing from the dispatch ledger"
-        )
-    if (line.get("multi_dispatch_imports") or 0) < 1:
-        out.append("no multi-dispatch import in the run")
+    if line.get("slot_fuse"):
+        # one-dispatch slot: the settle rides the signature fold's
+        # dispatch, so NO import may pay a second serial round trip
+        blob_imports = line.get("blob_imports") or 0
+        if blob_imports < 1:
+            out.append(
+                "fused run imported no blob block — nothing "
+                "exercised the chained settle"
+            )
+        if (line.get("serial_dispatches_max") or 0) != 1:
+            out.append(
+                "fused run: serial_dispatches_max != 1 — a blob "
+                "import paid a separate settle round trip (or the "
+                "dispatch ledger lost the fused dispatch)"
+            )
+        if (line.get("multi_dispatch_imports") or 0) != 0:
+            out.append(
+                "fused run still has multi-dispatch imports — the "
+                "one-dispatch slot did not engage"
+            )
+        if (line.get("fused_imports") or 0) != blob_imports:
+            out.append(
+                "not every blob import rode a fused dispatch "
+                f"({line.get('fused_imports')} fused vs "
+                f"{blob_imports} blob imports)"
+            )
+    else:
+        if (line.get("serial_dispatches_max") or 0) < 2:
+            out.append(
+                "no import paid >= 2 serial dispatches — the blob "
+                "settle round trip went missing from the dispatch "
+                "ledger"
+            )
+        if (line.get("multi_dispatch_imports") or 0) < 1:
+            out.append("no multi-dispatch import in the run")
     if (line.get("serial_dispatches_p50") or 0) < 1:
         out.append("median import paid no device dispatch at all")
     return out

@@ -100,6 +100,11 @@ SWEEP = [
     # serial dispatches and the fusable gap for a full block import
     # (stamped into scripts/perf_gate_baseline.json's hardware block)
     ("pallas", 16, "slotpath"),
+    # --- one-dispatch slot A/B (PR 19): serial vs chained
+    # slot-program over the same blob schedule — the real per-dispatch
+    # fixed-cost number behind the ~90 ms/dispatch model, with
+    # verdict byte-identity asserted between the arms
+    ("pallas", 16, "slotfuse"),
     # --- per-sweep reference point + BASELINE configs
     ("xla", 1024),
     ("pallas", 64, "sync512"),

@@ -87,6 +87,64 @@ def test_structure_lost_dispatch_ledger():
     assert any("serial dispatches" in p for p in check_structure(line))
 
 
+def _fused_line() -> dict:
+    """A structurally healthy bench line from a --slot-fuse run: every
+    blob import rode ONE chained dispatch."""
+    line = _line()
+    line.update(
+        slot_fuse=True,
+        blob_imports=3,
+        fused_imports=3,
+        multi_dispatch_imports=0,
+        serial_dispatches_max=1,
+        fusable_gap_multi_dispatch_p50_ms=0.0,
+    )
+    return line
+
+
+def test_structure_fused_ok():
+    assert check_structure(_fused_line()) == []
+
+
+def test_structure_fused_extra_dispatch_fails():
+    # a blob import paying a second serial round trip means the
+    # one-dispatch slot silently fell apart
+    line = _fused_line()
+    line["serial_dispatches_max"] = 2
+    line["multi_dispatch_imports"] = 1
+    problems = check_structure(line)
+    assert any("serial_dispatches_max != 1" in p for p in problems)
+    assert any("multi-dispatch" in p for p in problems)
+
+
+def test_structure_fused_needs_blob_imports():
+    line = _fused_line()
+    line["blob_imports"] = 0
+    line["fused_imports"] = 0
+    assert any(
+        "imported no blob block" in p for p in check_structure(line)
+    )
+
+
+def test_structure_fused_counts_every_blob_import():
+    line = _fused_line()
+    line["fused_imports"] = 2  # one blob import settled serially
+    assert any(
+        "not every blob import" in p for p in check_structure(line)
+    )
+
+
+def test_committed_baseline_is_fused():
+    """The committed baseline records the default import mode — since
+    the one-dispatch-slot PR that is --slot-fuse on, single-dispatch
+    blob imports."""
+    with open(BASELINE_PATH) as f:
+        baseline = json.load(f)
+    assert baseline["slot_fuse"] is True
+    assert baseline["serial_dispatches_max"] == 1
+    assert baseline["fusable_gap_multi_dispatch_p50_ms"] == 0.0
+
+
 # --------------------------------------------------------- timing checks
 
 

@@ -1,0 +1,434 @@
+"""One-dispatch slot: chained slot-programs + the async executor.
+
+The fusion's whole contract is "same verdicts, fewer round trips", so
+every test here is an oracle test against the serial path:
+
+  * chained-program byte-identity — the full fused import pipeline
+    (bench_slotfuse's A/B driver on the fake backend) must produce a
+    canonical journal and head root byte-equal to the serial arm's,
+    with every blob import riding ONE dispatch of kind ``fused``;
+  * SlotProgram-level oracle on the fake and tpu (XLA) backends — the
+    chained tree-hash -> signature-fold -> KZG-settle program returns
+    exactly what the three serial dispatches return for the same seed;
+  * `dispatch_async` — handles resolve in submission order, the host
+    overlaps device compute, and exceptions re-raise on the caller's
+    thread with serial semantics;
+  * guard rails mid-chain — an injected stall (and then an open
+    breaker) fails the WHOLE chained program over to the serial host
+    tiers with correct verdicts, and a lying device is caught by the
+    canary before any chained verdict escapes;
+  * the `device_faults_fused` scenario — schema-pinned in tier-1; the
+    slow tier runs it twice and asserts zero wrong verdicts plus
+    byte-identical canonical replay.
+"""
+
+import copy
+import json
+import threading
+from pathlib import Path
+
+import pytest
+
+from lighthouse_tpu import bls, kzg
+from lighthouse_tpu.bench_slotfuse import _drive
+from lighthouse_tpu.device_plane.breaker import OPEN
+from lighthouse_tpu.device_plane.executor import (
+    GUARD,
+    DeviceFaultError,
+    GuardedExecutor,
+)
+from lighthouse_tpu.device_plane.faults import INJECTOR
+from lighthouse_tpu.ops import merkle_proof
+from lighthouse_tpu.ops.slot_program import SlotProgram
+
+_ROOT = Path(__file__).resolve().parent.parent
+_SEED = 11
+
+
+@pytest.fixture
+def clean_globals():
+    """Tests that touch the process-global GUARD / INJECTOR must leave
+    them at boot state for the rest of the suite."""
+    GUARD.reset()
+    INJECTOR.reset()
+    yield
+    GUARD.reset()
+    INJECTOR.reset()
+
+
+# ------------------------------------------- chain-level byte-identity
+
+
+def test_fused_import_byte_identical_to_serial(monkeypatch):
+    """The acceptance oracle end to end: the same blob-and-plain import
+    schedule driven through a serial node and a fused node yields
+    byte-equal canonical journals and the same head — and the fused arm
+    really did collapse every blob import to one dispatch."""
+    monkeypatch.setenv("SLOTPATH_BLOCKS", "12")
+    monkeypatch.setenv("SLOTPATH_BLOB_PERIOD", "4")
+    monkeypatch.setenv("SLOTPATH_BLOBS", "2")
+    serial = _drive("fake", fuse=False)
+    fused = _drive("fake", fuse=True)
+
+    assert fused["canonical"] == serial["canonical"]
+    assert fused["head_root"] == serial["head_root"]
+    # the schedule exercised both import shapes
+    assert fused["blob_imports"] >= 1
+    assert fused["blob_imports"] < 12  # plain imports in the mix too
+    # the fused arm: every blob import rode ONE chained dispatch
+    assert fused["serial_dispatches_max"] == 1
+    assert fused["fused_imports"] == fused["blob_imports"]
+    # the serial arm really paid the second round trip it exists to pay
+    assert serial["serial_dispatches_max"] >= 2
+    assert serial["fused_imports"] == 0
+    assert serial["budget_complete"] and fused["budget_complete"]
+
+
+# --------------------------------------- SlotProgram-level oracle
+
+
+class _SettleWork:
+    """Duck-typed stand-in for the DA checker's PendingSettle: records
+    every delivered verdict so the test can see exactly what the
+    chained program (or its failover tier) decided."""
+
+    def __init__(self, blobs, commitments, proofs, backend):
+        self._payload = (blobs, commitments, proofs, backend)
+        self.verdicts = []
+
+    def payload(self):
+        return self._payload
+
+    def deliver(self, verdict):
+        self.verdicts.append(verdict)
+
+
+def _settle_inputs(n=2, backend="ref", corrupt_last=False):
+    from lighthouse_tpu.bench_slotpath import _blob
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec()
+    blobs = [_blob(spec, 100 + i) for i in range(n)]
+    comms = [
+        kzg.blob_to_kzg_commitment(b, consumer="bench") for b in blobs
+    ]
+    proofs = [
+        kzg.compute_blob_kzg_proof(b, c, consumer="bench")
+        for b, c in zip(blobs, comms)
+    ]
+    if corrupt_last:
+        proofs[-1] = proofs[0]  # valid point, wrong opening
+    return blobs, comms, proofs, backend
+
+
+def _sig_sets(good=2, bad=0):
+    kps = bls.interop_keypairs(good + bad)
+    msg = b"slot-fuse-oracle"
+    sets = [
+        bls.SignatureSet(kp.sk.sign(msg), [kp.pk], msg)
+        for kp in kps[:good]
+    ]
+    sets += [
+        bls.SignatureSet(kp.sk.sign(b"wrong"), [kp.pk], msg)
+        for kp in kps[good:]
+    ]
+    return sets
+
+
+def _merkle_case():
+    """Two branch queries with host-folded roots: one honest, one with
+    a corrupted expected root (the negative polarity)."""
+    queries = [
+        (b"\x11" * 32, [b"\x22" * 32, b"\x33" * 32], 4),
+        (b"\x44" * 32, [b"\x55" * 32], 2),
+    ]
+    roots = merkle_proof.fold_branches_host(queries)
+    roots[1] = b"\x00" * 32
+    return queries, roots
+
+
+@pytest.mark.parametrize("backend", ["fake", "tpu"])
+def test_slot_program_matches_serial_dispatches(backend, clean_globals):
+    """The chained program's verdicts are EXACTLY the three serial
+    dispatches' verdicts for the same seed — on the fake backend and on
+    the tpu backend (the XLA graphs, pinned to CPU in tier-1)."""
+    GUARD.configure(watchdog=False, canary="off")
+    settle_backend = "fake" if backend == "fake" else "ref"
+    blobs, comms, proofs, _ = _settle_inputs(backend=settle_backend)
+    work = _SettleWork(blobs, comms, proofs, settle_backend)
+    sets = _sig_sets(good=2)
+    queries, roots = _merkle_case()
+
+    program = (
+        SlotProgram(seed=_SEED)
+        .add_settle(work)
+        .add_signatures(sets, consumer="gossip_single")
+        .add_merkle(queries, roots, consumer="bench")
+    )
+    ok, record = program.run(backend=backend)
+
+    # serial oracles, same inputs and seed
+    serial_settle = kzg.verify_blob_kzg_proof_batch(
+        blobs, comms, proofs, backend=settle_backend, consumer="kzg"
+    )
+    serial_sig, _ = bls.verify_signature_sets_shared(
+        [(sets, "gossip_single")], backend=backend, seed=_SEED
+    )
+    serial_merkle = merkle_proof.batch_verify_branches(
+        queries, roots, consumer="bench"
+    )
+    assert work.verdicts == [serial_settle] == [True]
+    assert program.merkle_results == [serial_merkle]
+    assert serial_merkle == [True, False]
+    assert ok == (bool(serial_sig) and all(serial_merkle)) is False
+    assert record is not None  # signature economics still reported
+
+
+def test_slot_program_bad_signature_fails_fold(clean_globals):
+    """One forged set sinks the chained fold exactly like the serial
+    fold — while the settle verdict stays independently correct."""
+    GUARD.configure(watchdog=False, canary="off")
+    blobs, comms, proofs, backend = _settle_inputs(backend="ref")
+    work = _SettleWork(blobs, comms, proofs, backend)
+    sets = _sig_sets(good=1, bad=1)
+    program = (
+        SlotProgram(seed=_SEED)
+        .add_settle(work)
+        .add_signatures(sets, consumer="gossip_single")
+    )
+    ok, _ = program.run(backend="ref")
+    assert ok is False
+    assert work.verdicts == [True]
+    serial_sig, _ = bls.verify_signature_sets_shared(
+        [(sets, "gossip_single")], backend="ref", seed=_SEED
+    )
+    assert bool(serial_sig) is False
+
+
+def test_slot_program_settle_only_and_bad_proof(clean_globals):
+    """The sync path's deferred-settle shape (no signature segment):
+    the group verdict is True and the settle work gets its own folded
+    verdict — False when a proof opens the wrong polynomial, exactly
+    like the serial batch."""
+    GUARD.configure(watchdog=False, canary="off")
+    blobs, comms, proofs, backend = _settle_inputs(
+        backend="ref", corrupt_last=True
+    )
+    work = _SettleWork(blobs, comms, proofs, backend)
+    ok, record = SlotProgram(seed=_SEED).add_settle(work).run(
+        backend="ref"
+    )
+    assert ok is True and record is None
+    assert work.verdicts == [
+        kzg.verify_blob_kzg_proof_batch(
+            blobs, comms, proofs, backend="ref", consumer="kzg"
+        )
+    ] == [False]
+
+
+# ----------------------------------------------------- dispatch_async
+
+
+def test_dispatch_async_resolves_in_submission_order():
+    g = GuardedExecutor()
+    g.configure(watchdog=False)
+    first_running = threading.Event()
+    release_first = threading.Event()
+    completions = []
+
+    def slow(plan):
+        first_running.set()
+        release_first.wait(10)
+        completions.append("slow")
+        return "slow"
+
+    def quick(plan):
+        completions.append("quick")
+        return "quick"
+
+    h1 = g.dispatch_async("bls", 4, slow)
+    assert first_running.wait(10)
+    h2 = g.dispatch_async("bls", 4, quick)  # double-buffered behind h1
+    release_first.set()
+    # one FIFO worker, one queue: submission order IS completion order
+    assert h1.result(timeout=10) == "slow"
+    assert h2.result(timeout=10) == "quick"
+    assert completions == ["slow", "quick"]
+    assert h1.done() and h2.done()
+
+
+def test_dispatch_async_overlaps_host_work():
+    """The point of the async boundary: submission returns while the
+    device dispatch is still in flight, so the caller marshals import
+    N+1 during import N's device compute."""
+    g = GuardedExecutor()
+    g.configure(watchdog=False)
+    release = threading.Event()
+    h = g.dispatch_async("bls", 4, lambda plan: release.wait(10))
+    assert not h.done()  # submission returned; dispatch still running
+    release.set()  # the host-side work the overlap window buys
+    assert h.result(timeout=10) is True
+
+
+def test_dispatch_async_keeps_serial_error_semantics():
+    """An unguarded data-dependent exception re-raises on the handle
+    owner's thread; a guarded fault still walks the failover chain —
+    identical to the synchronous dispatch."""
+    g = GuardedExecutor()
+    g.configure(watchdog=False)
+
+    def malformed(plan):
+        raise ValueError("bad input bytes")
+
+    h = g.dispatch_async(
+        "bls", 1, malformed, fault_types=(DeviceFaultError,)
+    )
+    with pytest.raises(ValueError, match="bad input bytes"):
+        h.result(timeout=10)
+
+    def broken_device(plan):
+        raise RuntimeError("device wedged")
+
+    h = g.dispatch_async(
+        "bls", 1, broken_device, fallbacks=[("ref", lambda: "host")]
+    )
+    assert h.result(timeout=10) == "host"
+
+
+# ------------------------------------------- guard rails mid-chain
+
+
+def test_stall_then_open_breaker_fail_chain_over_serially(
+    clean_globals,
+):
+    """A stall injected into the chained dispatch abandons the WHOLE
+    program to the serial host tier (verdicts correct), trips the
+    breaker at threshold 1, and the next chained program fails over
+    breaker-open without touching the device — still correct."""
+    GUARD.configure(watchdog=False, canary="off", threshold=1)
+    INJECTOR.arm("stall", "bls", rate=1.0, seed=1)
+
+    blobs, comms, proofs, backend = _settle_inputs(backend="ref")
+    work = _SettleWork(blobs, comms, proofs, backend)
+    program = (
+        SlotProgram(seed=_SEED)
+        .add_settle(work)
+        .add_signatures(_sig_sets(good=2), consumer="gossip_single")
+    )
+    ok, _ = program.run(backend="ref")
+    assert ok is True and work.verdicts == [True]
+
+    st = GUARD.stats()
+    assert st["faults"].get("bls:stall") == 1
+    assert st["failovers"].get("bls:ref") == 1
+    assert GUARD.breaker.state_of("bls", "4") == OPEN
+
+    # breaker now open: the next chained program (one forged set, so
+    # the CORRECT verdict is False) skips the device entirely
+    work2 = _SettleWork(blobs, comms, proofs, backend)
+    program2 = (
+        SlotProgram(seed=_SEED)
+        .add_settle(work2)
+        .add_signatures(
+            _sig_sets(good=1, bad=1), consumer="gossip_single"
+        )
+    )
+    ok2, _ = program2.run(backend="ref")
+    assert ok2 is False and work2.verdicts == [True]
+    assert GUARD.stats()["failovers"].get("bls:ref") == 2
+    # the stall count did not grow: breaker-open never dispatched
+    assert GUARD.stats()["faults"].get("bls:stall") == 1
+
+
+def test_flip_mid_chain_caught_by_canary_zero_wrong_verdicts(
+    clean_globals,
+):
+    """A lying device under the chained program: the canary pair is
+    checked FIRST inside the guarded attempt, so the flip is caught
+    before any chained verdict escapes and the serial host tier
+    delivers only correct verdicts."""
+    GUARD.configure(watchdog=False)  # canary auto: armed injector => on
+    INJECTOR.arm("flip", "bls", rate=1.0, seed=9)
+
+    blobs, comms, proofs, backend = _settle_inputs(backend="ref")
+    work = _SettleWork(blobs, comms, proofs, backend)
+    program = (
+        SlotProgram(seed=_SEED)
+        .add_settle(work)
+        .add_signatures(_sig_sets(good=2), consumer="gossip_single")
+    )
+    ok, _ = program.run(backend="ref")
+    assert ok is True  # NOT flipped
+    assert work.verdicts == [True]  # settle verdict escaped unflipped
+    st = GUARD.stats()
+    assert st["faults"].get("bls:canary") == 1
+    assert st["failovers"].get("bls:ref") == 1
+
+
+# ------------------------------------------- the fused fault scenario
+
+
+def _fused_scenario_doc():
+    path = (
+        _ROOT
+        / "lighthouse_tpu"
+        / "sim"
+        / "scenarios"
+        / "device_faults_fused.json"
+    )
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_fused_device_fault_scenario_schema():
+    """The committed scenario drives BLOB slots through both fault
+    windows — the whole point is faults landing on the chained
+    slot-program, not the plain signature path."""
+    from lighthouse_tpu.sim.scenario import ScenarioError, validate
+
+    doc = _fused_scenario_doc()
+    sc = validate(doc)
+    assert sorted(f.kind for f in sc.faults) == [
+        "device_flip",
+        "device_stall",
+    ]
+    assert all(f.plane == "bls" for f in sc.faults)
+    assert sc.blob_slots == [9, 10, 13, 14]
+    # every fault window overlaps at least one blob slot
+    for f in sc.faults:
+        assert any(
+            f.at_slot <= s < f.until_slot for s in sc.blob_slots
+        ), f"{f.kind} window misses every blob slot"
+    assert "device_no_wrong_verdicts" in sc.invariants
+    assert "device_breaker_balanced" in sc.invariants
+
+    bad = copy.deepcopy(doc)
+    bad["blob_slots"] = [99]  # outside the run
+    with pytest.raises(ScenarioError, match="blob_slots"):
+        validate(bad)
+
+
+@pytest.mark.slow
+def test_fused_scenario_zero_wrong_verdicts_and_replay():
+    """Acceptance, end to end: stalls and flips landing INSIDE chained
+    slot-programs still yield zero wrong verdicts (the invariant suite
+    checks every settle and fold against the host oracle), and two runs
+    with one seed replay byte-identically."""
+    from lighthouse_tpu.sim import Simulation, scenario as scenario_mod
+
+    def run_once():
+        sim = Simulation(
+            scenario_mod.find_scenario("device_faults_fused")
+        )
+        try:
+            return sim.run()
+        finally:
+            sim.close()
+
+    r1 = run_once()
+    assert r1["ok"], r1["violations"]
+    assert "device_no_wrong_verdicts" in r1["invariants"]
+    r2 = run_once()
+    assert r1["journals"] == r2["journals"], (
+        "fused fault scenario replay diverged"
+    )
